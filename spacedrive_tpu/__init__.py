@@ -1,0 +1,20 @@
+"""spacedrive_tpu — a TPU-native virtual distributed filesystem (VDFS) framework.
+
+A ground-up re-design of the capabilities of `annihilatorrrr/spacedrive`
+(Rust/Tauri file manager with a content-addressed, CRDT-synced library
+database) for TPU hosts:
+
+- **Metadata plane** (host CPU): SQLite library database, HLC-ordered
+  LWW-CRDT sync, P2P transfer protocol, typed RPC API.
+- **Compute plane** (TPU, JAX/XLA/Pallas): batched BLAKE3 content
+  addressing (cas_id), vmapped thumbnail resizing, perceptual-hash
+  dedup via MXU matmuls, and a flax image-labeler model.
+- **Execution plane**: an interruptible task system + stateful job layer
+  whose workers assemble fixed-shape batches feeding a double-buffered
+  host→TPU pipeline.
+
+Reference behavior citations use `ref:<path>:<line>` pointing into the
+upstream tree (e.g. ``ref:core/src/object/cas.rs:23``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""TPU compute plane: batched content hashing, resizing, perceptual hashing."""

@@ -1,0 +1,2 @@
+from .errors import FileIOError, SpacedriveError, VersionManagerError
+from .events import EventBus

@@ -1,0 +1,33 @@
+"""Error taxonomy (parity: ref:crates/utils/src/error.rs)."""
+
+from __future__ import annotations
+
+
+class SpacedriveError(Exception):
+    """Base class for all framework errors."""
+
+
+class FileIOError(SpacedriveError):
+    """An IO error tagged with the path it happened on
+    (parity: ref:crates/utils/src/error.rs FileIOError)."""
+
+    def __init__(self, path, cause: BaseException | str):
+        self.path = str(path)
+        self.cause = cause
+        super().__init__(f"{self.path}: {cause}")
+
+
+class VersionManagerError(SpacedriveError):
+    """Config migration failure (parity: ref:core/src/util/version_manager.rs)."""
+
+
+class MissingFieldError(SpacedriveError):
+    """A DB field expected to be present was NULL
+    (parity: ref:crates/utils/src/db.rs maybe_missing)."""
+
+
+def maybe_missing(value, field: str):
+    """Guard against NULL DB fields (parity: ref:crates/utils/src/db.rs:12)."""
+    if value is None:
+        raise MissingFieldError(field)
+    return value

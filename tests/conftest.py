@@ -1,0 +1,16 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Multi-chip sharding is validated on host-platform virtual devices
+(no TPU needed for the test suite), per the framework's test strategy:
+N in-process nodes + loopback transports for distributed tests.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,65 @@
+"""cas_id parity tests: sampling layout, CPU path, batched device path."""
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.ops import cas
+from spacedrive_tpu.ops.blake3_ref import StreamingBlake3
+
+RNG = np.random.default_rng(42)
+
+
+def _content(n: int) -> bytes:
+    return RNG.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_small_file_message_is_size_prefixed_whole_content():
+    c = _content(5000)
+    msg = cas.message_from_bytes(c)
+    assert msg[:8] == (5000).to_bytes(8, "little")
+    assert msg[8:] == c
+
+
+def test_large_file_layout_matches_reference_seek_sequence():
+    # Simulate the reference's read/seek loop independently and compare.
+    size = 300_000
+    c = _content(size)
+    jump = (size - 2 * cas.HEADER_OR_FOOTER_SIZE) // cas.SAMPLE_COUNT
+    expect = [c[:8192]]
+    for k in range(4):
+        off = 8192 + k * jump
+        expect.append(c[off:off + 10240])
+    expect.append(c[-8192:])
+    msg = cas.message_from_bytes(c)
+    assert msg == size.to_bytes(8, "little") + b"".join(expect)
+    assert len(msg) == cas.LARGE_MSG_LEN
+
+
+@pytest.mark.parametrize(
+    "size",
+    [0, 1, 1000, 100 * 1024 - 1, 100 * 1024, 100 * 1024 + 1, 123_456, 1_000_000],
+)
+def test_file_cas_cpu_matches_from_bytes(tmp_path, size):
+    c = _content(size)
+    p = tmp_path / "f.bin"
+    p.write_bytes(c)
+    assert cas.cas_id_cpu(p) == cas.cas_id_from_bytes_cpu(c)
+
+
+def test_batched_device_cas_matches_cpu():
+    sizes = [0, 5, 1024, 50_000, 100 * 1024, 100 * 1024 + 1, 250_000, 57_344]
+    contents = [_content(s) for s in sizes]
+    msgs = [cas.message_from_bytes(c) for c in contents]
+    got = cas.cas_ids_batched(msgs)
+    want = [cas.cas_id_from_bytes_cpu(c) for c in contents]
+    assert got == want
+    assert all(len(h) == 16 for h in got)
+
+
+def test_full_digest_64_hex():
+    # Validator-style full digest through the streaming hasher.
+    c = _content(3 * 1024 * 1024 + 5)
+    h = StreamingBlake3()
+    for off in range(0, len(c), 1 << 20):
+        h.update(c[off:off + (1 << 20)])
+    assert len(h.hexdigest()) == 64
