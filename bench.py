@@ -1,0 +1,100 @@
+"""Headline benchmark: batched cas_id BLAKE3 hashing, TPU vs multi-core CPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The workload is BASELINE.json config 2 (batched cas_id hashing of
+large-bucket sampled messages — every file > 100 KiB hashes exactly
+57,352 bytes, ref:core/src/object/cas.rs:10-21). The baseline is the
+framework's own native C BLAKE3 fanned out over all host cores — the
+same role the Rust `blake3` crate plays in the reference's
+file_identifier hot loop (ref:core/src/object/file_identifier/mod.rs:105).
+All diagnostics go to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from spacedrive_tpu import native
+    from spacedrive_tpu.ops import blake3_jax
+    from spacedrive_tpu.ops.cas import LARGE_CHUNKS, LARGE_MSG_LEN
+
+    import jax
+
+    n = int(os.environ.get("SD_BENCH_FILES", "4096"))
+    iters = int(os.environ.get("SD_BENCH_ITERS", "5"))
+    rng = np.random.default_rng(0)
+
+    log(f"devices: {jax.devices()}")
+    log(f"generating {n} large-bucket messages ({LARGE_MSG_LEN} B each)…")
+    arr = rng.integers(0, 256, size=(n, LARGE_CHUNKS * 1024), dtype=np.uint8)
+    arr[:, LARGE_MSG_LEN:] = 0  # zero pad beyond message length
+    lens = np.full((n,), LARGE_MSG_LEN, np.int32)
+    total_bytes = n * LARGE_MSG_LEN
+
+    # --- device path (compile, then timed end-to-end incl. host->device)
+    words = blake3_jax.hash_batch(arr, lens, max_chunks=LARGE_CHUNKS)
+    jax.block_until_ready(words)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        words = blake3_jax.hash_batch(arr, lens, max_chunks=LARGE_CHUNKS)
+    jax.block_until_ready(words)
+    dev_s = (time.perf_counter() - t0) / iters
+    dev_fps = n / dev_s
+    log(f"device: {dev_s*1e3:.1f} ms/batch  {dev_fps:,.0f} files/s  "
+        f"{total_bytes/dev_s/1e9:.2f} GB/s")
+
+    # device-resident (data already on device): isolates kernel from PCIe
+    arr_dev = jax.device_put(arr)
+    lens_dev = jax.device_put(lens)
+    jax.block_until_ready(blake3_jax.hash_batch(arr_dev, lens_dev, max_chunks=LARGE_CHUNKS))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        w2 = blake3_jax.hash_batch(arr_dev, lens_dev, max_chunks=LARGE_CHUNKS)
+    jax.block_until_ready(w2)
+    res_s = (time.perf_counter() - t0) / iters
+    log(f"device-resident: {res_s*1e3:.1f} ms/batch  {n/res_s:,.0f} files/s  "
+        f"{total_bytes/res_s/1e9:.2f} GB/s")
+
+    # --- CPU baseline: native C BLAKE3 over all cores
+    cores = os.cpu_count() or 1
+    msgs = [arr[i, :LARGE_MSG_LEN].tobytes() for i in range(n)]
+    cpu_fps = None
+    if native.available():
+        native.blake3_many(msgs[:64], cores)  # warm
+        t0 = time.perf_counter()
+        digests = native.blake3_many(msgs, cores)
+        cpu_s = time.perf_counter() - t0
+        cpu_fps = n / cpu_s
+        log(f"cpu ({cores} threads): {cpu_s*1e3:.1f} ms  {cpu_fps:,.0f} files/s  "
+            f"{total_bytes/cpu_s/1e9:.2f} GB/s")
+        # parity spot-check: device digests == native digests
+        hexes = blake3_jax.words_to_hex(words, 32)
+        for i in (0, n // 2, n - 1):
+            assert hexes[i] == digests[i].hex(), f"digest mismatch at {i}"
+        log("parity: device digests match native CPU digests")
+    else:
+        log("native CPU baseline unavailable (no C compiler)")
+
+    print(json.dumps({
+        "metric": "cas_id_blake3_throughput",
+        "value": round(dev_fps, 1),
+        "unit": "files/s",
+        "vs_baseline": round(dev_fps / cpu_fps, 3) if cpu_fps else None,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

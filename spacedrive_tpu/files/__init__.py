@@ -1,0 +1,23 @@
+"""File taxonomy + path decomposition (the reference's sd-file-ext and
+sd-file-path-helper crates, re-designed as data-driven Python)."""
+
+from .kind import ObjectKind
+from .extensions import (
+    Extension,
+    ExtensionPossibility,
+    from_str,
+    resolve_conflicting,
+    verify_magic_bytes,
+)
+from .isolated_path import IsolatedFilePathData, FilePathMetadata
+
+__all__ = [
+    "ObjectKind",
+    "Extension",
+    "ExtensionPossibility",
+    "from_str",
+    "resolve_conflicting",
+    "verify_magic_bytes",
+    "IsolatedFilePathData",
+    "FilePathMetadata",
+]

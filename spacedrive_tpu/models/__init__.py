@@ -1,0 +1,18 @@
+"""TPU model zoo for the framework's ML subsystems.
+
+The reference embeds one model family — a YOLOv8 ONNX image labeler run
+through ONNX Runtime C++ (ref:crates/ai/src/lib.rs:22-77). Here the
+labeler is a native flax model compiled by XLA, shardable over a device
+mesh (dp/fsdp/tp), with the same role: emit text labels for images in a
+library so they become searchable.
+"""
+
+from .labeler import LabelerNet, LABEL_CLASSES, create_train_state, train_step, infer_step
+
+__all__ = [
+    "LabelerNet",
+    "LABEL_CLASSES",
+    "create_train_state",
+    "train_step",
+    "infer_step",
+]

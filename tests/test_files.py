@@ -1,0 +1,164 @@
+"""Extension/kind taxonomy + IsolatedFilePathData tests (mirrors the
+reference's inline tests in crates/file-ext/src/extensions.rs:370-564
+and crates/file-path-helper/src/isolated_file_path_data.rs)."""
+
+import os
+
+import pytest
+
+from spacedrive_tpu.files import (
+    IsolatedFilePathData,
+    ObjectKind,
+    from_str,
+    resolve_conflicting,
+)
+from spacedrive_tpu.files.extensions import Extension, kind_for_path
+from spacedrive_tpu.files.isolated_path import separate_name_and_extension
+
+
+def test_from_str_known():
+    poss = from_str("jpg")
+    assert poss.known == Extension("Image", "jpg")
+    assert poss.known.kind == ObjectKind.Image
+
+
+def test_from_str_conflict():
+    poss = from_str("ts")
+    assert poss.known is None
+    cats = {e.category for e in poss.conflicts}
+    assert cats == {"Video", "Code"}
+
+
+def test_from_str_unknown():
+    assert from_str("jeff") is None
+
+
+def test_case_insensitive():
+    assert from_str("JPG").known == Extension("Image", "jpg")
+
+
+def test_kind_mapping():
+    assert from_str("pdf").known.kind == ObjectKind.Document
+    assert from_str("7z").known.kind == ObjectKind.Archive
+    assert from_str("sqlite").known.kind == ObjectKind.Database
+    assert from_str("epub").known.kind == ObjectKind.Book
+    assert from_str("ttf").known.kind == ObjectKind.Font
+    assert from_str("py").known.kind == ObjectKind.Code
+    assert from_str("yaml").known.kind == ObjectKind.Config
+
+
+def test_resolve_conflicting_ts(tmp_path):
+    # MPEG-TS sync byte 0x47 -> Video; otherwise -> Code
+    video = tmp_path / "clip.ts"
+    video.write_bytes(b"\x47" + b"\x00" * 16)
+    code = tmp_path / "module.ts"
+    code.write_bytes(b"export const x = 1;\n")
+    v = resolve_conflicting(video)
+    c = resolve_conflicting(code)
+    assert v == Extension("Video", "ts")
+    assert c == Extension("Code", "ts")
+
+
+def test_magic_check_forced(tmp_path):
+    # a fake "png" that is actually jpeg bytes fails the forced check
+    fake = tmp_path / "fake.png"
+    fake.write_bytes(b"\xff\xd8\xff\xe0" + b"\x00" * 16)
+    assert resolve_conflicting(fake, always_check_magic_bytes=True) is None
+    real = tmp_path / "real.png"
+    real.write_bytes(bytes([0x89, 0x50, 0x4E, 0x47, 0x0D, 0x0A, 0x1A, 0x0A]) + b"\x00" * 8)
+    assert resolve_conflicting(real, always_check_magic_bytes=True) == Extension("Image", "png")
+
+
+def test_magic_with_offset(tmp_path):
+    mov = tmp_path / "film.mov"
+    mov.write_bytes(b"\x00\x00\x00\x14" + b"ftypqt  " + b"\x00" * 8)
+    assert resolve_conflicting(mov, always_check_magic_bytes=True) == Extension("Video", "mov")
+
+
+def test_wildcard_magic(tmp_path):
+    gif = tmp_path / "anim.gif"
+    gif.write_bytes(b"GIF87a" + b"\x00" * 8)
+    assert resolve_conflicting(gif, always_check_magic_bytes=True) == Extension("Image", "gif")
+
+
+def test_kind_for_path():
+    assert kind_for_path("x/y/photo.JPEG") == ObjectKind.Image
+    assert kind_for_path("dir", is_dir=True) == ObjectKind.Folder
+    assert kind_for_path("mystery.xyz") == ObjectKind.Unknown
+
+
+# --- IsolatedFilePathData ---
+
+def test_isolated_file():
+    iso = IsolatedFilePathData.new(1, "/loc", "/loc/a/b/photo.tar.gz", is_dir=False)
+    assert iso.materialized_path == "/a/b/"
+    assert iso.name == "photo.tar"
+    assert iso.extension == "gz"
+    assert iso.relative_path == "a/b/photo.tar.gz"
+    assert iso.full_name() == "photo.tar.gz"
+    assert not iso.is_root
+
+
+def test_isolated_dir_and_root():
+    root = IsolatedFilePathData.new(1, "/loc", "/loc", is_dir=True)
+    assert root.is_root and root.materialized_path == "/" and root.name == ""
+    d = IsolatedFilePathData.new(1, "/loc", "/loc/a/b", is_dir=True)
+    assert d.materialized_path == "/a/" and d.name == "b" and d.extension == ""
+    assert d.materialized_path_for_children() == "/a/b/"
+    assert root.materialized_path_for_children() == "/"
+
+
+def test_isolated_parent():
+    iso = IsolatedFilePathData.new(1, "/loc", "/loc/a/b/c.txt", is_dir=False)
+    p = iso.parent()
+    assert p.is_dir and p.materialized_path == "/a/" and p.name == "b"
+    pp = p.parent()
+    assert pp.materialized_path == "/" and pp.name == "a"
+    assert pp.parent().is_root
+
+
+def test_isolated_outside_location():
+    with pytest.raises(Exception):
+        IsolatedFilePathData.new(1, "/loc", "/other/file.txt", is_dir=False)
+
+
+def test_isolated_roundtrip_db():
+    iso = IsolatedFilePathData.new(7, "/loc", "/loc/x/y/z.png", is_dir=False)
+    back = IsolatedFilePathData.from_db_row(
+        7, iso.materialized_path, iso.name, iso.extension, iso.is_dir
+    )
+    assert back == iso
+    assert back.join_on("/loc") == os.path.join("/loc", "x/y/z.png")
+
+
+def test_separate_name_extension():
+    assert separate_name_and_extension("a.tar.gz") == ("a.tar", "gz")
+    assert separate_name_and_extension("noext") == ("noext", "")
+    assert separate_name_and_extension(".env") == (".env", "")
+
+
+def test_version_manager(tmp_path):
+    from spacedrive_tpu.utils.version_manager import VersionManager
+
+    vm = VersionManager(current_version=2)
+
+    @vm.register(0)
+    def _v0(d):
+        d["name"] = d.pop("title", "untitled")
+        return d
+
+    @vm.register(1)
+    def _v1(d):
+        d["renamed"] = True
+        return d
+
+    cfg = tmp_path / "c.json"
+    cfg.write_text('{"version": 0, "title": "x"}')
+    data = vm.load(cfg)
+    assert data == {"version": 2, "name": "x", "renamed": True}
+    # persisted migrated form
+    data2 = vm.load(cfg)
+    assert data2 == data
+    # fresh default
+    fresh = vm.load(tmp_path / "new.json", default={"name": "d"})
+    assert fresh["version"] == 2
