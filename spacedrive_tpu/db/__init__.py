@@ -1,0 +1,21 @@
+"""Data layer — per-library SQLite database.
+
+Parity: the reference's Prisma schema (ref:core/prisma/schema.prisma)
+and generated client. One SQLite file per library; typed access helpers
+and the sync-model registry (the reference generates these with
+`prisma-client-rust` + `sync-generator`; here they are explicit,
+readable tables).
+"""
+
+from .database import LibraryDb, dict_row
+from .schema import SCHEMA_VERSION
+from .sync_registry import SyncKind, SYNC_MODELS, model_sync_kind
+
+__all__ = [
+    "LibraryDb",
+    "dict_row",
+    "SCHEMA_VERSION",
+    "SyncKind",
+    "SYNC_MODELS",
+    "model_sync_kind",
+]

@@ -155,3 +155,16 @@ class IsolatedFilePathData:
 
     def __str__(self) -> str:
         return self.relative_path
+
+
+def full_path_from_db_row(location_path: str | os.PathLike, row: dict) -> str:
+    """Absolute path of a file_path DB row — the one canonical
+    reconstruction used by every pipeline."""
+    iso = IsolatedFilePathData.from_db_row(
+        row.get("location_id", 0),
+        row["materialized_path"],
+        row["name"],
+        row["extension"] or "",
+        bool(row.get("is_dir")),
+    )
+    return iso.join_on(location_path)

@@ -1,0 +1,5 @@
+"""Locations — watched directory trees indexed into the library.
+
+Parity: ref:core/src/location/ (location CRUD, indexer, watcher,
+non-indexed browsing).
+"""

@@ -1,0 +1,19 @@
+"""Filesystem indexer: rule engine + walker + indexer job.
+
+Parity: ref:core/src/location/indexer/ (rules/mod.rs, walk.rs,
+indexer_job.rs, shallow.rs).
+"""
+
+from .rules import IndexerRule, RuleKind, RulePerKind, system_rules
+from .walker import WalkedEntry, WalkResult, walk, walk_single_dir
+
+__all__ = [
+    "IndexerRule",
+    "RuleKind",
+    "RulePerKind",
+    "system_rules",
+    "WalkedEntry",
+    "WalkResult",
+    "walk",
+    "walk_single_dir",
+]

@@ -1,0 +1,166 @@
+"""Location CRUD + scan orchestration.
+
+Parity: ref:core/src/location/mod.rs — LocationCreateArgs::create
+(:1-200 region), `scan_location` spawning the
+Indexer → FileIdentifier → MediaProcessor chain (:443-475),
+`light_scan_location` (:517), and `.spacedrive` metadata markers
+(location/metadata.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import uuid
+from dataclasses import dataclass
+from typing import Any
+
+from ..db.database import new_pub_id, now_iso, u64_blob
+from ..jobs import JobBuilder, JobManager
+from ..node.library import Library
+
+logger = logging.getLogger(__name__)
+
+SPACEDRIVE_LOCATION_METADATA_FILE = ".spacedrive"
+
+
+@dataclass
+class LocationCreateArgs:
+    path: str
+    name: str | None = None
+    dry_run: bool = False
+    indexer_rules_ids: list[int] | None = None
+
+    def create(self, library: Library) -> dict[str, Any] | None:
+        path = os.path.abspath(self.path)
+        if not os.path.isdir(path):
+            raise NotADirectoryError(path)
+        existing = library.db.find_one("location", path=path)
+        if existing is not None:
+            raise FileExistsError(f"location already exists for {path}")
+        if self.dry_run:
+            return None
+
+        pub_id = new_pub_id()
+        name = self.name or os.path.basename(path.rstrip(os.sep)) or path
+        date_created = now_iso()
+        loc_id = library.db.insert(
+            "location",
+            pub_id=pub_id,
+            name=name,
+            path=path,
+            date_created=date_created,
+            instance_id=library.config.instance_id,
+        )
+        # default rules attach (ref:location/mod.rs create flow)
+        rule_ids = self.indexer_rules_ids
+        if rule_ids is None:
+            rule_ids = [
+                r["id"] for r in library.db.query(
+                    'SELECT id FROM indexer_rule WHERE "default" = 1'
+                )
+            ]
+        for rid in rule_ids:
+            library.db.insert(
+                "indexer_rule_in_location", location_id=loc_id, indexer_rule_id=rid
+            )
+        # sync ops for the shared location row
+        library.sync.write_ops(
+            library.sync.shared_create(
+                "location",
+                pub_id.hex(),
+                [("name", name), ("path", path), ("date_created", date_created)],
+            )
+        )
+        # marker file (ref:location/metadata.rs)
+        try:
+            metadata_path = os.path.join(path, SPACEDRIVE_LOCATION_METADATA_FILE)
+            with open(metadata_path, "w", encoding="utf-8") as f:
+                json.dump({"location_pub_id": pub_id.hex(), "library_id": str(library.id)}, f)
+        except OSError:
+            logger.warning("could not write .spacedrive marker in %s", path)
+        return library.db.find_one("location", id=loc_id)
+
+
+async def scan_location(
+    library: Library,
+    location: dict[str, Any],
+    job_manager: JobManager,
+    *,
+    backend: str = "auto",
+) -> uuid.UUID:
+    """Full scan job chain (ref:location/mod.rs:443-475)."""
+    from ..object.file_identifier.job import FileIdentifierJob
+    from ..object.media.job import MediaProcessorJob
+    from .indexer.job import IndexerJob
+
+    builder = (
+        JobBuilder(IndexerJob({"location_id": location["id"]}))
+        .queue_next(FileIdentifierJob({"location_id": location["id"], "backend": backend}))
+        .queue_next(MediaProcessorJob({"location_id": location["id"], "backend": backend}))
+    )
+    return await builder.spawn(job_manager, library)
+
+
+async def light_scan_location(
+    library: Library,
+    location: dict[str, Any],
+    sub_path: str,
+    job_manager: JobManager,
+) -> uuid.UUID:
+    """Shallow re-scan of one directory (ref:location/mod.rs:517)."""
+    from ..object.file_identifier.job import FileIdentifierJob
+    from ..object.media.job import MediaProcessorJob
+    from .indexer.job import IndexerJob
+
+    builder = (
+        JobBuilder(
+            IndexerJob(
+                {"location_id": location["id"], "sub_path": sub_path, "shallow": True}
+            )
+        )
+        .queue_next(
+            FileIdentifierJob({"location_id": location["id"], "sub_path": sub_path})
+        )
+        .queue_next(
+            MediaProcessorJob({"location_id": location["id"], "sub_path": sub_path})
+        )
+    )
+    return await builder.spawn(job_manager, library)
+
+
+def relink_location(library: Library, path: str) -> dict[str, Any] | None:
+    """Re-attach a moved location by its `.spacedrive` marker."""
+    marker = os.path.join(path, SPACEDRIVE_LOCATION_METADATA_FILE)
+    try:
+        with open(marker, "r", encoding="utf-8") as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    pub_id = bytes.fromhex(meta["location_pub_id"])
+    row = library.db.find_one("location", pub_id=pub_id)
+    if row is None:
+        return None
+    library.db.update("location", {"id": row["id"]}, path=os.path.abspath(path))
+    return library.db.find_one("location", id=row["id"])
+
+
+def update_location_size(library: Library, location_id: int) -> int:
+    """Roll directory sizes up into the location row
+    (ref:location/mod.rs reverse_update_directories_sizes)."""
+    from ..db.database import blob_u64
+
+    total = sum(
+        blob_u64(r["size_in_bytes_bytes"]) or 0
+        for r in library.db.query(
+            "SELECT size_in_bytes_bytes FROM file_path "
+            "WHERE location_id = ? AND is_dir = 0",
+            (location_id,),
+        )
+    )
+    library.db.update(
+        "location", {"id": location_id},
+        size_in_bytes=u64_blob(total),
+    )
+    return total

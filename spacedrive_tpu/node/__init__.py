@@ -1,0 +1,8 @@
+"""Node runtime: root object, config, libraries.
+
+Parity: ref:core/src/{lib.rs,node/,library/}.
+"""
+
+from .library import Library, Libraries, LibraryConfig
+
+__all__ = ["Library", "Libraries", "LibraryConfig"]

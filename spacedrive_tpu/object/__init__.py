@@ -1,0 +1,5 @@
+"""Objects — content-identified entities behind file_paths.
+
+Parity: ref:core/src/object/ (cas, file_identifier, media, fs ops,
+validation, tags, orphan remover).
+"""

@@ -1,0 +1,6 @@
+"""file_identifier — links orphan file_paths to content-addressed
+Objects. Parity: ref:core/src/object/file_identifier/."""
+
+from .job import FileIdentifierJob, CHUNK_SIZE
+
+__all__ = ["FileIdentifierJob", "CHUNK_SIZE"]

@@ -1,0 +1,251 @@
+"""FileIdentifierJob — cas_id hashing + object linking, TPU-batched.
+
+Parity: ref:core/src/object/file_identifier/ — orphan query with cursor
+pagination (file_identifier_job.rs:56-165), CHUNK_SIZE = 100 files per
+step (mod.rs:33-34), FileMetadata::new = fs metadata + kind resolve +
+cas_id (mod.rs:57-96), then cas_id sync updates + object
+dedupe/create/connect (mod.rs:98-350).
+
+TPU-first: where the reference hashes ≤100 files concurrently on CPU
+cores (join_all), each step here assembles the sampled messages on the
+host and hashes the whole chunk as ONE device batch (Pallas/XLA BLAKE3)
+— the batch dim replaces task-level concurrency. The chunk size is
+raised accordingly (devices want bigger batches), configurable via
+init["chunk_size"].
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any
+
+from ...db.database import blob_u64, new_pub_id, now_iso
+from ...files.extensions import from_str as ext_from_str
+from ...files.isolated_path import full_path_from_db_row as _row_full_path
+from ...files.kind import ObjectKind
+from ...jobs import StatefulJob
+from ...jobs.job import JobContext, JobError, StepResult
+from ...jobs.manager import register_job
+from ...ops import cas
+
+logger = logging.getLogger(__name__)
+
+CHUNK_SIZE = 100            # ref:mod.rs:34 (CPU parity constant)
+DEVICE_CHUNK_SIZE = 2048    # device batches amortize dispatch overhead
+
+
+def orphan_where_clause(sub_path_mat: str | None = None) -> str:
+    """Orphan = no object, not identified yet, real file
+    (ref:file_identifier_job.rs orphan_path_filters)."""
+    base = (
+        "object_id IS NULL AND cas_id IS NULL AND is_dir = 0 "
+        "AND location_id = ?"
+    )
+    if sub_path_mat is not None:
+        base += " AND materialized_path LIKE ?"
+    return base
+
+
+@register_job
+class FileIdentifierJob(StatefulJob):
+    """init: {location_id, sub_path?, backend?, chunk_size?}"""
+
+    NAME = "file_identifier"
+    IS_BATCHED = True
+
+    async def init_job(self, ctx: JobContext) -> None:
+        library = ctx.library
+        loc_id = self.init["location_id"]
+        location = library.db.find_one("location", id=loc_id)
+        if location is None:
+            raise JobError(f"location {loc_id} not found")
+
+        backend = self.init.get("backend", "auto")
+        chunk = self.init.get("chunk_size") or (
+            DEVICE_CHUNK_SIZE if backend in ("tpu", "device", "auto") else CHUNK_SIZE
+        )
+
+        params: list[Any] = [loc_id]
+        where = orphan_where_clause(self.init.get("sub_path") and self.init["sub_path"])
+        if self.init.get("sub_path"):
+            params.append(f"/{self.init['sub_path'].strip('/')}/%")
+        total = library.db.count("file_path", where, tuple(params))
+
+        self.data.update(
+            location_id=loc_id,
+            location_path=location["path"],
+            backend=backend,
+            chunk_size=chunk,
+            cursor=0,
+        )
+        n_steps = (total + chunk - 1) // chunk
+        for _ in range(n_steps):
+            self.steps.append({"kind": "identify"})
+        self.run_metadata.update(
+            total_orphan_paths=total, created_objects=0, linked_objects=0,
+            hash_time=0.0, db_time=0.0,
+        )
+        ctx.progress(
+            task_count=n_steps,
+            message=f"identifying {total} orphan paths", phase="identifying",
+        )
+
+    async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
+        library = ctx.library
+        d = self.data
+        params: list[Any] = [d["location_id"]]
+        where = orphan_where_clause(self.init.get("sub_path"))
+        if self.init.get("sub_path"):
+            params.append(f"/{self.init['sub_path'].strip('/')}/%")
+        # cursor pagination by id (ref:file_identifier_job.rs:126-165)
+        rows = library.db.query(
+            f"SELECT * FROM file_path WHERE {where} AND id > ? ORDER BY id LIMIT ?",
+            tuple(params) + (d["cursor"], d["chunk_size"]),
+        )
+        if not rows:
+            return StepResult()
+        d["cursor"] = rows[-1]["id"]
+
+        t0 = time.perf_counter()
+        loc_path = d["location_path"]
+        metas: list[dict | None] = []
+        messages: list[bytes] = []
+        msg_rows: list[dict] = []
+        for row in rows:
+            full = _row_full_path(loc_path, row)
+            size = blob_u64(row["size_in_bytes_bytes"]) or 0
+            if size == 0:
+                metas.append({"row": row, "cas_id": None})
+                continue
+            try:
+                msg = cas.read_message(full, size)
+            except OSError as e:
+                metas.append(None)
+                logger.debug("identifier: unreadable %s: %s", full, e)
+                continue
+            messages.append(msg)
+            msg_rows.append(row)
+            metas.append({"row": row, "cas_id": "pending"})
+
+        cas_ids = cas.cas_ids(messages, d["backend"])
+        hash_time = time.perf_counter() - t0
+
+        by_row_id = {r["id"]: c for r, c in zip(msg_rows, cas_ids)}
+
+        t1 = time.perf_counter()
+        created, linked = self._link_objects(library, rows, by_row_id)
+        db_time = time.perf_counter() - t1
+
+        errors = [f"unreadable file_path {r['id']}" for m, r in zip(metas, rows) if m is None]
+        return StepResult(
+            errors=errors,
+            metadata={
+                "created_objects": self.run_metadata["created_objects"] + created,
+                "linked_objects": self.run_metadata["linked_objects"] + linked,
+                "hash_time": round(self.run_metadata["hash_time"] + hash_time, 4),
+                "db_time": round(self.run_metadata["db_time"] + db_time, 4),
+            },
+        )
+
+    def _link_objects(
+        self, library, rows: list[dict], cas_by_row_id: dict[int, str]
+    ) -> tuple[int, int]:
+        """cas_id updates + object dedupe/create/connect in one sync
+        write (ref:mod.rs:157-347)."""
+        sync = library.sync
+        ops = []
+        created = linked = 0
+
+        # existing objects for these cas_ids
+        distinct = sorted({c for c in cas_by_row_id.values()})
+        existing: dict[str, tuple[int, bytes]] = {}
+        if distinct:
+            qmarks = ",".join("?" for _ in distinct)
+            for row in library.db.query(
+                f"SELECT fp.cas_id, fp.object_id, o.pub_id AS object_pub FROM file_path fp "
+                f"JOIN object o ON o.id = fp.object_id "
+                f"WHERE fp.cas_id IN ({qmarks}) AND fp.object_id IS NOT NULL",
+                tuple(distinct),
+            ):
+                existing.setdefault(row["cas_id"], (row["object_id"], row["object_pub"]))
+
+        new_objects: dict[str, tuple[bytes, dict]] = {}  # cas -> (obj pub_id, row)
+        updates: list[tuple[dict, str, int | None, bytes | None]] = []
+        for row in rows:
+            cas_id = cas_by_row_id.get(row["id"])
+            if cas_id is None:
+                continue
+            if cas_id in existing:
+                obj_id, obj_pub = existing[cas_id]
+                updates.append((row, cas_id, obj_id, obj_pub))
+                linked += 1
+            elif cas_id in new_objects:
+                updates.append((row, cas_id, None, new_objects[cas_id][0]))
+                linked += 1
+            else:
+                obj_pub = new_pub_id()
+                new_objects[cas_id] = (obj_pub, row)
+                updates.append((row, cas_id, None, obj_pub))
+                created += 1
+
+        date_created = now_iso()
+        obj_rows: dict[bytes, int] = {}
+
+        def writes(conn):
+            # create missing objects
+            for cas_id, (obj_pub, src_row) in new_objects.items():
+                kind = _kind_for_row(src_row)
+                cur = conn.execute(
+                    "INSERT INTO object (pub_id, kind, date_created) VALUES (?,?,?)",
+                    (obj_pub, int(kind), date_created),
+                )
+                obj_rows[obj_pub] = cur.lastrowid
+            # connect + cas updates
+            for row, cas_id, obj_id, obj_pub in updates:
+                if obj_id is None and obj_pub is not None:
+                    obj_id = obj_rows.get(obj_pub)
+                conn.execute(
+                    "UPDATE file_path SET cas_id = ?, object_id = ? WHERE id = ?",
+                    (cas_id, obj_id, row["id"]),
+                )
+
+        for cas_id, (obj_pub, src_row) in new_objects.items():
+            kind = _kind_for_row(src_row)
+            ops.extend(
+                sync.shared_create(
+                    "object", obj_pub.hex(),
+                    [("kind", int(kind)), ("date_created", date_created)],
+                )
+            )
+        for row, cas_id, _obj_id, obj_pub in updates:
+            rid = row["pub_id"].hex()
+            ops.append(sync.shared_update("file_path", rid, "cas_id", cas_id))
+            if obj_pub is not None:
+                ops.append(
+                    sync.shared_update("file_path", rid, "object_id", obj_pub.hex())
+                )
+
+        sync.write_ops(ops, writes)
+        return created, linked
+
+    async def finalize(self, ctx: JobContext) -> Any:
+        ctx.progress(message="identification complete", phase="done")
+        return dict(self.run_metadata)
+
+
+def _kind_for_row(row: dict) -> ObjectKind:
+    if row.get("is_dir"):
+        return ObjectKind.Folder
+    ext = row.get("extension") or ""
+    if not ext:
+        return ObjectKind.Unknown
+    poss = ext_from_str(ext)
+    if poss is None:
+        return ObjectKind.Unknown
+    if poss.known is not None:
+        return poss.known.kind
+    # conflicting extension: prefer the first conflict's kind (full
+    # magic-sniff happens in the media pipeline where bytes are read)
+    return poss.conflicts[0].kind

@@ -1,0 +1,4 @@
+"""Media pipeline: EXIF extraction, thumbnails, labeler hookup.
+
+Parity: ref:core/src/object/media/.
+"""

@@ -1,0 +1,163 @@
+"""MediaProcessorJob — thumbnails + EXIF rows + labeler batches.
+
+Parity: ref:core/src/object/media/media_processor/job.rs — init
+dispatches ALL thumbnails to the node-wide thumbnailer actor (:148-170),
+optionally enqueues an image-labeler batch (:176-196); steps are chunks
+of 10 files of EXIF extraction plus WaitThumbnails/WaitLabels
+rendezvous steps (:83-88, :199-230).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+from ...files.extensions import all_extensions
+from ...files.isolated_path import full_path_from_db_row as _full_path
+from ...jobs import StatefulJob
+from ...jobs.job import JobContext, JobError, StepResult
+from ...jobs.manager import register_job
+from .media_data import ImageMetadata
+
+logger = logging.getLogger(__name__)
+
+BATCH_SIZE = 10  # ref:media_processor/job.rs:50
+
+# extensions we can thumbnail / extract exif from (PIL-decodable subset
+# of the reference's FILTERED_IMAGE_EXTENSIONS)
+THUMBNAILABLE_EXTENSIONS = tuple(
+    e for e in all_extensions("Image")
+    if e in ("jpg", "jpeg", "png", "gif", "bmp", "tiff", "webp", "ico")
+)
+EXIF_EXTENSIONS = ("jpg", "jpeg", "png", "tiff", "webp")
+
+
+@register_job
+class MediaProcessorJob(StatefulJob):
+    """init: {location_id, sub_path?, backend?}"""
+
+    NAME = "media_processor"
+    IS_BATCHED = True
+
+    async def init_job(self, ctx: JobContext) -> None:
+        library = ctx.library
+        loc_id = self.init["location_id"]
+        location = library.db.find_one("location", id=loc_id)
+        if location is None:
+            raise JobError(f"location {loc_id} not found")
+        self.data.update(location_id=loc_id, location_path=location["path"])
+
+        qmarks = ",".join("?" for _ in THUMBNAILABLE_EXTENSIONS)
+        sub_filter = ""
+        params: list[Any] = [loc_id, *THUMBNAILABLE_EXTENSIONS]
+        if self.init.get("sub_path"):
+            sub_filter = " AND materialized_path LIKE ?"
+            params.append(f"/{self.init['sub_path'].strip('/')}/%")
+        rows = library.db.query(
+            f"SELECT id, pub_id, cas_id, object_id, materialized_path, name, extension "
+            f"FROM file_path WHERE location_id = ? AND is_dir = 0 "
+            f"AND object_id IS NOT NULL AND cas_id IS NOT NULL "
+            f"AND extension IN ({qmarks}){sub_filter}",
+            tuple(params),
+        )
+
+        # dispatch ALL thumbnails up-front to the node thumbnailer actor
+        # (ref:job.rs:148-156); the job only awaits counts later.
+        thumbnailer = getattr(getattr(library, "node", None), "thumbnailer", None)
+        dispatched = 0
+        if thumbnailer is not None and rows:
+            loc_path = self.data["location_path"]
+            batch = [
+                (r["cas_id"], _full_path(loc_path, r)) for r in rows
+            ]
+            thumbnailer.new_indexed_thumbnails_batch(
+                library.id, batch, background=False
+            )
+            dispatched = len(batch)
+        self.data["thumbs_dispatched"] = dispatched
+
+        exif_rows = [r for r in rows if (r["extension"] or "") in EXIF_EXTENSIONS]
+        for i in range(0, len(exif_rows), BATCH_SIZE):
+            chunk = exif_rows[i:i + BATCH_SIZE]
+            self.steps.append(
+                {
+                    "kind": "extract_media_data",
+                    "ids": [(r["id"], r["object_id"]) for r in chunk],
+                }
+            )
+        if dispatched:
+            self.steps.append({"kind": "wait_thumbnails", "count": dispatched})
+        labeler = getattr(getattr(library, "node", None), "image_labeler", None)
+        if labeler is not None and rows:
+            loc_path = self.data["location_path"]
+            batch_id = labeler.new_batch(
+                library,
+                [
+                    {"file_path_id": r["id"], "object_id": r["object_id"],
+                     "path": _full_path(loc_path, r)}
+                    for r in rows
+                ],
+            )
+            self.steps.append({"kind": "wait_labels", "batch_id": batch_id})
+
+        self.run_metadata.update(
+            media_data_extracted=0, media_data_skipped=0,
+            thumbnails_dispatched=dispatched,
+        )
+        ctx.progress(
+            message=f"processing media for {len(rows)} files", phase="media"
+        )
+
+    async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
+        kind = step["kind"]
+        if kind == "extract_media_data":
+            return self._extract_media_data(ctx, step)
+        if kind == "wait_thumbnails":
+            return await self._wait_thumbnails(ctx, step)
+        if kind == "wait_labels":
+            return await self._wait_labels(ctx, step)
+        return StepResult()
+
+    def _extract_media_data(self, ctx: JobContext, step: dict) -> StepResult:
+        library = ctx.library
+        loc_path = self.data["location_path"]
+        extracted = skipped = 0
+        for fp_id, object_id in step["ids"]:
+            row = library.db.find_one("file_path", id=fp_id)
+            if row is None or object_id is None:
+                skipped += 1
+                continue
+            meta = ImageMetadata.from_path(_full_path(loc_path, row))
+            if meta is None:
+                skipped += 1
+                continue
+            cols = meta.to_row(object_id)
+            library.db.upsert("media_data", {"object_id": object_id}, **{
+                k: v for k, v in cols.items() if k != "object_id"
+            })
+            extracted += 1
+        return StepResult(
+            metadata={
+                "media_data_extracted": self.run_metadata["media_data_extracted"] + extracted,
+                "media_data_skipped": self.run_metadata["media_data_skipped"] + skipped,
+            }
+        )
+
+    async def _wait_thumbnails(self, ctx: JobContext, step: dict) -> StepResult:
+        """Rendezvous with the thumbnailer actor (ref:job.rs:83-88
+        WaitThumbnails step)."""
+        thumbnailer = getattr(getattr(ctx.library, "node", None), "thumbnailer", None)
+        if thumbnailer is not None:
+            await thumbnailer.wait_library_batch(ctx.library.id)
+        return StepResult()
+
+    async def _wait_labels(self, ctx: JobContext, step: dict) -> StepResult:
+        labeler = getattr(getattr(ctx.library, "node", None), "image_labeler", None)
+        if labeler is not None:
+            await labeler.wait_batch(step["batch_id"])
+        return StepResult()
+
+    async def finalize(self, ctx: JobContext) -> Any:
+        ctx.progress(message="media processing complete", phase="done")
+        return dict(self.run_metadata)

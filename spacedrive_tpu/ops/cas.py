@@ -130,3 +130,56 @@ def cas_ids_for_paths(paths: Iterable[tuple[str, int]]) -> list[str]:
     BLAKE3 on device."""
     msgs = [read_message(p, s) for p, s in paths]
     return cas_ids_batched(msgs)
+
+
+def cas_ids_native_cpu(messages: Sequence[bytes]) -> list[str] | None:
+    """Threaded C BLAKE3 path; None when the native lib is unavailable."""
+    from .. import native
+
+    digests = native.blake3_many(list(messages))
+    if digests is None:
+        return None
+    return [d[:8].hex() for d in digests]
+
+
+def cas_ids(messages: Sequence[bytes], backend: str = "auto") -> list[str]:
+    """Backend-selected batched cas_ids.
+
+    - "tpu"/"device": JAX accelerator batch (falls back if jax is
+      unusable only under "auto").
+    - "cpu": native C (threaded), then pure Python.
+    - "auto": device if a non-CPU jax backend is live, else native C,
+      else Python — the same default-with-fallback contract the
+      north-star requires.
+    """
+    if not messages:
+        return []
+    if backend in ("tpu", "device"):
+        return cas_ids_batched(messages)
+    if backend == "cpu":
+        got = cas_ids_native_cpu(messages)
+        if got is not None:
+            return got
+        return [StreamingBlake3().update(m).hexdigest()[:16] for m in messages]
+    # auto
+    if _device_available():
+        try:
+            return cas_ids_batched(messages)
+        except Exception:  # noqa: BLE001 - fall back to host hashing
+            pass
+    return cas_ids(messages, "cpu")
+
+
+_DEVICE_STATE: list[bool] | None = None
+
+
+def _device_available() -> bool:
+    global _DEVICE_STATE
+    if _DEVICE_STATE is None:
+        try:
+            import jax
+
+            _DEVICE_STATE = [jax.devices()[0].platform != "cpu"]
+        except Exception:  # noqa: BLE001 - no usable accelerator
+            _DEVICE_STATE = [False]
+    return _DEVICE_STATE[0]

@@ -1,0 +1,136 @@
+"""Sync manager — the write/read sides of library replication.
+
+Parity: ref:core/crates/sync/src/manager.rs — `write_ops` persists
+domain rows and their crdt_operation rows in ONE transaction (:70-93);
+`get_ops` pages ops after per-instance watermarks (:115-172); the
+manager owns the library's HLC and instance identity and emits
+SyncMessage events for the P2P layer.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import Any, Callable, Iterable
+
+from ..db.database import LibraryDb
+from ..utils.events import EventBus
+from .crdt import CRDTOperation
+from .factory import OperationFactory
+from .hlc import HybridLogicalClock, NTP64
+
+logger = logging.getLogger(__name__)
+
+
+class SyncManager(OperationFactory):
+    """One per library. Also the OperationFactory for local writes."""
+
+    def __init__(
+        self,
+        db: LibraryDb,
+        instance: uuid.UUID,
+        event_bus: EventBus | None = None,
+        emit_messages: bool = True,
+    ):
+        super().__init__(HybridLogicalClock(instance), instance)
+        self.db = db
+        self.event_bus = event_bus or EventBus()
+        self.emit_messages = emit_messages
+        # per-instance ingest watermarks (ref:manager.rs:29 `timestamps`)
+        self.timestamps: dict[uuid.UUID, NTP64] = {}
+        self._load_timestamps()
+
+    # --- startup ---
+
+    def _load_timestamps(self) -> None:
+        rows = self.db.query(
+            "SELECT i.pub_id, MAX(c.timestamp) AS ts FROM crdt_operation c "
+            "JOIN instance i ON i.id = c.instance_id GROUP BY c.instance_id"
+        )
+        for row in rows:
+            self.timestamps[uuid.UUID(bytes=row["pub_id"])] = NTP64(row["ts"])
+
+    def _instance_db_id(self, instance: uuid.UUID) -> int:
+        row = self.db.find_one("instance", pub_id=instance.bytes)
+        if row is None:
+            raise ValueError(f"unknown instance {instance}")
+        return row["id"]
+
+    # --- write side (ref:manager.rs:70-93) ---
+
+    def write_ops(
+        self,
+        ops: list[CRDTOperation],
+        db_writes: Callable[[Any], None] | None = None,
+    ) -> None:
+        """Atomically apply `db_writes(conn)` (domain rows) and persist
+        `ops`; then notify subscribers (SyncMessage::Created)."""
+        if not ops and db_writes is None:
+            return
+        instance_ids: dict[uuid.UUID, int] = {}
+        with self.db.transaction() as conn:
+            if db_writes is not None:
+                db_writes(conn)
+            for op in ops:
+                iid = instance_ids.get(op.instance)
+                if iid is None:
+                    iid = self._instance_db_id(op.instance)
+                    instance_ids[op.instance] = iid
+                conn.execute(
+                    "INSERT OR REPLACE INTO crdt_operation "
+                    "(id, timestamp, model, record_id, kind, data, instance_id) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?)",
+                    (
+                        op.id.bytes,
+                        int(op.timestamp),
+                        op.model,
+                        _record_id_blob(op.record_id),
+                        op.kind(),
+                        op.pack(),
+                        iid,
+                    ),
+                )
+        if ops and self.emit_messages:
+            self.event_bus.emit(("SyncMessage", "Created"))
+
+    # --- read side (ref:manager.rs:115-172) ---
+
+    def get_ops(
+        self,
+        count: int = 1000,
+        clocks: Iterable[tuple[uuid.UUID, NTP64]] = (),
+    ) -> list[CRDTOperation]:
+        """Ops strictly after each instance's watermark, oldest first.
+        `clocks` are the requesting peer's per-instance watermarks;
+        instances not listed start from 0. Filtering and paging happen
+        in SQL so cost is O(page), not O(op-log)."""
+        clock_map = {inst: int(ts) for inst, ts in clocks}
+        conds, params = [], []
+        for row in self.db.query("SELECT id, pub_id FROM instance"):
+            watermark = clock_map.get(uuid.UUID(bytes=row["pub_id"]), -1)
+            conds.append("(c.instance_id = ? AND c.timestamp > ?)")
+            params.extend([row["id"], watermark])
+        if not conds:
+            return []
+        rows = self.db.query(
+            "SELECT c.data FROM crdt_operation c "
+            f"WHERE {' OR '.join(conds)} "
+            "ORDER BY c.timestamp ASC LIMIT ?",
+            (*params, count),
+        )
+        return [CRDTOperation.unpack(r["data"]) for r in rows]
+
+    def get_cloud_ops(self, count: int = 1000) -> list[tuple[bytes, CRDTOperation]]:
+        """Pending rows from the cloud receive cache
+        (ref:core/src/cloud/sync/ingest.rs)."""
+        rows = self.db.query(
+            "SELECT id, data FROM cloud_crdt_operation ORDER BY timestamp ASC LIMIT ?",
+            (count,),
+        )
+        return [(r["id"], CRDTOperation.unpack(r["data"])) for r in rows]
+
+
+def _record_id_blob(record_id: Any) -> bytes:
+    import msgpack
+
+    return msgpack.packb(record_id, use_bin_type=True)

@@ -13,4 +13,45 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# If a TPU-tunnel PJRT plugin (e.g. "axon") was registered by a
+# sitecustomize hook, deregister it: its device query can block even
+# when JAX_PLATFORMS=cpu, and the test suite must never touch real
+# accelerator hardware. The hook also imports jax early, so the env
+# vars above were read already — force the config directly too.
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu", "interpreter"):
+            _xb._backend_factories.pop(_name, None)
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        pass  # older jax: XLA_FLAGS path above applies
+except Exception:
+    pass
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Minimal async-test support (pytest-asyncio isn't in the image):
+# coroutine test functions run under asyncio.run with a fresh loop.
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: async test (built-in runner)")

@@ -1,0 +1,161 @@
+"""End-to-end slice: library create → location add → IndexerJob →
+FileIdentifierJob → MediaProcessorJob; objects + cas_ids + media_data
+land in the DB and CRDT ops are recorded (SURVEY.md §7 build step 4)."""
+
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.db.database import blob_u64
+from spacedrive_tpu.jobs import JobManager, JobStatus
+from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+from spacedrive_tpu.node import Libraries
+from spacedrive_tpu.ops.cas import cas_id_cpu
+from spacedrive_tpu.tasks import TaskSystem
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    data = tmp_path / "data"
+    loc = tmp_path / "stuff"
+    (loc / "docs").mkdir(parents=True)
+    (loc / "docs" / "a.txt").write_bytes(b"hello world")
+    (loc / "docs" / "b.txt").write_bytes(b"hello world")  # dup content
+    (loc / "big.bin").write_bytes(np.random.default_rng(7).integers(0, 256, 300_000, dtype=np.uint8).tobytes())
+    (loc / "empty.txt").write_bytes(b"")
+    # tiny valid png for the media processor
+    from PIL import Image
+
+    Image.new("RGB", (32, 24), (200, 10, 10)).save(loc / "red.png")
+    return data, loc
+
+
+@pytest.mark.asyncio
+async def test_full_scan_chain(tree):
+    data_dir, loc_path = tree
+    libs = Libraries(data_dir)
+    library = libs.create("test-lib")
+    mgr = JobManager(TaskSystem(2))
+
+    location = LocationCreateArgs(path=str(loc_path)).create(library)
+    assert location is not None
+
+    job_id = await scan_location(library, location, mgr, backend="cpu")
+    await mgr.wait(job_id)
+    # chained jobs run after the first completes
+    for _ in range(50):
+        await mgr.wait_idle()
+        rows = library.db.query("SELECT status FROM job")
+        if len(rows) == 3 and all(r["status"] in (2, 6) for r in rows):
+            break
+    rows = library.db.query("SELECT name, status FROM job ORDER BY date_created")
+    assert [r["name"] for r in rows] == ["indexer", "file_identifier", "media_processor"]
+    assert all(r["status"] in (int(JobStatus.COMPLETED), int(JobStatus.COMPLETED_WITH_ERRORS)) for r in rows)
+
+    # indexed rows (.spacedrive marker is rule-rejected)
+    paths = library.db.query("SELECT * FROM file_path ORDER BY materialized_path, name")
+    rels = {(r["materialized_path"], r["name"], r["extension"]) for r in paths}
+    assert ("/", "big", "bin") in rels
+    assert ("/docs/", "a", "txt") in rels
+    assert not any(n == ".spacedrive" for _, n, _e in rels)
+
+    # cas ids match the reference algorithm; dup content = one object
+    a = library.db.find_one("file_path", name="a", extension="txt")
+    b = library.db.find_one("file_path", name="b", extension="txt")
+    big = library.db.find_one("file_path", name="big", extension="bin")
+    assert a["cas_id"] == cas_id_cpu(loc_path / "docs" / "a.txt")
+    assert big["cas_id"] == cas_id_cpu(loc_path / "big.bin")
+    assert a["cas_id"] == b["cas_id"]
+    assert a["object_id"] == b["object_id"] and a["object_id"] is not None
+    assert big["object_id"] != a["object_id"]
+
+    # empty file: no cas, no object (ref skips zero-size)
+    empty = library.db.find_one("file_path", name="empty", extension="txt")
+    assert empty["cas_id"] is None and empty["object_id"] is None
+
+    # dirs got size rollups
+    docs = library.db.find_one("file_path", name="docs", extension="")
+    assert blob_u64(docs["size_in_bytes_bytes"]) == 22
+
+    # media_data extracted for the png
+    png = library.db.find_one("file_path", name="red", extension="png")
+    assert png["object_id"] is not None
+    md = library.db.find_one("media_data", object_id=png["object_id"])
+    assert md is not None
+    import msgpack
+
+    assert msgpack.unpackb(md["resolution"]) == [32, 24]
+
+    # CRDT ops recorded for creates/updates
+    n_ops = library.db.count("crdt_operation")
+    assert n_ops > 0
+    kinds = {r["kind"] for r in library.db.query("SELECT DISTINCT kind FROM crdt_operation")}
+    assert "c" in kinds and any(k.startswith("u:") for k in kinds)
+
+    # location size rolled up
+    loc_row = library.db.find_one("location", id=location["id"])
+    assert blob_u64(loc_row["size_in_bytes"]) >= 300_000
+
+    await mgr.system.shutdown()
+    library.close()
+
+
+@pytest.mark.asyncio
+async def test_rescan_is_incremental(tree):
+    data_dir, loc_path = tree
+    libs = Libraries(data_dir)
+    library = libs.create("lib2")
+    mgr = JobManager(TaskSystem(2))
+    location = LocationCreateArgs(path=str(loc_path)).create(library)
+    job_id = await scan_location(library, location, mgr, backend="cpu")
+    await mgr.wait(job_id)
+    for _ in range(50):
+        await mgr.wait_idle()
+        rows = library.db.query("SELECT status FROM job")
+        if len(rows) == 3 and all(r["status"] in (2, 6) for r in rows):
+            break
+    first_count = library.db.count("file_path")
+    objects_before = library.db.count("object")
+
+    # add one file, rescan: only the new file is created, objects stable
+    (loc_path / "new.txt").write_bytes(b"fresh")
+    job_id2 = await scan_location(library, location, mgr, backend="cpu")
+    await mgr.wait(job_id2)
+    for _ in range(50):
+        await mgr.wait_idle()
+        rows = library.db.query("SELECT status FROM job")
+        if len(rows) == 6 and all(r["status"] in (2, 6) for r in rows):
+            break
+    assert library.db.count("file_path") == first_count + 1
+    new_row = library.db.find_one("file_path", name="new", extension="txt")
+    assert new_row["cas_id"] is not None
+    assert library.db.count("object") == objects_before + 1
+
+    # remove a file, rescan: row deleted
+    os.remove(loc_path / "docs" / "b.txt")
+    job_id3 = await scan_location(library, location, mgr, backend="cpu")
+    await mgr.wait(job_id3)
+    for _ in range(50):
+        await mgr.wait_idle()
+        rows = library.db.query("SELECT status FROM job")
+        if len(rows) == 9 and all(r["status"] in (2, 6) for r in rows):
+            break
+    assert library.db.find_one("file_path", name="b", extension="txt") is None
+    await mgr.system.shutdown()
+    library.close()
+
+
+def test_library_persistence(tmp_path):
+    libs = Libraries(tmp_path)
+    lib = libs.create("persist")
+    lib_id = lib.id
+    lib.db.insert("object", pub_id=uuid.uuid4().bytes, kind=5)
+    lib.close()
+    libs2 = Libraries(tmp_path)
+    loaded = libs2.load_all()
+    assert len(loaded) == 1 and loaded[0].id == lib_id
+    assert loaded[0].db.count("object") == 1
+    assert loaded[0].db.count("indexer_rule") == 4  # seeded system rules
+    loaded[0].close()

@@ -1,0 +1,226 @@
+"""Walker + rules tests — mirrors the reference's walk.rs test corpus
+(ref:core/src/location/indexer/walk.rs:721-1040: test_walk_without_rules,
+test_only_photos, test_git_repos, git_repos_without_deps_or_build_dirs)
+with the same temp-dir tree and injected (no-DB) fetchers."""
+
+import os
+
+import pytest
+
+from spacedrive_tpu.files.isolated_path import IsolatedFilePathData
+from spacedrive_tpu.location.indexer import walk
+from spacedrive_tpu.location.indexer.rules import (
+    GlobSet,
+    IndexerRule,
+    RuleKind,
+    RulePerKind,
+    no_git,
+    no_hidden,
+    only_images,
+    system_rules,
+)
+
+
+@pytest.fixture()
+def location(tmp_path):
+    """The reference's prepare_location() tree (ref:walk.rs:800-880)."""
+    root = tmp_path
+    (root / "rust_project" / ".git").mkdir(parents=True)
+    (root / "rust_project" / "src").mkdir()
+    (root / "rust_project" / "target" / "debug").mkdir(parents=True)
+    (root / "inner" / "node_project" / ".git").mkdir(parents=True)
+    (root / "inner" / "node_project" / "src").mkdir()
+    (root / "inner" / "node_project" / "node_modules" / "react").mkdir(parents=True)
+    (root / "photos").mkdir()
+
+    (root / "rust_project" / "Cargo.toml").touch()
+    (root / "rust_project" / "src" / "main.rs").touch()
+    (root / "rust_project" / "target" / "debug" / "main").touch()
+    (root / "inner" / "node_project" / "package.json").touch()
+    (root / "inner" / "node_project" / "src" / "App.tsx").touch()
+    (root / "inner" / "node_project" / "node_modules" / "react" / "readme.md").touch()
+    (root / "photos" / "photo1.png").touch()
+    (root / "photos" / "photo2.jpg").touch()
+    (root / "photos" / "photo3.jpeg").touch()
+    (root / "photos" / "text.txt").touch()
+    return root
+
+
+def run_walk(root, rules):
+    iso = lambda p, d: IsolatedFilePathData.new(1, root, p, d)  # noqa: E731
+    res = walk(
+        root=root,
+        indexer_rules=rules,
+        iso_file_path_factory=iso,
+        file_paths_db_fetcher=lambda isos: [],
+        to_remove_db_fetcher=lambda parent, isos: [],
+    )
+    assert not res.errors
+    return {e.iso_file_path.relative_path + ("/" if e.iso_file_path.is_dir else "") for e in res.walked}
+
+
+def test_walk_without_rules(location):
+    got = run_walk(str(location), [])
+    expected = {
+        "rust_project/", "rust_project/.git/", "rust_project/Cargo.toml",
+        "rust_project/src/", "rust_project/src/main.rs",
+        "rust_project/target/", "rust_project/target/debug/",
+        "rust_project/target/debug/main",
+        "inner/", "inner/node_project/", "inner/node_project/.git/",
+        "inner/node_project/package.json", "inner/node_project/src/",
+        "inner/node_project/src/App.tsx",
+        "inner/node_project/node_modules/",
+        "inner/node_project/node_modules/react/",
+        "inner/node_project/node_modules/react/readme.md",
+        "photos/", "photos/photo1.png", "photos/photo2.jpg",
+        "photos/photo3.jpeg", "photos/text.txt",
+    }
+    assert got == expected
+
+
+def test_only_photos(location):
+    # ancestor backfill keeps the containing dir (ref:walk.rs:866-874)
+    got = run_walk(str(location), [only_images()])
+    assert got == {
+        "photos/", "photos/photo1.png", "photos/photo2.jpg", "photos/photo3.jpeg"
+    }
+
+
+def test_git_repos(location):
+    """AcceptIfChildrenDirectoriesArePresent(.git) keeps only git repos'
+    contents (ref:walk.rs test_git_repos)."""
+    rule = IndexerRule(
+        "git repos",
+        [RulePerKind(RuleKind.ACCEPT_IF_CHILDREN_DIRECTORIES_ARE_PRESENT, [".git"])],
+    )
+    got = run_walk(str(location), [rule])
+    expected = {
+        "rust_project/", "rust_project/.git/", "rust_project/Cargo.toml",
+        "rust_project/src/", "rust_project/src/main.rs",
+        "rust_project/target/", "rust_project/target/debug/",
+        "rust_project/target/debug/main",
+        "inner/",  # ancestor backfill (ref:walk.rs:941)
+        "inner/node_project/", "inner/node_project/.git/",
+        "inner/node_project/package.json", "inner/node_project/src/",
+        "inner/node_project/src/App.tsx",
+        "inner/node_project/node_modules/",
+        "inner/node_project/node_modules/react/",
+        "inner/node_project/node_modules/react/readme.md",
+    }
+    assert got == expected
+
+
+def test_git_repos_without_deps_or_build_dirs(location):
+    rules = [
+        IndexerRule(
+            "git repos",
+            [RulePerKind(RuleKind.ACCEPT_IF_CHILDREN_DIRECTORIES_ARE_PRESENT, [".git"])],
+        ),
+        IndexerRule(
+            "no build dirs",
+            [
+                RulePerKind(
+                    RuleKind.REJECT_FILES_BY_GLOB,
+                    [
+                        "{**/node_modules/*,**/node_modules}",
+                        "{**/target/*,**/target}",
+                    ],
+                )
+            ],
+        ),
+        no_git(),
+    ]
+    got = run_walk(str(location), rules)
+    expected = {
+        "rust_project/", "rust_project/Cargo.toml",
+        "rust_project/src/", "rust_project/src/main.rs",
+        "inner/",
+        "inner/node_project/", "inner/node_project/package.json",
+        "inner/node_project/src/", "inner/node_project/src/App.tsx",
+    }
+    assert got == expected
+
+
+def test_no_hidden(location):
+    (location / ".hidden_dir").mkdir()
+    (location / ".hidden_dir" / "inside.txt").touch()
+    (location / ".secret").touch()
+    got = run_walk(str(location), [no_hidden()])
+    assert not any(".hidden_dir" in p or ".secret" in p or ".git" in p for p in got)
+    assert "photos/photo1.png" in got
+
+
+def test_limit_stops_early(location):
+    iso = lambda p, d: IsolatedFilePathData.new(1, str(location), p, d)  # noqa: E731
+    res = walk(
+        root=str(location),
+        indexer_rules=[],
+        iso_file_path_factory=iso,
+        file_paths_db_fetcher=lambda isos: [],
+        to_remove_db_fetcher=lambda parent, isos: [],
+        limit=3,
+    )
+    assert len(res.walked) >= 3
+    assert res.to_walk  # remaining dirs are handed back
+
+
+def test_update_detection(location):
+    """An existing DB row with a different inode/mtime lands in
+    to_update with its pub_id preserved (ref:walk.rs:370-411)."""
+    iso_factory = lambda p, d: IsolatedFilePathData.new(1, str(location), p, d)  # noqa: E731
+    target = location / "photos" / "photo1.png"
+    iso = iso_factory(str(target), False)
+
+    def fetcher(isos):
+        return [
+            {
+                "location_id": 1,
+                "pub_id": b"\x01" * 16,
+                "object_id": 7,
+                "inode": (999).to_bytes(8, "little"),
+                "hidden": 0,
+                "date_modified": "2000-01-01T00:00:00+00:00",
+                "size_in_bytes_bytes": (0).to_bytes(8, "little"),
+                "materialized_path": iso.materialized_path,
+                "name": iso.name,
+                "extension": iso.extension,
+                "is_dir": False,
+            }
+        ]
+
+    res = walk(
+        root=str(location),
+        indexer_rules=[],
+        iso_file_path_factory=iso_factory,
+        file_paths_db_fetcher=fetcher,
+        to_remove_db_fetcher=lambda parent, isos: [],
+    )
+    assert len(res.to_update) == 1
+    upd = res.to_update[0]
+    assert upd.pub_id == b"\x01" * 16 and upd.object_id == 7
+    assert all(w.iso_file_path != iso for w in res.walked)
+
+
+def test_glob_translator():
+    gs = GlobSet(["**/{.git,.gitignore}"])
+    assert gs.is_match("/a/b/.git")
+    # the pattern itself doesn't match dir contents — the walker prunes
+    # rejected dirs instead, so contents are never visited
+    assert not gs.is_match("/a/b/.git/config")
+    assert gs.is_match("/r/.gitignore")
+    assert not gs.is_match("/a/b/git")
+    only = GlobSet(["*.{jpg,png}"])
+    assert only.is_match("/deep/path/x.jpg")
+    assert not only.is_match("/deep/path/x.txt")
+    cls = GlobSet(["**/FOUND.[0-9][0-9][0-9]"])
+    assert cls.is_match("/x/FOUND.123")
+    assert not cls.is_match("/x/FOUND.12a")
+
+
+def test_rules_serialize_roundtrip():
+    for rule in system_rules():
+        raw = rule.serialize_rules()
+        back = IndexerRule.deserialize(rule.name, raw, rule.default, rule.pub_id)
+        assert [(r.kind, r.params) for r in back.rules] == [
+            (r.kind, r.params) for r in rule.rules
+        ]
