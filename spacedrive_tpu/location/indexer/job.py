@@ -67,6 +67,7 @@ class IndexerJob(StatefulJob):
             root = os.path.join(loc_path, self.init["sub_path"].lstrip("/"))
 
         self.data["location_id"] = loc_id
+        self.data["location_pub_id"] = location["pub_id"].hex()
         self.run_metadata.update(
             total_paths=0, updated_paths=0, removed_paths=0,
             scan_read_time=0.0, db_write_time=0.0, indexing_errors=0,
@@ -201,26 +202,42 @@ class IndexerJob(StatefulJob):
 
     def _save_batch(self, library, loc_id: int, entries: list[dict], update: bool) -> None:
         sync = library.sync
+        loc_pub = self.data["location_pub_id"]
         ops = []
         for e in entries:
-            values = [
-                ("is_dir", e["is_dir"]),
-                ("materialized_path", e["materialized_path"]),
-                ("name", e["name"]),
-                ("extension", e["extension"]),
-                ("hidden", e["hidden"]),
-                ("size_in_bytes_bytes", e["size"]),
-                ("inode", e["inode"]),
-                ("date_created", e["created_at"]),
-                ("date_modified", e["modified_at"]),
-            ]
             rid = e["pub_id"].hex()
             if update:
+                # only the fields the local UPDATE below mutates sync —
+                # identity fields (path/name/location) can't have changed
                 ops.extend(
-                    sync.shared_update("file_path", rid, f, v) for f, v in values
+                    sync.shared_update("file_path", rid, f, v)
+                    for f, v in [
+                        ("hidden", e["hidden"]),
+                        ("size_in_bytes_bytes", e["size"]),
+                        ("inode", e["inode"]),
+                        ("date_modified", e["modified_at"]),
+                    ]
                 )
             else:
-                ops.extend(sync.shared_create("file_path", rid, values))
+                ops.extend(
+                    sync.shared_create(
+                        "file_path", rid,
+                        [
+                            # FK columns sync as the target's sync id
+                            # (sync/apply.py)
+                            ("location_id", loc_pub),
+                            ("is_dir", e["is_dir"]),
+                            ("materialized_path", e["materialized_path"]),
+                            ("name", e["name"]),
+                            ("extension", e["extension"]),
+                            ("hidden", e["hidden"]),
+                            ("size_in_bytes_bytes", e["size"]),
+                            ("inode", e["inode"]),
+                            ("date_created", e["created_at"]),
+                            ("date_modified", e["modified_at"]),
+                        ],
+                    )
+                )
 
         date_indexed = now_iso()
 

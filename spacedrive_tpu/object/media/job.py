@@ -13,7 +13,6 @@ import logging
 import os
 from typing import Any
 
-from ...files.extensions import all_extensions
 from ...files.isolated_path import full_path_from_db_row as _full_path
 from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
@@ -24,12 +23,12 @@ logger = logging.getLogger(__name__)
 
 BATCH_SIZE = 10  # ref:media_processor/job.rs:50
 
-# extensions we can thumbnail / extract exif from (PIL-decodable subset
-# of the reference's FILTERED_IMAGE_EXTENSIONS)
-THUMBNAILABLE_EXTENSIONS = tuple(
-    e for e in all_extensions("Image")
-    if e in ("jpg", "jpeg", "png", "gif", "bmp", "tiff", "webp", "ico")
-)
+# extensions we can thumbnail / extract exif from (decodable subset of
+# the reference's FILTERED_{IMAGE,VIDEO}_EXTENSIONS; videos get a
+# keyframe thumb, ref:media_processor/job.rs + thumbnail/process.rs:463)
+from .thumbnail.process import IMAGE_EXTENSIONS, VIDEO_EXTENSIONS
+
+THUMBNAILABLE_EXTENSIONS = tuple(IMAGE_EXTENSIONS) + tuple(VIDEO_EXTENSIONS)
 EXIF_EXTENSIONS = ("jpg", "jpeg", "png", "tiff", "webp")
 
 
@@ -66,18 +65,21 @@ class MediaProcessorJob(StatefulJob):
         # (ref:job.rs:148-156); the job only awaits counts later.
         thumbnailer = getattr(getattr(library, "node", None), "thumbnailer", None)
         dispatched = 0
+        thumb_batch_id = 0
         if thumbnailer is not None and rows:
             loc_path = self.data["location_path"]
             batch = [
                 (r["cas_id"], _full_path(loc_path, r)) for r in rows
             ]
-            thumbnailer.new_indexed_thumbnails_batch(
+            thumb_batch_id = thumbnailer.new_indexed_thumbnails_batch(
                 library.id, batch, background=False
             )
             dispatched = len(batch)
         self.data["thumbs_dispatched"] = dispatched
 
-        exif_rows = [r for r in rows if (r["extension"] or "") in EXIF_EXTENSIONS]
+        exif_rows = [
+            r for r in rows if (r["extension"] or "").lower() in EXIF_EXTENSIONS
+        ]
         for i in range(0, len(exif_rows), BATCH_SIZE):
             chunk = exif_rows[i:i + BATCH_SIZE]
             self.steps.append(
@@ -87,16 +89,25 @@ class MediaProcessorJob(StatefulJob):
                 }
             )
         if dispatched:
-            self.steps.append({"kind": "wait_thumbnails", "count": dispatched})
+            self.steps.append(
+                {
+                    "kind": "wait_thumbnails",
+                    "count": dispatched,
+                    "batch_id": thumb_batch_id,
+                }
+            )
         labeler = getattr(getattr(library, "node", None), "image_labeler", None)
-        if labeler is not None and rows:
+        label_rows = [
+            r for r in rows if (r["extension"] or "").lower() in IMAGE_EXTENSIONS
+        ]
+        if labeler is not None and label_rows:
             loc_path = self.data["location_path"]
             batch_id = labeler.new_batch(
                 library,
                 [
                     {"file_path_id": r["id"], "object_id": r["object_id"],
                      "path": _full_path(loc_path, r)}
-                    for r in rows
+                    for r in label_rows
                 ],
             )
             self.steps.append({"kind": "wait_labels", "batch_id": batch_id})
@@ -146,10 +157,13 @@ class MediaProcessorJob(StatefulJob):
 
     async def _wait_thumbnails(self, ctx: JobContext, step: dict) -> StepResult:
         """Rendezvous with the thumbnailer actor (ref:job.rs:83-88
-        WaitThumbnails step)."""
+        WaitThumbnails step) — per dispatched batch, so unrelated
+        background thumbnail work can't stall this job. After a resume
+        the id is from a dead process; `wait_batch` treats unknown ids
+        as done (the actor re-queues persisted work on its own)."""
         thumbnailer = getattr(getattr(ctx.library, "node", None), "thumbnailer", None)
         if thumbnailer is not None:
-            await thumbnailer.wait_library_batch(ctx.library.id)
+            await thumbnailer.wait_batch(step.get("batch_id", 0))
         return StepResult()
 
     async def _wait_labels(self, ctx: JobContext, step: dict) -> StepResult:

@@ -1,0 +1,20 @@
+"""Node-level thumbnailer: TPU batch-resize pipeline behind an actor.
+
+Parity: ref:core/src/object/media/thumbnail/ — the node-wide actor
+outside the job system (actor.rs), priority LIFO foreground vs FIFO
+background queues + bounded background parallelism (process.rs:105-128),
+30s per-thumb timeout (process.rs:172), crash-resumable pending state
+(state.rs), sharded webp storage (shard.rs), versioned directory
+(directory.rs), and orphan cleanup (clean_up.rs).
+"""
+
+from .actor import Thumbnailer, ThumbKey
+from .store import ThumbnailStore, get_shard_hex
+
+__all__ = ["Thumbnailer", "ThumbKey", "ThumbnailStore", "get_shard_hex"]
+
+TARGET_PX = 262144  # ref:thumbnail/mod.rs:45
+WEBP_QUALITY = 30  # ref:thumbnail/mod.rs:49
+VIDEO_THUMB_SIZE = 256  # ref:thumbnail/process.rs:470
+GENERATION_TIMEOUT_S = 30  # ref:thumbnail/process.rs:172
+EPHEMERAL_DIR = "ephemeral"  # ref:thumbnail/mod.rs (EPHEMERAL_DIR)

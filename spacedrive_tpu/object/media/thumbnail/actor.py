@@ -1,0 +1,341 @@
+"""The node-wide thumbnailer actor.
+
+Parity: ref:core/src/object/media/thumbnail/{actor.rs,worker.rs,
+process.rs} — a node-global actor outside the job system; jobs dispatch
+batches and only await counts. Foreground batches are a priority LIFO
+stack, background a FIFO queue (state.rs:23-32); background work is
+throttled to `background_processing_percentage`% of cores
+(process.rs:105-128); each thumb gets a 30s timeout (process.rs:172);
+queues persist across crashes (state.rs); `NewThumbnail` events flow to
+the node event bus (ref:core/src/api/mod.rs:54).
+
+TPU shape: a batch is processed as [decode on host threads] →
+[ONE device resize call per size bucket] → [webp encode on host
+threads]; "pause/preempt" maps to batch-boundary draining, the leftover
+pattern the reference uses for its queues.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import itertools
+import logging
+import os
+import secrets
+from typing import Any, Sequence
+
+from .process import (
+    Decoded,
+    ThumbError,
+    can_generate,
+    decode,
+    finish,
+    needs_cpu_fallback,
+    resize_cpu,
+    resize_decoded,
+)
+from .state import Batch, load_state, save_state
+from .store import ThumbnailStore, get_shard_hex
+
+logger = logging.getLogger(__name__)
+
+GENERATION_TIMEOUT_S = 30  # ref:process.rs:172
+DEVICE_BATCH = 32  # images per device dispatch
+
+
+ThumbKey = tuple[str, str, str]  # (namespace, shard, cas_id)
+
+
+class Thumbnailer:
+    """`Node.thumbnailer` — see module docstring for the contract."""
+
+    def __init__(
+        self,
+        data_dir: str | os.PathLike,
+        event_bus: Any = None,
+        background_processing_percentage: int = 50,  # ref:actor.rs:98
+        use_device: bool = True,
+    ):
+        self.data_dir = os.fspath(data_dir)
+        os.makedirs(self.data_dir, exist_ok=True)
+        self.store = ThumbnailStore(self.data_dir)
+        self.event_bus = event_bus
+        self.use_device = use_device
+        cores = os.cpu_count() or 1
+        self._fg_parallelism = cores
+        self._bg_parallelism = max(
+            1, cores * max(0, min(100, background_processing_percentage)) // 100
+        )
+        self._fg: collections.deque[Batch] = collections.deque()  # LIFO
+        self._bg: collections.deque[Batch] = collections.deque()  # FIFO
+        self._current: Batch | None = None  # in-flight (for persistence)
+        # random base so a batch id persisted in a resumed job's state
+        # can't collide with a fresh id from this process
+        self._batch_ids = itertools.count((secrets.randbits(40) << 20) | 1)
+        self._batch_pending: collections.Counter[int] = collections.Counter()
+        self._pending: collections.Counter[str] = collections.Counter()
+        self._cond: asyncio.Condition | None = None
+        self._wake: asyncio.Event | None = None
+        self._worker: asyncio.Task | None = None
+        self._stopped = False
+        self.generated = 0
+        self.skipped = 0
+        self.errors = 0
+        # Crash recovery: previously queued batches resume as background,
+        # and are re-persisted at once so a second crash before the first
+        # batch completes still loses nothing (the load deleted the file).
+        for b in load_state(self.data_dir):
+            b.background = True
+            b.id = next(self._batch_ids)
+            self._bg.append(b)
+            self._pending[self._ns(b.library_id)] += len(b.entries)
+            self._batch_pending[b.id] = len(b.entries)
+        self._save()
+
+    # ---- lifecycle -----------------------------------------------------
+    def _ns(self, library_id: str | None) -> str:
+        return self.store.namespace(library_id)
+
+    def _save(self) -> None:
+        batches = list(self._fg) + list(self._bg)
+        if self._current is not None and self._current.entries:
+            batches.insert(0, self._current)
+        save_state(self.data_dir, batches)
+
+    def _ensure_started(self) -> None:
+        """Lazily bind to the running loop (actor model: one worker)."""
+        if self._stopped:
+            return
+        if self._worker is None or self._worker.done():
+            self._cond = self._cond or asyncio.Condition()
+            self._wake = self._wake or asyncio.Event()
+            self._worker = asyncio.get_running_loop().create_task(
+                self._run(), name="thumbnailer"
+            )
+            if self._fg or self._bg:
+                self._wake.set()
+
+    async def shutdown(self) -> None:
+        """Persist unprocessed batches (including the in-flight
+        remainder) and stop (ref:state.rs:47-75)."""
+        self._stopped = True
+        if self._wake is not None:
+            self._wake.set()
+        if self._worker is not None:
+            try:
+                await asyncio.wait_for(asyncio.shield(self._worker), timeout=60)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._worker.cancel()
+                try:
+                    await self._worker
+                except (asyncio.CancelledError, Exception):
+                    pass
+        self._save()
+        # unblock rendezvous waiters: with the actor stopped their work
+        # will never drain, and hanging a job forever is worse
+        if self._cond is not None:
+            async with self._cond:
+                self._cond.notify_all()
+
+    # ---- dispatch API (ref:actor.rs new_*_thumbnails_batch) ------------
+    def new_indexed_thumbnails_batch(
+        self,
+        library_id: str,
+        entries: Sequence[tuple[str, str] | tuple[str, str, str]],
+        background: bool = False,
+    ) -> int:
+        """entries: (cas_id, path[, extension]); returns a batch id for
+        `wait_batch`, or 0 if nothing was queued."""
+        return self._enqueue(library_id, entries, background)
+
+    def new_ephemeral_thumbnails_batch(
+        self, entries: Sequence[tuple[str, str] | tuple[str, str, str]]
+    ) -> int:
+        return self._enqueue(None, entries, background=False)
+
+    def _enqueue(self, library_id, entries, background) -> int:
+        library_id = str(library_id) if library_id is not None else None
+        norm: list[tuple[str, str, str]] = []
+        for e in entries:
+            cas_id, path = e[0], e[1]
+            ext = (
+                e[2]
+                if len(e) > 2
+                else os.path.splitext(path)[1].lstrip(".").lower()
+            )
+            if not cas_id or not can_generate(ext):
+                continue
+            if self.store.exists(library_id, cas_id):
+                self.skipped += 1
+                continue
+            norm.append((cas_id, path, ext))
+        if not norm:
+            return 0
+        batch = Batch(library_id=library_id, entries=norm, background=background)
+        batch.id = next(self._batch_ids)
+        if background:
+            self._bg.append(batch)
+        else:
+            self._fg.appendleft(batch)  # LIFO priority stack
+        self._pending[self._ns(library_id)] += len(norm)
+        self._batch_pending[batch.id] = len(norm)
+        self._save()
+        try:
+            self._ensure_started()
+            assert self._wake is not None
+            self._wake.set()
+        except RuntimeError:
+            pass  # no running loop yet; started on first await
+        return batch.id
+
+    def delete_thumbnails(self, library_id: str | None, cas_ids: list[str]) -> int:
+        return self.store.remove(library_id, cas_ids)
+
+    # ---- rendezvous (ref:job.rs WaitThumbnails) ------------------------
+    async def wait_batch(self, batch_id: int) -> None:
+        """Wait for one dispatched batch (ids are per-process; an
+        unknown/finished id — e.g. after an actor restart — is done)."""
+        if batch_id <= 0:
+            return
+        self._ensure_started()
+        assert self._cond is not None
+        async with self._cond:
+            await self._cond.wait_for(
+                lambda: self._stopped or self._batch_pending[batch_id] == 0
+            )
+
+    async def wait_library_batch(self, library_id: str | None) -> None:
+        """Wait for a whole namespace to drain (coarser than
+        `wait_batch`; unrelated background work counts too)."""
+        self._ensure_started()
+        ns = self._ns(library_id)
+        assert self._cond is not None
+        async with self._cond:
+            await self._cond.wait_for(
+                lambda: self._stopped or self._pending[ns] == 0
+            )
+
+    def pending_count(self, library_id: str | None) -> int:
+        return self._pending[self._ns(library_id)]
+
+    # ---- worker --------------------------------------------------------
+    async def _run(self) -> None:
+        assert self._wake is not None and self._cond is not None
+        while not self._stopped:
+            if not self._fg and not self._bg:
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    continue
+            if self._stopped:
+                break
+            if self._fg:
+                batch = self._fg.popleft()
+            elif self._bg:
+                batch = self._bg.popleft()
+            else:
+                continue
+            self._current = batch
+            try:
+                await self._process_batch(batch)
+            except asyncio.CancelledError:
+                # shutdown cancelled us mid-batch: requeue the remainder
+                # so shutdown's _save persists it (waiters unblock via
+                # the _stopped clause in their predicates)
+                self._current = None
+                if batch.entries:
+                    self._fg.appendleft(batch)
+                raise
+            except Exception:
+                logger.exception("thumbnail batch failed")
+                self.errors += len(batch.entries)
+                await self._account(batch, len(batch.entries))
+                batch.entries = []
+            self._current = None
+            if batch.entries:
+                # drained early because _stopped flipped mid-batch
+                self._fg.appendleft(batch)
+            self._save()
+
+    async def _account(self, batch: Batch, n: int) -> None:
+        assert self._cond is not None
+        async with self._cond:
+            self._pending[self._ns(batch.library_id)] -= n
+            self._batch_pending[batch.id] -= n
+            if self._batch_pending[batch.id] <= 0:
+                del self._batch_pending[batch.id]
+            self._cond.notify_all()
+
+    async def _process_batch(self, batch: Batch) -> None:
+        parallelism = (
+            self._bg_parallelism if batch.background else self._fg_parallelism
+        )
+        sem = asyncio.Semaphore(parallelism)
+
+        async def _decode(entry: tuple[str, str, str]) -> Decoded | None:
+            cas_id, path, ext = entry
+            async with sem:
+                try:
+                    return await asyncio.wait_for(
+                        asyncio.to_thread(decode, path, ext),
+                        timeout=GENERATION_TIMEOUT_S,
+                    )
+                except (ThumbError, asyncio.TimeoutError, OSError) as e:
+                    logger.debug("thumb decode failed %s: %s", path, e)
+                    return None
+
+        while batch.entries and not self._stopped:
+            chunk = batch.entries[:DEVICE_BATCH]
+            decoded = await asyncio.gather(*(_decode(e) for e in chunk))
+            device_idx: list[int] = []
+            for i, d in enumerate(decoded):
+                if d is None:
+                    self.errors += 1
+                elif not self.use_device or needs_cpu_fallback(d):
+                    # host-path stragglers (extreme aspect / no device)
+                    try:
+                        webp = await asyncio.wait_for(
+                            asyncio.to_thread(resize_cpu, d),
+                            timeout=GENERATION_TIMEOUT_S,
+                        )
+                        self._store_one(batch.library_id, chunk[i][0], webp)
+                    except Exception:
+                        self.errors += 1
+                else:
+                    device_idx.append(i)
+            if device_idx:
+                ds = [decoded[i] for i in device_idx]
+                try:
+                    resized = await asyncio.to_thread(resize_decoded, ds)
+                    webps = await asyncio.gather(
+                        *(
+                            asyncio.to_thread(finish, d, r)
+                            for d, r in zip(ds, resized)
+                        )
+                    )
+                    for i, webp in zip(device_idx, webps):
+                        self._store_one(batch.library_id, chunk[i][0], webp)
+                except Exception:
+                    logger.exception("device resize batch failed")
+                    self.errors += len(device_idx)
+            # consume as we go: the crash/error accounting and the
+            # persisted resume state only ever see the remainder
+            batch.entries = batch.entries[len(chunk):]
+            await self._account(batch, len(chunk))
+
+    def _store_one(self, library_id: str | None, cas_id: str, webp: bytes) -> None:
+        self.store.write(library_id, cas_id, webp)
+        self.generated += 1
+        if self.event_bus is not None:
+            self.event_bus.emit(
+                {
+                    "type": "NewThumbnail",
+                    "thumb_key": (
+                        self._ns(library_id),
+                        get_shard_hex(cas_id),
+                        cas_id,
+                    ),
+                }
+            )

@@ -1,0 +1,197 @@
+"""Thumbnail generation pipeline: CPU decode → TPU batch resize → webp.
+
+Parity: ref:core/src/object/media/thumbnail/process.rs:394-473
+(`generate_image_thumbnail` / `generate_video_thumbnail`) and
+ref:crates/ffmpeg/src/movie_decoder.rs (video: preferred stream, seek
+~10%, decode one frame, rotation-aware scale).
+
+The TPU-first difference from the reference: decode stays on host
+threads, but *all* resampling runs as batched `scale_and_translate`
+device calls (spacedrive_tpu/ops/thumbnail_jax.py) — one compiled
+program per size bucket instead of a per-image CPU resize pool.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import math
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ....ops import thumbnail_jax as tj
+
+logger = logging.getLogger(__name__)
+
+WEBP_QUALITY = 30  # ref:process.rs:440
+MAX_FILE_SIZE = 192 * 1024 * 1024  # ref:crates/images/src/consts.rs:9
+MAX_DIM = 4096  # ref:crates/images/src/consts.rs:33
+
+# Decodable subsets of the taxonomy (the taxonomy stays the single
+# source of truth, ref:crates/file-ext; the reference fans out to the
+# `image` crate / libheif / resvg / pdfium by extension,
+# ref:crates/images/src/handler.rs:18-60 — HEIF/PDF need their own
+# decoders and are gated out here until a native frontend lands).
+from ....files.extensions import all_extensions as _all_extensions
+
+_PIL_DECODABLE = {
+    "jpg", "jpeg", "png", "gif", "bmp", "tiff", "tif", "webp", "ico",
+    "apng",
+}
+_CV2_DECODABLE = {
+    "mp4", "mov", "avi", "mkv", "webm", "m4v", "mpg", "mpeg", "mpe",
+    "wmv", "flv", "3gp", "ogv", "mts", "m2ts", "m2v", "ts", "vob", "qt",
+}
+IMAGE_EXTENSIONS = tuple(
+    e for e in _all_extensions("Image") if e in _PIL_DECODABLE
+)
+VIDEO_EXTENSIONS = tuple(
+    e for e in _all_extensions("Video") if e in _CV2_DECODABLE
+)
+VIDEO_SEEK_FRACTION = 0.1  # ref:movie_decoder.rs seeks ~10% in
+
+
+class ThumbError(Exception):
+    pass
+
+
+@dataclass
+class Decoded:
+    """One decoded frame ready for the device batch."""
+    array: np.ndarray  # HxWx4 uint8 RGBA
+    target: tuple[int, int]  # (th, tw) scaled dims
+    orientation: int = 1
+
+
+def can_generate(extension: str | None) -> bool:
+    e = (extension or "").lower()
+    return e in IMAGE_EXTENSIONS or e in VIDEO_EXTENSIONS
+
+
+def is_video(extension: str | None) -> bool:
+    return (extension or "").lower() in VIDEO_EXTENSIONS
+
+
+def decode_image(path: str) -> Decoded:
+    """Decode a still image to RGBA, reading EXIF orientation.
+
+    Uses JPEG draft-mode DCT scaling so huge photos decode near the
+    target size instead of full-res (the decode-side analogue of the
+    reference's resize-after-full-decode; output parity is held by the
+    device resample, which always produces `scale_dimensions` dims).
+    """
+    from PIL import Image
+
+    if os.path.getsize(path) > MAX_FILE_SIZE:
+        raise ThumbError(f"file over {MAX_FILE_SIZE} bytes: {path}")
+    with Image.open(path) as img:
+        w0, h0 = img.size
+        tw, th = tj.scale_dimensions(w0, h0)
+        orientation = 1
+        try:
+            orientation = int(img.getexif().get(0x0112, 1) or 1)
+        except Exception:
+            pass
+        if img.format == "JPEG":
+            img.draft("RGB", (tw, th))  # smallest DCT scale ≥ target
+        img = img.convert("RGBA")
+        arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    if max(h, w) > MAX_DIM:
+        # Pre-shrink oversized decodes so they fit the largest bucket
+        # (the reference rejects >4096² outright; we degrade instead).
+        step = math.ceil(max(h, w) / MAX_DIM)
+        arr = arr[::step, ::step]
+        h, w = arr.shape[:2]
+    if min(h, w) < 1:
+        raise ThumbError(f"empty image: {path}")
+    return Decoded(array=arr, target=(th, tw), orientation=orientation)
+
+
+def needs_cpu_fallback(d: Decoded) -> bool:
+    """Targets beyond the device output canvas (aspect > 4:1) resize on
+    host instead of the batched device path."""
+    th, tw = d.target
+    return th > tj.OUT_CANVAS or tw > tj.OUT_CANVAS or max(
+        d.array.shape[:2]
+    ) > tj.BUCKETS[-1]
+
+
+def decode_video_frame(path: str) -> Decoded:
+    """Grab one frame ~10% into the video (ref:movie_decoder.rs:32-629:
+    open → preferred stream → seek 10% → decode; rotation handled by
+    the decoder). Target dims bound the max dimension to 256
+    (ref:process.rs:470)."""
+    try:
+        import cv2
+    except Exception as e:  # pragma: no cover
+        raise ThumbError(f"video decode unavailable: {e}")
+    cap = cv2.VideoCapture(path)
+    try:
+        if not cap.isOpened():
+            raise ThumbError(f"cannot open video: {path}")
+        frames = cap.get(cv2.CAP_PROP_FRAME_COUNT) or 0
+        if frames > 0:
+            cap.set(cv2.CAP_PROP_POS_FRAMES, int(frames * VIDEO_SEEK_FRACTION))
+        ok, frame = cap.read()
+        if not ok:
+            # fall back to the first frame (seek can fail near EOF)
+            cap.set(cv2.CAP_PROP_POS_FRAMES, 0)
+            ok, frame = cap.read()
+        if not ok or frame is None:
+            raise ThumbError(f"no decodable frame: {path}")
+    finally:
+        cap.release()
+    rgb = frame[:, :, ::-1]  # BGR → RGB
+    h, w = rgb.shape[:2]
+    if max(h, w) > MAX_DIM:
+        step = math.ceil(max(h, w) / MAX_DIM)
+        rgb = rgb[::step, ::step]
+        h, w = rgb.shape[:2]
+    arr = np.dstack([rgb, np.full((h, w, 1), 255, np.uint8)])
+    tw, th = tj.video_dimensions(w, h)
+    return Decoded(array=np.ascontiguousarray(arr), target=(th, tw))
+
+
+def decode(path: str, extension: str | None) -> Decoded:
+    if is_video(extension):
+        return decode_video_frame(path)
+    return decode_image(path)
+
+
+def encode_webp(arr: np.ndarray, quality: int = WEBP_QUALITY) -> bytes:
+    """RGBA uint8 → webp bytes at the reference's quality 30
+    (ref:process.rs:431-440)."""
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr, "RGBA").save(buf, "WEBP", quality=quality)
+    return buf.getvalue()
+
+
+def finish(decoded: Decoded, resized: np.ndarray) -> bytes:
+    """Orientation-correct the device output and encode."""
+    arr = tj.apply_orientation(resized, decoded.orientation)
+    return encode_webp(np.ascontiguousarray(arr))
+
+
+def resize_decoded(batch: list[Decoded]) -> list[np.ndarray]:
+    """One (or few, per bucket) device calls for a whole decoded batch."""
+    return tj.resize_batch([d.array for d in batch], [d.target for d in batch])
+
+
+def resize_cpu(d: Decoded) -> bytes:
+    """Pure-CPU fallback path (extreme aspect ratios / no device): PIL
+    resize with the same Triangle filter + quality."""
+    from PIL import Image
+
+    th, tw = d.target
+    img = Image.fromarray(d.array, "RGBA").resize((tw, th), Image.BILINEAR)
+    arr = tj.apply_orientation(np.asarray(img), d.orientation)
+    return encode_webp(np.ascontiguousarray(arr))
+
+
+def generate_one_cpu(path: str, extension: str | None) -> bytes:
+    return resize_cpu(decode(path, extension))

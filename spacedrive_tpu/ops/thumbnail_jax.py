@@ -1,0 +1,169 @@
+"""Batched thumbnail resize on TPU.
+
+Parity targets (behavior, not implementation):
+- ref:core/src/object/media/thumbnail/process.rs:394-461 — decode →
+  `scale_dimensions` to TARGET_PX=262144 (≈512²) → Triangle-filter
+  resize → EXIF-orientation correction → webp quality 30.
+- ref:crates/images/src/lib.rs:89 — `scale_dimensions` keeps aspect and
+  makes w*h ≈ target_px.
+- ref:crates/ffmpeg/src/lib.rs:20-33 — video thumbs bound the max
+  dimension to 256 instead.
+
+TPU-first design. The reference resizes one image at a time on a CPU
+pool. Here, decoded images are padded into a small set of square size
+*buckets* (bounded XLA compile shapes) and a whole batch is resized in
+ONE device call per bucket via `jax.image.scale_and_translate`, vmapped
+with *per-image* scale factors as traced arguments — so a single
+compiled program handles arbitrary (h, w) inputs inside a bucket. XLA
+lowers separable scale_and_translate to two weight matmuls per image,
+which ride the MXU; `antialias=True` + `method="triangle"` is exactly
+the reference's Triangle filter for downscale. Crop to the per-image
+target dims, orientation flips, and webp encode stay on host (cheap,
+variable-shape).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Sequence
+
+import numpy as np
+
+TARGET_PX = 262144  # ref:core/src/object/media/thumbnail/mod.rs:45
+WEBP_QUALITY = 30  # ref:thumbnail/mod.rs:49
+VIDEO_MAX_DIM = 256  # ref:thumbnail/process.rs:470
+
+# Square input buckets (images are padded up to the next one). 4096 is
+# the reference's max decodable dimension (ref:crates/images/src/consts.rs:33).
+BUCKETS = (256, 512, 1024, 2048, 4096)
+# Output canvas: covers aspect ratios up to 4:1 at TARGET_PX
+# (tw = sqrt(262144·4) = 1024); more extreme aspects fall back to CPU.
+OUT_CANVAS = 1024
+MAX_ASPECT = (OUT_CANVAS * OUT_CANVAS) / TARGET_PX  # 4.0
+
+
+def scale_dimensions(w: int, h: int, target_px: int = TARGET_PX) -> tuple[int, int]:
+    """Aspect-preserving dims with w*h ≈ target_px; never upscales.
+
+    Parity: ref:crates/images/src/lib.rs:89 (`scale_dimensions`).
+    """
+    if w * h <= target_px:
+        return w, h
+    ratio = math.sqrt(target_px / (w * h))
+    return max(1, round(w * ratio)), max(1, round(h * ratio))
+
+
+def video_dimensions(w: int, h: int, max_dim: int = VIDEO_MAX_DIM) -> tuple[int, int]:
+    """Bound the max dimension (video thumbs, ref:sd_ffmpeg size=256)."""
+    if max(w, h) <= max_dim:
+        return w, h
+    ratio = max_dim / max(w, h)
+    return max(1, round(w * ratio)), max(1, round(h * ratio))
+
+
+def bucket_for(h: int, w: int) -> int | None:
+    """Smallest square bucket holding (h, w); None if over the cap."""
+    m = max(h, w)
+    for b in BUCKETS:
+        if m <= b:
+            return b
+    return None
+
+
+@functools.cache
+def _resize_fn():
+    """Lazily built jitted bucket-resize (jax imported on first use)."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("out_size",))
+    def resize_bucket(canvases, scales, out_size: int):
+        # [B, S, S, 4] uint8 RGBA canvases + per-image [B, 2] (sy, sx)
+        # scales → [B, OUT, OUT, 4] uint8, resized into the top-left
+        # corner. One compiled program per (bucket, out) pair; the
+        # per-image scale is a traced operand, so every (h, w) in the
+        # bucket reuses it.
+        def one(img, scale):
+            out = jax.image.scale_and_translate(
+                img.astype(jnp.float32),
+                shape=(out_size, out_size, 4),
+                spatial_dims=(0, 1),
+                scale=scale,
+                translation=jnp.zeros((2,), jnp.float32),
+                method="triangle",
+                antialias=True,
+            )
+            return jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+
+        return jax.vmap(one)(canvases, scales)
+
+    return resize_bucket
+
+
+def resize_batch(
+    images: Sequence[np.ndarray],
+    targets: Sequence[tuple[int, int]],
+    out_size: int = OUT_CANVAS,
+) -> list[np.ndarray]:
+    """Resize a batch of HxWx4 uint8 RGBA images to per-image (th, tw).
+
+    Groups by input bucket, pads to the bucket canvas, runs one device
+    call per bucket, crops on host. Returns resized uint8 arrays in
+    input order. Images too large for any bucket or with th/tw beyond
+    the output canvas must be filtered by the caller beforehand.
+    """
+    results: list[np.ndarray | None] = [None] * len(images)
+    by_bucket: dict[int, list[int]] = {}
+    for i, img in enumerate(images):
+        h, w = img.shape[:2]
+        b = bucket_for(h, w)
+        if b is None:
+            raise ValueError(f"image {i} ({h}x{w}) exceeds max bucket")
+        by_bucket.setdefault(b, []).append(i)
+
+    for b, idxs in by_bucket.items():
+        # Pad the batch dim to the next power of two so compile count is
+        # bounded at (buckets × log2 max-batch) programs, not one per
+        # arbitrary group size.
+        bpad = 1 << max(0, (len(idxs) - 1).bit_length())
+        canv = np.zeros((bpad, b, b, 4), np.uint8)
+        scales = np.ones((bpad, 2), np.float32)
+        for j, i in enumerate(idxs):
+            img = images[i]
+            h, w = img.shape[:2]
+            th, tw = targets[i][0], targets[i][1]
+            # Edge-replicate into the padding so the antialias window
+            # clamps at the image boundary instead of pulling in zeros
+            # (the reference resampler clamps at edges too).
+            canv[j, :h, :w] = img
+            canv[j, h:, :w] = img[h - 1 : h, :]
+            canv[j, :h, w:] = img[:, w - 1 : w]
+            canv[j, h:, w:] = img[h - 1, w - 1]
+            scales[j] = (th / h, tw / w)
+        out = np.asarray(_resize_fn()(canv, scales, out_size=out_size))
+        for j, i in enumerate(idxs):
+            th, tw = targets[i]
+            results[i] = out[j, :th, :tw]
+    return results  # type: ignore[return-value]
+
+
+def apply_orientation(arr: np.ndarray, orientation: int) -> np.ndarray:
+    """EXIF orientation 1-8 → corrected array (host, zero-copy views
+    where possible). Parity: ref:crates/media-metadata/src/image/
+    orientation.rs applied post-resize (process.rs:421-428)."""
+    if orientation == 2:
+        return arr[:, ::-1]
+    if orientation == 3:
+        return arr[::-1, ::-1]
+    if orientation == 4:
+        return arr[::-1]
+    if orientation == 5:
+        return np.transpose(arr, (1, 0, 2))
+    if orientation == 6:
+        return np.transpose(arr[::-1], (1, 0, 2))
+    if orientation == 7:
+        return np.transpose(arr[::-1, ::-1], (1, 0, 2))
+    if orientation == 8:
+        return np.transpose(arr[:, ::-1], (1, 0, 2))
+    return arr

@@ -1,0 +1,286 @@
+"""Sync ingest actor — applying remote op streams.
+
+Parity: ref:core/crates/sync/src/ingest.rs — a per-library actor with
+the state machine WaitingForNotification → RetrievingMessages →
+Ingesting (:49-93); `receive_crdt_operation` merges the remote HLC
+timestamp, rejects old ops per (model, record, field) LWW, applies the
+op and stores it in one transaction (:120-166); `is_operation_old`
+(:169-192) consults the stored op log. Backfill parity:
+ref:core/crates/sync/src/backfill.rs (generate ops for rows that
+predate sync).
+
+The transport is injected: `request_ops(timestamps, count)` is any
+async callable — loopback queues in tests, the P2P sync exchange or the
+cloud relay in production (ref:core/src/p2p/sync/mod.rs:22-70).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import uuid
+from typing import Any, Awaitable, Callable, Iterable
+
+from .apply import apply_op
+from .crdt import CRDTOperation, DELETE
+from .hlc import NTP64
+from .manager import SyncManager, _record_id_blob
+
+logger = logging.getLogger(__name__)
+
+OPS_PER_REQUEST = 1000  # ref:core/src/cloud/sync/ingest.rs:21
+
+# request_ops(timestamps, count) -> (ops, has_more)
+RequestOps = Callable[
+    [list[tuple[uuid.UUID, NTP64]], int],
+    Awaitable[tuple[list[CRDTOperation], bool]],
+]
+
+
+class State(enum.Enum):
+    WAITING_FOR_NOTIFICATION = "waiting"
+    RETRIEVING_MESSAGES = "retrieving"
+    INGESTING = "ingesting"
+
+
+def is_operation_old(sync: SyncManager, op: CRDTOperation) -> bool:
+    """True if a stored op for the same (model, record) supersedes
+    `op` — same-field update or any delete with a newer-or-equal
+    timestamp (ref:ingest.rs:169-192)."""
+    rows = sync.db.query(
+        "SELECT kind, timestamp FROM crdt_operation "
+        "WHERE model = ? AND record_id = ? AND timestamp >= ? "
+        "ORDER BY timestamp DESC",
+        (op.model, _record_id_blob(op.record_id), int(op.timestamp)),
+    )
+    mine = op.kind()
+    for row in rows:
+        if NTP64(row["timestamp"]) == op.timestamp and row["kind"] == mine:
+            continue  # our own echo (same instance round trip)
+        if row["kind"] == DELETE or row["kind"] == mine:
+            return True
+    return False
+
+
+def receive_crdt_operation(sync: SyncManager, op: CRDTOperation) -> bool:
+    """Merge clock, LWW-check, apply + store atomically; returns True if
+    the op was applied (ref:ingest.rs:120-166)."""
+    sync.clock.update(op.timestamp)
+
+    applied = False
+    if not is_operation_old(sync, op):
+        iid = _ensure_instance(sync, op.instance)
+        with sync.db.transaction() as conn:
+            apply_op(conn, op)
+            conn.execute(
+                "INSERT OR REPLACE INTO crdt_operation "
+                "(id, timestamp, model, record_id, kind, data, instance_id) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (
+                    op.id.bytes,
+                    int(op.timestamp),
+                    op.model,
+                    _record_id_blob(op.record_id),
+                    op.kind(),
+                    op.pack(),
+                    iid,
+                ),
+            )
+        applied = True
+
+    # watermark advances even for rejected-old ops: they're *seen*
+    current = sync.timestamps.get(op.instance, NTP64(0))
+    if op.timestamp > current:
+        sync.timestamps[op.instance] = op.timestamp
+    return applied
+
+
+def _ensure_instance(sync: SyncManager, instance: uuid.UUID) -> int:
+    row = sync.db.find_one("instance", pub_id=instance.bytes)
+    if row is not None:
+        return row["id"]
+    # unseen originator: record a placeholder instance row (the library
+    # pairing flow fills in identity/metadata later)
+    from ..db.database import now_iso
+
+    now = now_iso()
+    return sync.db.insert(
+        "instance", pub_id=instance.bytes, identity=b"", node_id=b"",
+        node_name="", node_platform=0, last_seen=now, date_created=now,
+    )
+
+
+class IngestActor:
+    """One per library; drives the pull side of sync."""
+
+    def __init__(
+        self,
+        sync: SyncManager,
+        request_ops: RequestOps,
+        ops_per_request: int = OPS_PER_REQUEST,
+    ):
+        self.sync = sync
+        self.request_ops = request_ops
+        self.ops_per_request = ops_per_request
+        self.state = State.WAITING_FOR_NOTIFICATION
+        self.applied = 0
+        self.rejected = 0
+        self._notify = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+        self._idle = asyncio.Event()
+        self._idle.set()
+
+    # --- actor API (ref:ingest.rs Event::Notification) ---
+    def notify(self) -> None:
+        self._notify.set()
+        self._ensure_started()
+
+    def _ensure_started(self) -> None:
+        if self._stopped:
+            return
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="sync-ingest"
+            )
+
+    async def stop(self) -> None:
+        self._stopped = True
+        self._notify.set()
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, timeout=10)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._task.cancel()
+
+    async def wait_idle(self) -> None:
+        """Settle: no notification pending and the tick loop is parked."""
+        self._ensure_started()
+        while not self._idle.is_set() or self._notify.is_set():
+            await self._idle.wait()
+            if self._notify.is_set():
+                # notification not yet picked up by the loop; yield
+                await asyncio.sleep(0.01)
+
+    # --- state machine (ref:ingest.rs:49-93) ---
+    async def _run(self) -> None:
+        while not self._stopped:
+            self.state = State.WAITING_FOR_NOTIFICATION
+            try:
+                await asyncio.wait_for(self._notify.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                continue
+            if self._stopped:
+                break
+            self._notify.clear()
+            self._idle.clear()
+            try:
+                await self._tick()
+            except Exception:
+                logger.exception("sync ingest tick failed")
+            finally:
+                self._idle.set()
+
+    async def _tick(self) -> None:
+        while not self._stopped:
+            self.state = State.RETRIEVING_MESSAGES
+            timestamps = list(self.sync.timestamps.items())
+            ops, has_more = await self.request_ops(
+                timestamps, self.ops_per_request
+            )
+            self.state = State.INGESTING
+            for op in ops:
+                if receive_crdt_operation(self.sync, op):
+                    self.applied += 1
+                else:
+                    self.rejected += 1
+            if ops and self.sync.event_bus is not None:
+                self.sync.event_bus.emit(("SyncMessage", "Ingested"))
+            if not has_more:
+                break
+
+
+# --- backfill (ref:core/crates/sync/src/backfill.rs) ---------------------
+
+def backfill_operations(sync: SyncManager) -> int:
+    """Emit create+update ops for every syncable row that has no op log
+    yet (a library that predates sync, or was seeded directly). Returns
+    the number of ops written."""
+    from ..db.sync_registry import SYNC_MODELS, SyncKind
+
+    ops: list[CRDTOperation] = []
+    for model in SYNC_MODELS.values():
+        if model.kind is SyncKind.LOCAL:
+            continue
+        for row in sync.db.query(f"SELECT * FROM {model.name}"):
+            record_id = _row_sync_id(sync, model, row)
+            if record_id is None:
+                continue
+            if sync.db.query_one(
+                "SELECT 1 FROM crdt_operation WHERE model = ? AND record_id = ?",
+                (model.name, _record_id_blob(record_id)),
+            ):
+                continue
+            values = _row_sync_values(sync, model, row)
+            if model.kind is SyncKind.SHARED:
+                ops.extend(sync.shared_create(model.name, record_id, values))
+            else:
+                ops.extend(sync.relation_create(model.name, record_id, values))
+    if ops:
+        sync.write_ops(ops)
+    return len(ops)
+
+
+def _row_sync_id(sync: SyncManager, model, row) -> Any:
+    from ..db.sync_registry import SyncKind
+
+    if model.kind is SyncKind.RELATION:
+        item = _fk_sync_id(sync, model.item, row[model.item.column])
+        group = _fk_sync_id(sync, model.group, row[model.group.column])
+        if item is None or group is None:
+            return None
+        return {"item": item, "group": group}
+    if model.id_ref is not None:
+        return _fk_sync_id(sync, model.id_ref, row[model.id_ref.column])
+    v = row[model.id_field]
+    if v is None:
+        return None
+    return v.hex() if isinstance(v, (bytes, bytearray)) else v
+
+
+def _fk_sync_id(sync: SyncManager, fr, local_id) -> Any:
+    if local_id is None:
+        return None
+    target = sync.db.find_one(fr.table, id=local_id)
+    if target is None:
+        return None
+    v = target[fr.target_id_field]
+    return v.hex() if isinstance(v, (bytes, bytearray)) else v
+
+
+def _row_sync_values(sync: SyncManager, model, row) -> list[tuple[str, Any]]:
+    """Synced (field, wire-value) pairs for a backfilled row."""
+    from ..db.database import blob_u64
+    from .apply import _U64_COLUMNS
+
+    skip = {"id", model.id_field, *(model.local_fields or ())}
+    if model.kind.name == "RELATION":
+        skip |= {model.item.column, model.group.column}
+    fk_cols = {fr.column: fr for fr in model.foreign_refs}
+    if model.id_ref is not None:
+        skip.add(model.id_ref.column)
+    values = []
+    for col, v in row.items():
+        if col in skip or v is None:
+            continue
+        if col in fk_cols:
+            v = _fk_sync_id(sync, fk_cols[col], v)
+            if v is None:
+                continue
+        elif col in _U64_COLUMNS.get(model.name, ()):
+            v = blob_u64(v)
+        elif isinstance(v, (bytes, bytearray)):
+            v = bytes(v).hex() if col == "pub_id" else bytes(v)
+        values.append((col, v))
+    return values

@@ -34,8 +34,17 @@ def tree(tmp_path):
 
 @pytest.mark.asyncio
 async def test_full_scan_chain(tree):
+    from spacedrive_tpu.object.media.thumbnail import Thumbnailer
+
     data_dir, loc_path = tree
-    libs = Libraries(data_dir)
+
+    class _Node:  # minimal node stub until the full Node lands
+        pass
+
+    node = _Node()
+    node.thumbnailer = Thumbnailer(data_dir)
+    node.image_labeler = None
+    libs = Libraries(data_dir, node=node)
     library = libs.create("test-lib")
     mgr = JobManager(TaskSystem(2))
 
@@ -78,6 +87,14 @@ async def test_full_scan_chain(tree):
     # dirs got size rollups
     docs = library.db.find_one("file_path", name="docs", extension="")
     assert blob_u64(docs["size_in_bytes_bytes"]) == 22
+
+    # the media job dispatched red.png to the node thumbnailer and the
+    # webp landed in the sharded store (ref:job.rs:148-156 + shard.rs)
+    red = library.db.find_one("file_path", name="red", extension="png")
+    assert red["cas_id"] is not None
+    await node.thumbnailer.wait_library_batch(library.id)
+    assert node.thumbnailer.store.exists(library.id, red["cas_id"])
+    await node.thumbnailer.shutdown()
 
     # media_data extracted for the png
     png = library.db.find_one("file_path", name="red", extension="png")
