@@ -33,6 +33,13 @@ def new_pub_id() -> bytes:
     return uuid.uuid4().bytes
 
 
+def escape_like(s: str) -> str:
+    r"""Escape LIKE wildcards in user-derived path fragments; pair with
+    ``LIKE ? ESCAPE '\'`` so a directory named ``50% off`` can't match
+    unrelated rows."""
+    return s.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")
+
+
 def u64_blob(value: int) -> bytes:
     """u64 -> 8-byte LE BLOB (inode / size columns; SQLite lacks u64,
     same workaround as ref:core/prisma/schema.prisma:164)."""

@@ -9,6 +9,7 @@ progress streams over the library event bus as JobProgressEvent.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import uuid
 from typing import Any
@@ -53,6 +54,7 @@ class JobManager:
         self.system = task_system or TaskSystem()
         self._active: dict[uuid.UUID, tuple[Any, JobContext]] = {}  # job id -> (handle, ctx)
         self._supervisors: set = set()
+        self._supervisor_by_job: dict[uuid.UUID, Any] = {}
 
     # --- ingest & drive (ref:manager.rs:101-178) ---
 
@@ -75,13 +77,13 @@ class JobManager:
         runner = JobRunnerTask(job, ctx)
         handle = self.system.dispatch(runner)
         self._active[job.id] = (handle, ctx)
-        import asyncio
-
         # keep a strong ref: the loop only weak-refs tasks and a GC'd
         # supervisor would drop final status writes + job chaining
         sup = asyncio.ensure_future(self._supervise(job, library, handle, ctx))
         self._supervisors.add(sup)
+        self._supervisor_by_job[job.id] = sup
         sup.add_done_callback(self._supervisors.discard)
+        sup.add_done_callback(lambda _t, jid=job.id: self._supervisor_by_job.pop(jid, None))
 
     async def _supervise(self, job: StatefulJob, library: Any, handle, ctx: JobContext) -> None:
         result = await handle.wait()
@@ -120,8 +122,6 @@ class JobManager:
         await handle.pause()
         # job may complete before reaching a pause boundary — wait on
         # whichever happens first
-        import asyncio
-
         paused = asyncio.ensure_future(handle.wait_paused())
         done = asyncio.ensure_future(handle.wait())
         await asyncio.wait({paused, done}, return_when=asyncio.FIRST_COMPLETED)
@@ -154,13 +154,15 @@ class JobManager:
         if entry is None:
             return None
         await entry[0].wait()
+        # the supervisor writes the final status after the task settles
+        sup = self._supervisor_by_job.get(job_id)
+        if sup is not None:
+            await asyncio.shield(sup)
         return entry[1].report
 
     async def wait_idle(self) -> None:
         """Wait until no job is actively running (paused/parked jobs
         don't count — they only finish after resume)."""
-        import asyncio
-
         while True:
             waiters = [
                 asyncio.ensure_future(h.wait())

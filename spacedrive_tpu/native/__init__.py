@@ -60,6 +60,9 @@ def load() -> ctypes.CDLL | None:
                 ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32,
             ]
             lib.b3_state_size.restype = ctypes.c_uint32
+            lib.b3_init.argtypes = [ctypes.c_void_p]
+            lib.b3_update.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+            lib.b3_finalize.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
             _LIB = lib
         except OSError:
             _LOAD_FAILED = True
@@ -78,6 +81,29 @@ def blake3_digest(data: bytes, out_len: int = 32) -> bytes | None:
     out = (ctypes.c_uint8 * 64)()
     lib.b3_hash(data, len(data), out, min(out_len, 64))
     return bytes(out[:out_len])
+
+
+class StreamingHasher:
+    """Incremental native BLAKE3 — bounded memory over unbounded input
+    (the validator's full-file hash, ref:core/src/object/validation/hash.rs:9-25)."""
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._state = ctypes.create_string_buffer(lib.b3_state_size())
+        lib.b3_init(self._state)
+
+    def update(self, data: bytes | memoryview) -> "StreamingHasher":
+        data = bytes(data) if isinstance(data, memoryview) else data
+        self._lib.b3_update(self._state, data, len(data))
+        return self
+
+    def digest(self, out_len: int = 32) -> bytes:
+        out = (ctypes.c_uint8 * 64)()
+        self._lib.b3_finalize(self._state, out, min(out_len, 64))
+        return bytes(out[:out_len])
 
 
 def blake3_many(messages: list[bytes], nthreads: int | None = None) -> list[bytes] | None:

@@ -21,7 +21,7 @@ import os
 import time
 from typing import Any
 
-from ...db.database import blob_u64, new_pub_id, now_iso
+from ...db.database import blob_u64, escape_like, new_pub_id, now_iso
 from ...files.extensions import from_str as ext_from_str
 from ...files.isolated_path import full_path_from_db_row as _row_full_path
 from ...files.kind import ObjectKind
@@ -44,7 +44,7 @@ def orphan_where_clause(sub_path_mat: str | None = None) -> str:
         "AND location_id = ?"
     )
     if sub_path_mat is not None:
-        base += " AND materialized_path LIKE ?"
+        base += " AND materialized_path LIKE ? ESCAPE '\\'"
     return base
 
 
@@ -70,7 +70,7 @@ class FileIdentifierJob(StatefulJob):
         params: list[Any] = [loc_id]
         where = orphan_where_clause(self.init.get("sub_path") and self.init["sub_path"])
         if self.init.get("sub_path"):
-            params.append(f"/{self.init['sub_path'].strip('/')}/%")
+            params.append(escape_like(f"/{self.init['sub_path'].strip('/')}/") + "%")
         total = library.db.count("file_path", where, tuple(params))
 
         self.data.update(
@@ -98,7 +98,7 @@ class FileIdentifierJob(StatefulJob):
         params: list[Any] = [d["location_id"]]
         where = orphan_where_clause(self.init.get("sub_path"))
         if self.init.get("sub_path"):
-            params.append(f"/{self.init['sub_path'].strip('/')}/%")
+            params.append(escape_like(f"/{self.init['sub_path'].strip('/')}/") + "%")
         # cursor pagination by id (ref:file_identifier_job.rs:126-165)
         rows = library.db.query(
             f"SELECT * FROM file_path WHERE {where} AND id > ? ORDER BY id LIMIT ?",

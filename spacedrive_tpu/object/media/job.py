@@ -13,6 +13,7 @@ import logging
 import os
 from typing import Any
 
+from ...db.database import escape_like
 from ...files.isolated_path import full_path_from_db_row as _full_path
 from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
@@ -51,8 +52,8 @@ class MediaProcessorJob(StatefulJob):
         sub_filter = ""
         params: list[Any] = [loc_id, *THUMBNAILABLE_EXTENSIONS]
         if self.init.get("sub_path"):
-            sub_filter = " AND materialized_path LIKE ?"
-            params.append(f"/{self.init['sub_path'].strip('/')}/%")
+            sub_filter = " AND materialized_path LIKE ? ESCAPE '\\'"
+            params.append(escape_like(f"/{self.init['sub_path'].strip('/')}/") + "%")
         rows = library.db.query(
             f"SELECT id, pub_id, cas_id, object_id, materialized_path, name, extension "
             f"FROM file_path WHERE location_id = ? AND is_dir = 0 "

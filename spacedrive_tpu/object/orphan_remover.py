@@ -1,0 +1,80 @@
+"""OrphanRemoverActor — deletes objects with no remaining file_paths.
+
+Parity: ref:core/src/object/orphan_remover.rs — invokable actor with a
+periodic tick (1 min interval, 10 s debounce, orphan_remover.rs:12-49),
+clean-up loop removing ≤512 orphaned objects (and their tag links) per
+round until none remain (orphan_remover.rs:57-96).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+TICK_INTERVAL = 60.0  # ref:orphan_remover.rs ONE_MINUTE
+DEBOUNCE = 10.0  # ref:orphan_remover.rs TEN_SECONDS
+BATCH = 512  # ref:orphan_remover.rs:63
+
+
+def process_clean_up(db) -> int:
+    """One full clean-up pass; returns objects removed."""
+    removed = 0
+    while True:
+        rows = db.query(
+            "SELECT o.id FROM object o WHERE NOT EXISTS "
+            "(SELECT 1 FROM file_path fp WHERE fp.object_id = o.id) LIMIT ?",
+            (BATCH,),
+        )
+        if not rows:
+            return removed
+        ids = [r["id"] for r in rows]
+        qmarks = ",".join("?" for _ in ids)
+        with db.transaction() as conn:
+            conn.execute(f"DELETE FROM tag_on_object WHERE object_id IN ({qmarks})", ids)
+            conn.execute(f"DELETE FROM label_on_object WHERE object_id IN ({qmarks})", ids)
+            conn.execute(f"DELETE FROM object WHERE id IN ({qmarks})", ids)
+        removed += len(ids)
+        logger.debug("removed %d orphaned objects", len(ids))
+
+
+class OrphanRemoverActor:
+    def __init__(self, db, tick_interval: float = TICK_INTERVAL, debounce: float = DEBOUNCE):
+        self.db = db
+        self.tick_interval = tick_interval
+        self.debounce = debounce
+        self._wake = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._last_checked = 0.0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def invoke(self) -> None:
+        self._wake.set()
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout=self.tick_interval)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            if time.monotonic() - self._last_checked > self.debounce:
+                try:
+                    process_clean_up(self.db)
+                except Exception:  # noqa: BLE001 - actor must survive
+                    logger.exception("orphan clean-up failed")
+                self._last_checked = time.monotonic()

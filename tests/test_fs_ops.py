@@ -1,0 +1,266 @@
+"""File-operation jobs: copy (with duplicate renaming), cut
+(would-overwrite skip), delete, secure erase — behavior parity with
+ref:core/src/object/fs/{copy,cut,delete,erase}.rs."""
+
+import os
+
+import pytest
+
+from spacedrive_tpu.jobs import JobManager, JobStatus
+from spacedrive_tpu.location.indexer.job import IndexerJob
+from spacedrive_tpu.location.locations import LocationCreateArgs
+from spacedrive_tpu.node import Libraries
+from spacedrive_tpu.object.fs import (
+    append_digit_to_filename,
+    find_available_filename_for_duplicate,
+)
+from spacedrive_tpu.object.fs.copy import FileCopierJob
+from spacedrive_tpu.object.fs.cut import FileCutterJob
+from spacedrive_tpu.object.fs.delete import FileDeleterJob
+from spacedrive_tpu.object.fs.erase import FileEraserJob
+from spacedrive_tpu.tasks import TaskSystem
+
+
+@pytest.fixture()
+def env(tmp_path):
+    loc_dir = tmp_path / "stuff"
+    (loc_dir / "sub").mkdir(parents=True)
+    (loc_dir / "a.txt").write_bytes(b"alpha")
+    (loc_dir / "b.txt").write_bytes(b"beta")
+    (loc_dir / "sub" / "c.txt").write_bytes(b"gamma")
+
+    libs = Libraries(tmp_path / "data")
+    library = libs.create("fs-ops")
+    location = LocationCreateArgs(path=str(loc_dir)).create(library)
+    return library, location, loc_dir
+
+
+async def _indexed(env):
+    """Index the location and hand back (library, mgr, location, loc_dir)."""
+    library, location, loc_dir = env
+    mgr = JobManager(TaskSystem(2))
+    job = IndexerJob({"location_id": location["id"]})
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED
+    return library, mgr, location, loc_dir
+
+
+def _fp(library, name, ext=None):
+    row = library.db.find_one("file_path", name=name, extension=ext if ext is not None else "")
+    assert row is not None, f"no file_path row for {name}"
+    return row
+
+
+def test_append_digit():
+    assert append_digit_to_filename("photo", "jpg", 1) == "photo (1).jpg"
+    assert append_digit_to_filename("photo (3)", "jpg", 4) == "photo (4).jpg"
+    assert append_digit_to_filename("dir", None, 2) == "dir (2)"
+
+
+def test_find_available(tmp_path):
+    p = tmp_path / "x.txt"
+    p.write_text("hi")
+    got = find_available_filename_for_duplicate(str(p))
+    assert got == str(tmp_path / "x (1).txt")
+    (tmp_path / "x (1).txt").write_text("hi")
+    assert find_available_filename_for_duplicate(str(p)) == str(tmp_path / "x (2).txt")
+
+
+@pytest.mark.asyncio
+async def test_copy_file_and_dir(env):
+    library, mgr, location, loc_dir = await _indexed(env)
+    a = _fp(library, "a", "txt")
+    sub = _fp(library, "sub")
+    job = FileCopierJob(
+        {
+            "source_location_id": location["id"],
+            "target_location_id": location["id"],
+            "sources_file_path_ids": [a["id"], sub["id"]],
+            "target_relative_path": "sub",
+        }
+    )
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED
+    assert (loc_dir / "sub" / "a.txt").read_bytes() == b"alpha"
+    # copying `sub` into itself nests one level, without recursing
+    assert (loc_dir / "sub" / "sub" / "c.txt").read_bytes() == b"gamma"
+    assert not (loc_dir / "sub" / "sub" / "sub").exists()
+
+
+@pytest.mark.asyncio
+async def test_copy_same_place_renames(env):
+    library, mgr, location, loc_dir = await _indexed(env)
+    a = _fp(library, "a", "txt")
+    job = FileCopierJob(
+        {
+            "source_location_id": location["id"],
+            "target_location_id": location["id"],
+            "sources_file_path_ids": [a["id"]],
+            "target_relative_path": "",
+        }
+    )
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED
+    assert (loc_dir / "a (1).txt").read_bytes() == b"alpha"
+
+
+@pytest.mark.asyncio
+async def test_cut_moves_and_skips_overwrite(env):
+    library, mgr, location, loc_dir = await _indexed(env)
+    a = _fp(library, "a", "txt")
+    b = _fp(library, "b", "txt")
+    (loc_dir / "sub" / "b.txt").write_bytes(b"existing")  # collision for b
+    job = FileCutterJob(
+        {
+            "source_location_id": location["id"],
+            "target_location_id": location["id"],
+            "sources_file_path_ids": [a["id"], b["id"]],
+            "target_relative_path": "sub",
+        }
+    )
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED_WITH_ERRORS
+    assert (loc_dir / "sub" / "a.txt").read_bytes() == b"alpha"
+    assert not (loc_dir / "a.txt").exists()
+    # b skipped: source kept, target untouched
+    assert (loc_dir / "b.txt").read_bytes() == b"beta"
+    assert (loc_dir / "sub" / "b.txt").read_bytes() == b"existing"
+
+
+@pytest.mark.asyncio
+async def test_delete_removes_disk_and_rows(env):
+    library, mgr, location, loc_dir = await _indexed(env)
+    a = _fp(library, "a", "txt")
+    sub = _fp(library, "sub")
+    job = FileDeleterJob({"location_id": location["id"], "file_path_ids": [a["id"], sub["id"]]})
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED
+    assert not (loc_dir / "a.txt").exists()
+    assert not (loc_dir / "sub").exists()
+    assert library.db.find_one("file_path", id=a["id"]) is None
+    assert library.db.find_one("file_path", id=sub["id"]) is None
+    # child row under sub/ removed too
+    assert library.db.find_one("file_path", name="c") is None
+    # delete ops recorded for sync
+    ops = library.db.query("SELECT * FROM crdt_operation WHERE kind = 'd'")
+    assert len(ops) >= 3
+
+
+@pytest.mark.asyncio
+async def test_erase_overwrites_and_removes(env):
+    library, mgr, location, loc_dir = await _indexed(env)
+    a = _fp(library, "a", "txt")
+    sub = _fp(library, "sub")
+    job = FileEraserJob(
+        {"location_id": location["id"], "file_path_ids": [a["id"], sub["id"]], "passes": 2}
+    )
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED
+    assert not (loc_dir / "a.txt").exists()
+    assert not (loc_dir / "sub").exists()
+    assert library.db.find_one("file_path", id=a["id"]) is None
+    assert library.db.find_one("file_path", name="c") is None
+
+
+@pytest.mark.asyncio
+async def test_copy_into_descendant_terminates(env):
+    library, mgr, location, loc_dir = await _indexed(env)
+    sub = _fp(library, "sub")
+    # target two levels inside the source directory
+    job = FileCopierJob(
+        {
+            "source_location_id": location["id"],
+            "target_location_id": location["id"],
+            "sources_file_path_ids": [sub["id"]],
+            "target_relative_path": "sub/inner",
+        }
+    )
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED
+    assert (loc_dir / "sub" / "inner" / "sub" / "c.txt").read_bytes() == b"gamma"
+    # the copy itself was never re-entered as a source
+    assert not (loc_dir / "sub" / "inner" / "sub" / "inner" / "sub").exists()
+
+
+@pytest.mark.asyncio
+async def test_copy_file_creates_target_dir(env):
+    library, mgr, location, loc_dir = await _indexed(env)
+    a = _fp(library, "a", "txt")
+    job = FileCopierJob(
+        {
+            "source_location_id": location["id"],
+            "target_location_id": location["id"],
+            "sources_file_path_ids": [a["id"]],
+            "target_relative_path": "brand/new",
+        }
+    )
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED
+    assert (loc_dir / "brand" / "new" / "a.txt").read_bytes() == b"alpha"
+
+
+@pytest.mark.asyncio
+async def test_delete_wildcard_dirname_spares_lookalikes(tmp_path):
+    # '50% off' must not LIKE-match '/5000 off/...'
+    loc_dir = tmp_path / "stuff"
+    (loc_dir / "50% off").mkdir(parents=True)
+    (loc_dir / "50% off" / "in.txt").write_bytes(b"in")
+    (loc_dir / "5000 off").mkdir()
+    (loc_dir / "5000 off" / "keep.txt").write_bytes(b"keep")
+    libs = Libraries(tmp_path / "data")
+    library = libs.create("wild")
+    location = LocationCreateArgs(path=str(loc_dir)).create(library)
+    library2, mgr, _, _ = await _indexed((library, location, loc_dir))
+    victim = library.db.find_one("file_path", name="50% off")
+    job = FileDeleterJob({"location_id": location["id"], "file_path_ids": [victim["id"]]})
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED
+    assert library.db.find_one("file_path", name="keep", extension="txt") is not None
+    assert (loc_dir / "5000 off" / "keep.txt").exists()
+    assert library.db.find_one("file_path", name="in", extension="txt") is None
+
+
+@pytest.mark.asyncio
+async def test_erase_never_follows_symlinks(env, tmp_path):
+    library, mgr, location, loc_dir = await _indexed(env)
+    outside = tmp_path / "outside"
+    outside.mkdir()
+    precious = outside / "precious.txt"
+    precious.write_bytes(b"do not touch")
+    os.symlink(outside, loc_dir / "sub" / "link")
+    sub = _fp(library, "sub")
+    job = FileEraserJob(
+        {"location_id": location["id"], "file_path_ids": [sub["id"]], "passes": 1}
+    )
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED
+    assert precious.read_bytes() == b"do not touch"
+    assert not (loc_dir / "sub").exists()
+
+
+@pytest.mark.asyncio
+async def test_failed_erase_keeps_db_row(env):
+    library, mgr, location, loc_dir = await _indexed(env)
+    a = _fp(library, "a", "txt")
+    # make the erase fail: the path still exists but can't be opened r+b
+    os.remove(loc_dir / "a.txt")
+    (loc_dir / "a.txt").mkdir()
+    job = FileEraserJob(
+        {"location_id": location["id"], "file_path_ids": [a["id"]], "passes": 1}
+    )
+    await mgr.ingest(job, library)
+    report = await mgr.wait(job.id)
+    assert report.status == JobStatus.COMPLETED_WITH_ERRORS
+    # path survived, so its library record must too
+    assert (loc_dir / "a.txt").exists()
+    assert library.db.find_one("file_path", id=a["id"]) is not None

@@ -1,0 +1,102 @@
+"""Object validator: full-file BLAKE3 integrity checksums — device
+batch vs host parity, job writes + sync ops
+(ref:core/src/object/validation/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.jobs import JobManager, JobStatus
+from spacedrive_tpu.location.indexer.job import IndexerJob
+from spacedrive_tpu.location.locations import LocationCreateArgs
+from spacedrive_tpu.node import Libraries
+from spacedrive_tpu.object.orphan_remover import process_clean_up
+from spacedrive_tpu.object.validation import file_checksum, file_checksums
+from spacedrive_tpu.object.validation.job import ObjectValidatorJob
+from spacedrive_tpu.ops.blake3_ref import blake3_hex
+from spacedrive_tpu.tasks import TaskSystem
+
+
+def test_file_checksum_matches_reference_impl(tmp_path):
+    rng = np.random.default_rng(3)
+    for size in (0, 1, 1024, 70_000, 3 * 1024 * 1024 + 17):
+        p = tmp_path / f"f{size}"
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        p.write_bytes(data)
+        assert file_checksum(p) == blake3_hex(data, 32), size
+
+
+def test_batched_checksums_device_parity(tmp_path):
+    rng = np.random.default_rng(4)
+    paths, want = [], []
+    for i, size in enumerate([100, 1024, 5000, 65_536, 200_000, 300_000]):
+        p = tmp_path / f"g{i}"
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        p.write_bytes(data)
+        paths.append(str(p))
+        want.append(blake3_hex(data, 32))
+    got = file_checksums(paths, backend="tpu")
+    assert got == want
+
+
+@pytest.mark.asyncio
+async def test_validator_job(tmp_path):
+    loc_dir = tmp_path / "stuff"
+    loc_dir.mkdir()
+    rng = np.random.default_rng(5)
+    contents = {}
+    for name in ("x.bin", "y.bin", "z.bin"):
+        data = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+        (loc_dir / name).write_bytes(data)
+        contents[name] = data
+
+    libs = Libraries(tmp_path / "data")
+    library = libs.create("validate")
+    mgr = JobManager(TaskSystem(2))
+    location = LocationCreateArgs(path=str(loc_dir)).create(library)
+    job = IndexerJob({"location_id": location["id"]})
+    await mgr.ingest(job, library)
+    await mgr.wait(job.id)
+
+    vjob = ObjectValidatorJob({"location_id": location["id"], "backend": "cpu"})
+    await mgr.ingest(vjob, library)
+    report = await mgr.wait(vjob.id)
+    assert report.status == JobStatus.COMPLETED
+    assert report.metadata["validated"] == 3
+
+    for name, data in contents.items():
+        stem = name.rsplit(".", 1)[0]
+        row = library.db.find_one("file_path", name=stem, extension="bin")
+        assert row["integrity_checksum"] == blake3_hex(data, 32)
+    # checksum updates flowed through sync
+    ops = library.db.query(
+        "SELECT * FROM crdt_operation WHERE kind = 'u:integrity_checksum'"
+    )
+    assert len(ops) == 3
+    await mgr.system.shutdown()
+
+
+def test_orphan_remover(tmp_path):
+    libs = Libraries(tmp_path / "data")
+    library = libs.create("orphans")
+    db = library.db
+    from spacedrive_tpu.db.database import new_pub_id, now_iso
+
+    kept = db.insert("object", pub_id=new_pub_id(), kind=5, date_created=now_iso())
+    orphan = db.insert("object", pub_id=new_pub_id(), kind=5, date_created=now_iso())
+    tag = db.insert("tag", pub_id=new_pub_id(), name="t")
+    db.insert("tag_on_object", tag_id=tag, object_id=orphan, date_created=now_iso())
+    db.insert(
+        "file_path",
+        pub_id=new_pub_id(),
+        name="keepme",
+        extension="",
+        materialized_path="/",
+        object_id=kept,
+    )
+    removed = process_clean_up(db)
+    assert removed == 1
+    assert db.find_one("object", id=kept) is not None
+    assert db.find_one("object", id=orphan) is None
+    assert db.find_one("tag_on_object", object_id=orphan) is None
