@@ -1,8 +1,37 @@
-"""Node runtime: root object, config, libraries.
+"""Node runtime: root object, config, libraries, volumes, preferences.
 
-Parity: ref:core/src/{lib.rs,node/,library/}.
+Parity: ref:core/src/{lib.rs,node/,library/,volume/,preferences/,
+notifications.rs}.
 """
 
+from .actors import Actors
+from .config import BackendFeature, ConfigManager, NodeConfig, P2PDiscoveryState
 from .library import Library, Libraries, LibraryConfig
+from .node import Node
+from .notifications import Notification, NotificationId, Notifications
+from .preferences import clear_preference, read_preferences, write_preferences
+from .statistics import get_statistics, update_statistics
+from .volumes import Volume, get_volumes, save_volumes
 
-__all__ = ["Library", "Libraries", "LibraryConfig"]
+__all__ = [
+    "Actors",
+    "BackendFeature",
+    "ConfigManager",
+    "Library",
+    "Libraries",
+    "LibraryConfig",
+    "Node",
+    "NodeConfig",
+    "Notification",
+    "NotificationId",
+    "Notifications",
+    "P2PDiscoveryState",
+    "Volume",
+    "clear_preference",
+    "get_statistics",
+    "get_volumes",
+    "read_preferences",
+    "save_volumes",
+    "update_statistics",
+    "write_preferences",
+]

@@ -1,0 +1,136 @@
+"""Node — the root runtime object every host embeds.
+
+Parity: ref:core/src/lib.rs:82-250 `Node::new(data_dir, env)` builds
+config manager, libraries, job system, thumbnailer, event bus,
+notifications, optional image-labeler and P2P, then performs an
+ordered start (lib.rs:163-177: locations → libraries.init → jobs →
+p2p) and exposes `shutdown` (lib.rs:240-250). The API layer mounts on
+top of this object (api::mount, ref:core/src/api/mod.rs:124).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any
+
+from ..jobs.manager import JobManager
+from ..object.media.thumbnail.actor import Thumbnailer
+from ..object.orphan_remover import OrphanRemoverActor
+from ..tasks.system import TaskSystem
+from ..utils.events import EventBus
+from ..utils.tracing import init_logger
+from .actors import Actors
+from .config import BackendFeature, ConfigManager, NodeConfig
+from .library import Libraries, Library
+from .notifications import Notifications
+
+
+class Node:
+    """Owns every long-lived service; one per process (ref:lib.rs:60-80)."""
+
+    def __init__(
+        self,
+        data_dir: str | os.PathLike,
+        *,
+        use_device: bool = True,
+        with_logger: bool = False,
+    ):
+        self.data_dir = os.fspath(data_dir)
+        os.makedirs(self.data_dir, exist_ok=True)
+        if with_logger:
+            init_logger(self.data_dir)
+
+        self.config = ConfigManager(self.data_dir)
+        self.event_bus = EventBus()
+        self.notifications = Notifications(self.event_bus)
+        self.task_system = TaskSystem()
+        self.jobs = JobManager(self.task_system)
+        self.libraries = Libraries(self.data_dir, node=self)
+        self.actors = Actors()
+        self.thumbnailer = Thumbnailer(
+            os.path.join(self.data_dir, "thumbnails"),
+            event_bus=self.event_bus,
+            use_device=use_device,
+        )
+        self.use_device = use_device
+        self.p2p: Any = None  # P2PManager, attached by start() when enabled
+        self.http: Any = None  # custom_uri server handle
+        self._started = False
+
+    # --- identity ------------------------------------------------------
+
+    @property
+    def id(self) -> uuid.UUID:
+        return self.config.config.id
+
+    @property
+    def identity(self):
+        return self.config.config.identity
+
+    def is_feature_enabled(self, feature: BackendFeature) -> bool:
+        return feature in self.config.config.features
+
+    def toggle_feature(self, feature: BackendFeature, enabled: bool) -> None:
+        """ref:core/src/api/mod.rs:66-81 `toggleFeatureFlag`."""
+        feats = self.config.config.features
+        if enabled and feature not in feats:
+            feats.append(feature)
+        if not enabled and feature in feats:
+            feats.remove(feature)
+        self.config.save()
+
+    # --- lifecycle -----------------------------------------------------
+
+    async def start(self) -> None:
+        """Ordered start (ref:lib.rs:163-177; ordering is
+        deadlock-sensitive in the reference: locations actor first, then
+        libraries init — which cold-resumes jobs — then p2p listeners)."""
+        if self._started:
+            return
+        self._started = True
+        for lib in self.libraries.load_all():
+            await self._init_library(lib)
+        if self.config.config.p2p.enabled:
+            from ..p2p.manager import P2PManager
+
+            self.p2p = P2PManager(self)
+            await self.p2p.start()
+
+    async def _init_library(self, lib: Library) -> None:
+        """Per-library wiring done at load (ref:library/manager/mod.rs:387-535):
+        orphan-remover actor started, ingest actor wired when a sync
+        transport attaches (p2p/cloud), then cold job resume."""
+        lib.node = self
+        lib.orphan_remover = OrphanRemoverActor(lib.db)
+        lib.orphan_remover.start()
+        await self.jobs.cold_resume(lib)
+
+    async def create_library(self, name: str, description: str = "") -> Library:
+        lib = self.libraries.create(
+            name,
+            description,
+            node_pub_id=self.id.bytes,
+            node_name=self.config.config.name,
+        )
+        await self._init_library(lib)
+        return lib
+
+    async def shutdown(self) -> None:
+        """ref:lib.rs:240-250: stop jobs (persisting state), thumbnailer
+        (persisting queues), actors, p2p, then close libraries."""
+        from ..jobs.manager import shutdown_jobs
+
+        for lib in list(self.libraries.libraries.values()):
+            await shutdown_jobs(self.jobs, lib)
+            remover = getattr(lib, "orphan_remover", None)
+            if remover is not None:
+                await remover.stop()
+        await self.thumbnailer.shutdown()
+        await self.actors.shutdown()
+        if self.p2p is not None:
+            await self.p2p.shutdown()
+        await self.task_system.shutdown()
+        for lib in list(self.libraries.libraries.values()):
+            lib.close()
+        self._started = False

@@ -1,0 +1,12 @@
+"""P2P mesh: identity, transport, discovery, protocol, transfer.
+
+Parity: ref:crates/p2p2 (runtime), crates/p2p-block (Spaceblock),
+crates/p2p-proto (wire helpers), core/src/p2p (protocol + operations).
+The reference rides QUIC on a patched libp2p; here streams are
+length-framed asyncio TCP with an ed25519-authenticated X25519 +
+ChaCha20-Poly1305 channel (same trust model: identity keypairs, no CA).
+"""
+
+from .identity import Identity, RemoteIdentity
+
+__all__ = ["Identity", "RemoteIdentity"]

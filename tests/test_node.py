@@ -1,0 +1,221 @@
+"""Node runtime: config migrations, identity, actors, volumes,
+preferences, notifications, statistics, Node lifecycle.
+
+Parity targets: ref:core/src/node/config.rs, crates/actors,
+core/src/volume, core/src/preferences, core/src/notifications.rs,
+core/src/library/statistics.rs, core/src/lib.rs.
+"""
+
+import asyncio
+import json
+import os
+import uuid
+
+import pytest
+
+from spacedrive_tpu.db.database import LibraryDb, u64_blob
+from spacedrive_tpu.node.actors import Actors
+from spacedrive_tpu.node.config import (
+    BackendFeature,
+    ConfigManager,
+    NodeConfig,
+    P2PDiscoveryState,
+)
+from spacedrive_tpu.node.node import Node
+from spacedrive_tpu.node.notifications import Notifications
+from spacedrive_tpu.node.preferences import (
+    clear_preference,
+    read_preferences,
+    write_preferences,
+)
+from spacedrive_tpu.node.statistics import get_statistics, update_statistics
+from spacedrive_tpu.node.volumes import get_volumes, save_volumes
+from spacedrive_tpu.p2p.identity import Identity, RemoteIdentity
+
+
+# --- identity ------------------------------------------------------------
+
+
+def test_identity_roundtrip_and_sign():
+    ident = Identity()
+    seed = ident.to_bytes()
+    assert len(seed) == 32
+    again = Identity.from_bytes(seed)
+    remote = ident.to_remote_identity()
+    assert again.to_remote_identity() == remote
+    sig = ident.sign(b"hello")
+    assert remote.verify(sig, b"hello")
+    assert not remote.verify(sig, b"tampered")
+    # display form roundtrips (ref:identity.rs Display/FromStr)
+    assert RemoteIdentity.from_str(str(remote)) == remote
+
+
+# --- node config ---------------------------------------------------------
+
+
+def test_node_config_persist_and_reload(tmp_path):
+    mgr = ConfigManager(tmp_path)
+    node_id = mgr.config.id
+    mgr.config.name = "station"
+    mgr.config.features.append(BackendFeature.CLOUD_SYNC)
+    mgr.config.p2p.discovery = P2PDiscoveryState.CONTACTS_ONLY
+    mgr.save()
+
+    mgr2 = ConfigManager(tmp_path)
+    assert mgr2.config.id == node_id
+    assert mgr2.config.name == "station"
+    assert mgr2.config.features == [BackendFeature.CLOUD_SYNC]
+    assert mgr2.config.p2p.discovery == P2PDiscoveryState.CONTACTS_ONLY
+    # identity keypair survived the roundtrip
+    assert mgr2.config.identity.to_bytes() == mgr.config.identity.to_bytes()
+
+
+def test_node_config_migration_v1(tmp_path):
+    path = tmp_path / "node.json"
+    path.write_text(
+        json.dumps({"version": 1, "id": str(uuid.uuid4()), "name": "old"})
+    )
+    mgr = ConfigManager(tmp_path)
+    assert mgr.config.version == 2
+    assert mgr.config.features == []  # added by the v1→v2 migration
+
+
+# --- actors --------------------------------------------------------------
+
+
+def test_actors_declare_start_stop_restart():
+    async def run():
+        actors = Actors()
+        ticks = []
+
+        async def actor():
+            while True:
+                ticks.append(1)
+                await asyncio.sleep(0.01)
+
+        actors.declare("ticker", actor)
+        assert not actors.is_running("ticker")
+        assert actors.start("ticker")
+        await asyncio.sleep(0.05)
+        assert actors.is_running("ticker")
+        assert ticks
+        assert actors.stop("ticker")
+        await asyncio.sleep(0.02)
+        assert not actors.is_running("ticker")
+        assert actors.restart("ticker")
+        assert actors.states() == {"ticker": True}
+        await actors.shutdown()
+
+    asyncio.run(run())
+
+
+# --- volumes -------------------------------------------------------------
+
+
+def test_volumes_enumerate_and_save():
+    vols = get_volumes()
+    assert vols, "at least the root filesystem"
+    root = [v for v in vols if v.is_system]
+    assert root and root[0].total_bytes_capacity > 0
+    db = LibraryDb(None, memory=True)
+    n = save_volumes(db, vols)
+    assert db.count("volume") == n
+    save_volumes(db, vols)  # idempotent upsert on (mount_point, name)
+    assert db.count("volume") == n
+
+
+# --- preferences ---------------------------------------------------------
+
+
+def test_preferences_roundtrip():
+    db = LibraryDb(None, memory=True)
+    doc = {
+        "location": {"1": {"explorer": {"layout": "grid", "size": 3}}},
+        "theme": "dark",
+    }
+    write_preferences(db, doc)
+    assert read_preferences(db) == doc
+    # partial update touches only affected keys
+    write_preferences(db, {"theme": "light"})
+    out = read_preferences(db)
+    assert out["theme"] == "light"
+    assert out["location"] == doc["location"]
+    clear_preference(db, "location")
+    assert "location" not in read_preferences(db)
+
+
+# --- notifications -------------------------------------------------------
+
+
+def test_notifications_node_and_library():
+    db = LibraryDb(None, memory=True)
+    notif = Notifications()
+    seen = []
+    notif.event_bus.on(seen.append)
+    n1 = notif.emit_node({"kind": "info", "title": "hi"})
+    assert n1.id.library_id is None and n1.id.local_id == 1
+    lib_id = str(uuid.uuid4())
+    n2 = notif.emit_library(db, lib_id, {"kind": "error", "title": "bad"})
+    assert n2.id.library_id == lib_id
+    assert len(seen) == 2
+    rows = Notifications.list_library(db, lib_id)
+    assert rows[0].data["title"] == "bad" and not rows[0].read
+    Notifications.mark_read(db, rows[0].id.local_id)
+    assert Notifications.list_library(db, lib_id)[0].read
+
+
+# --- statistics ----------------------------------------------------------
+
+
+def test_statistics_snapshot(tmp_path):
+    db = LibraryDb(None, memory=True)
+    loc = db.insert("location", pub_id=os.urandom(16), path="/x", name="x")
+    oid = db.insert("object", pub_id=os.urandom(16), kind=5)
+    for i, (cas, size) in enumerate([("aa", 100), ("aa", 100), ("bb", 50)]):
+        db.insert(
+            "file_path",
+            pub_id=os.urandom(16),
+            location_id=loc,
+            materialized_path="/",
+            name=f"f{i}",
+            is_dir=0,
+            cas_id=cas,
+            size_in_bytes_bytes=u64_blob(size),
+            object_id=oid,
+        )
+    stats = update_statistics(db)
+    assert stats["total_object_count"] == 1
+    assert stats["total_bytes_used"] == "250"
+    assert stats["total_unique_bytes"] == "150"  # one 'aa' + one 'bb'
+    assert int(stats["total_bytes_capacity"]) > 0
+    # second call updates the same row
+    update_statistics(db)
+    assert db.count("statistics") == 1
+    assert get_statistics(db)["total_object_count"] == 1
+
+
+# --- Node lifecycle ------------------------------------------------------
+
+
+def test_node_lifecycle(tmp_path):
+    async def run():
+        node = Node(tmp_path, use_device=False)
+        node.config.config.p2p.enabled = False  # p2p exercised in test_p2p
+        await node.start()
+        lib = await node.create_library("home")
+        assert node.libraries.get(lib.id) is lib
+        assert getattr(lib, "orphan_remover", None) is not None
+        node.toggle_feature(BackendFeature.FILES_OVER_P2P, True)
+        assert node.is_feature_enabled(BackendFeature.FILES_OVER_P2P)
+        await node.shutdown()
+
+        # reload: same node id, library comes back
+        node2 = Node(tmp_path, use_device=False)
+        node2.config.config.p2p.enabled = False
+        assert node2.id == node.id
+        await node2.start()
+        assert node2.libraries.get(lib.id) is not None
+        assert node2.is_feature_enabled(BackendFeature.FILES_OVER_P2P)
+        await node2.shutdown()
+
+    asyncio.run(run())
