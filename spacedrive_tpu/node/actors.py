@@ -33,7 +33,9 @@ class Actors:
         if name not in self._factories:
             return False
         task = self._tasks.get(name)
-        if task is not None and not task.done():
+        # a just-cancelled task isn't done() until the loop runs; treat it
+        # as stopped so restart() can hand the name to a replacement
+        if task is not None and not task.done() and not task.cancelling():
             return False
         self._tasks[name] = asyncio.get_running_loop().create_task(
             self._factories[name](), name=f"actor:{name}"

@@ -126,6 +126,10 @@ class ConfigManager:
         if os.path.exists(self.path):
             data = _vm.load(self.path)
             self.config = NodeConfig.from_dict(data)
+            # persist any defaults from_dict filled in (a migrated file
+            # missing `identity` must not mint a new keypair every boot)
+            if self.config.to_dict() != data:
+                self.save()
         else:
             self.config = NodeConfig()
             self.save()

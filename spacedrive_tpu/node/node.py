@@ -114,6 +114,8 @@ class Node:
             node_name=self.config.config.name,
         )
         await self._init_library(lib)
+        if self.p2p is not None:
+            self.p2p.register_library(lib)
         return lib
 
     async def shutdown(self) -> None:

@@ -43,6 +43,12 @@ def write_preferences(db: LibraryDb, doc: dict[str, Any]) -> int:
     """Flatten `doc` and upsert each dotted key (ref:kv.rs `write`)."""
     flat = _flatten(doc)
     for key, value in flat.items():
+        # a key can't be both a leaf and a subtree: drop any ancestor
+        # leaves and any children this write shadows
+        parts = key.split(".")
+        for i in range(1, len(parts)):
+            db.delete("preference", key=".".join(parts[:i]))
+        db.execute("DELETE FROM preference WHERE key LIKE ?", (key + ".%",))
         db.upsert("preference", {"key": key}, value=msgpack.packb(value))
     return len(flat)
 

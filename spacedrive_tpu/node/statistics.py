@@ -34,15 +34,15 @@ def update_statistics(
     db: LibraryDb, thumbnails_dir: str | None = None
 ) -> dict[str, Any]:
     total_objects = db.count("object")
-    rows = db.query("SELECT size_in_bytes_bytes FROM file_path")
-    total_bytes_used = sum(blob_u64(r["size_in_bytes_bytes"]) or 0 for r in rows)
-    # unique bytes = one size per distinct cas_id; sizes are LE blobs, so
-    # aggregate in Python rather than SQL (SQLite can't order the blobs)
+    # one table scan for both totals; unique bytes = one size per distinct
+    # cas_id, aggregated in Python (sizes are LE blobs SQLite can't order)
+    total_bytes_used = 0
     by_cas: dict[str, int] = {}
-    for r in db.query(
-        "SELECT cas_id, size_in_bytes_bytes FROM file_path WHERE cas_id IS NOT NULL"
-    ):
-        by_cas.setdefault(r["cas_id"], blob_u64(r["size_in_bytes_bytes"]) or 0)
+    for r in db.query("SELECT cas_id, size_in_bytes_bytes FROM file_path"):
+        size = blob_u64(r["size_in_bytes_bytes"]) or 0
+        total_bytes_used += size
+        if r["cas_id"] is not None:
+            by_cas.setdefault(r["cas_id"], size)
     total_unique_bytes = sum(by_cas.values())
 
     capacity = 0

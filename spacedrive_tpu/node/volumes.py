@@ -74,7 +74,14 @@ def get_volumes() -> list[Volume]:
             if len(parts) < 3:
                 continue
             device, mount, fstype = parts[0], parts[1], parts[2]
-            mount = mount.encode().decode("unicode_escape")  # \040 spaces
+            # /proc/mounts octal-escapes UTF-8 bytes (\040 space etc.);
+            # unicode_escape yields Latin-1 codepoints, so re-encode
+            mount = (
+                mount.encode("latin-1")
+                .decode("unicode_escape")
+                .encode("latin-1")
+                .decode("utf-8", "surrogateescape")
+            )
             if fstype in _PSEUDO_FS or mount in seen:
                 continue
             try:

@@ -7,6 +7,22 @@ length-framed asyncio TCP with an ed25519-authenticated X25519 +
 ChaCha20-Poly1305 channel (same trust model: identity keypairs, no CA).
 """
 
+from .block import BlockSize, Range, SpaceblockRequest, SpaceblockRequests, Transfer
 from .identity import Identity, RemoteIdentity
+from .p2p import P2P, Peer
+from .protocol import FileRequest, Header, HeaderType
 
-__all__ = ["Identity", "RemoteIdentity"]
+__all__ = [
+    "BlockSize",
+    "FileRequest",
+    "Header",
+    "HeaderType",
+    "Identity",
+    "P2P",
+    "Peer",
+    "Range",
+    "RemoteIdentity",
+    "SpaceblockRequest",
+    "SpaceblockRequests",
+    "Transfer",
+]

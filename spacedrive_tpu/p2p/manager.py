@@ -1,0 +1,167 @@
+"""P2PManager — wires the P2P runtime into the Node.
+
+Parity: ref:core/src/p2p/manager.rs:49-118 — builds the P2P runtime
+from `NodeConfig.p2p` (port, discovery mode), advertises node metadata
+(name/os/version, metadata.rs) plus per-library instances
+(libraries.rs) over discovery, dispatches inbound streams by `Header`
+(protocol.rs), pushes sync alerts to library peers on every local
+`write_ops`, and backs each library's ingest actor with peer pulls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import platform
+import uuid
+from typing import Any
+
+from ..node.config import BackendFeature, P2PDiscoveryState
+from ..sync.ingest import IngestActor
+from .identity import RemoteIdentity
+from .mdns import MdnsDiscovery
+from .operations import SpacedropManager, respond_file
+from .p2p import P2P
+from .protocol import Header, HeaderType
+from .sync import alert_new_ops, request_ops_from_peer, respond_sync_request
+from .wire import Writer
+
+logger = logging.getLogger(__name__)
+
+
+class P2PManager:
+    def __init__(self, node: Any, *, beacon_addrs: list[tuple[str, int]] | None = None,
+                 bind_host: str = "0.0.0.0"):
+        self.node = node
+        self.p2p = P2P("spacedrive", node.config.config.identity)
+        self.spacedrop = SpacedropManager(self.p2p, node.event_bus)
+        self.ingest_actors: dict[uuid.UUID, IngestActor] = {}
+        self._beacon_addrs = beacon_addrs
+        self._bind_host = bind_host
+        self._unsubs: list[Any] = []
+        self.port: int | None = None
+
+    # --- lifecycle -----------------------------------------------------
+
+    async def start(self) -> None:
+        cfg = self.node.config.config
+        self._loop = asyncio.get_running_loop()
+        self.p2p.set_stream_handler(self._handle_stream)
+        self.port = await self.p2p.listen(cfg.p2p.port, host=self._bind_host)
+        self._advertise()
+        if cfg.p2p.discovery != P2PDiscoveryState.DISABLED:
+            mdns = MdnsDiscovery(
+                self.p2p,
+                self.port,
+                beacon_addrs=self._beacon_addrs,
+                bind_port=0 if self._beacon_addrs is not None else 41841,
+            )
+            await mdns.start()
+        for lib in self.node.libraries.libraries.values():
+            self.register_library(lib)
+
+    def _advertise(self) -> None:
+        """Node metadata for discovery (ref:p2p/metadata.rs) + the
+        instances this node exposes per library (ref:p2p/libraries.rs)."""
+        cfg = self.node.config.config
+        self.p2p.metadata.update(
+            {
+                "name": cfg.name,
+                "operating_system": platform.system().lower(),
+                "device_model": platform.machine(),
+                "version": "0.1.0",
+                "libraries": ",".join(
+                    str(lid) for lid in self.node.libraries.libraries
+                ),
+            }
+        )
+
+    def register_library(self, lib: Any) -> None:
+        """Wire sync for one library: alert peers on local writes; back
+        the ingest actor with peer pulls (ref:p2p/sync/mod.rs)."""
+        if lib.id in self.ingest_actors:
+            return
+
+        async def request_ops(timestamps, count, lib_id=lib.id):
+            for peer in self.peers_for_library(lib_id):
+                try:
+                    return await request_ops_from_peer(
+                        self.p2p, peer.identity, lib_id, timestamps, count
+                    )
+                except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                    logger.debug("sync pull from %s failed: %s", peer.identity, e)
+            return [], False
+
+        actor = IngestActor(lib.sync, request_ops)
+        self.ingest_actors[lib.id] = actor
+        lib.ingest = actor
+
+        def on_event(event, lib_id=lib.id):
+            if event == ("SyncMessage", "Created"):
+                loop = getattr(self, "_loop", None)
+                if loop is not None and loop.is_running():
+                    loop.call_soon_threadsafe(
+                        lambda: loop.create_task(self._alert_peers(lib_id))
+                    )
+
+        try:
+            self._loop = asyncio.get_running_loop()
+        except RuntimeError:
+            pass  # set at start(); registration before start is fine
+        self._unsubs.append(lib.event_bus.on(on_event))
+        self._advertise()
+
+    async def _alert_peers(self, library_id: uuid.UUID) -> None:
+        for peer in self.peers_for_library(library_id):
+            try:
+                await alert_new_ops(self.p2p, peer.identity, library_id)
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                logger.debug("sync alert to %s failed: %s", peer.identity, e)
+
+    def peers_for_library(self, library_id: uuid.UUID) -> list[Any]:
+        lid = str(library_id)
+        return [
+            p
+            for p in self.p2p.discovered_peers()
+            if lid in p.metadata.get("libraries", "").split(",")
+        ]
+
+    # --- inbound dispatch (ref:manager.rs stream handler) --------------
+
+    async def _handle_stream(self, stream: Any) -> None:
+        header = await Header.read(stream)
+        if header.type == HeaderType.PING:
+            w = Writer(stream)
+            w.u8(0xAA)
+            await w.flush()
+        elif header.type == HeaderType.SPACEDROP:
+            await self.spacedrop.handle_inbound(stream, header.spacedrop)
+        elif header.type == HeaderType.SYNC:
+            w = Writer(stream)
+            w.u8(0x01)
+            await w.flush()
+            actor = self.ingest_actors.get(header.library_id)
+            if actor is not None:
+                actor.notify()
+        elif header.type == HeaderType.SYNC_REQUEST:
+            lib = self.node.libraries.get(header.library_id)
+            if lib is not None:
+                await respond_sync_request(stream, lib.sync)
+        elif header.type == HeaderType.FILE:
+            if self.node.is_feature_enabled(BackendFeature.FILES_OVER_P2P):
+                await respond_file(stream, header.file, self.node.libraries)
+            else:
+                w = Writer(stream)
+                w.u8(0).string("filesOverP2P disabled")
+                await w.flush()
+        else:
+            logger.warning("unhandled header type %s", header.type)
+
+    async def shutdown(self) -> None:
+        for unsub in self._unsubs:
+            unsub()
+        self._unsubs.clear()
+        for actor in self.ingest_actors.values():
+            await actor.stop()
+        self.ingest_actors.clear()
+        await self.p2p.shutdown()

@@ -1,0 +1,242 @@
+"""P2P operations — ping, Spacedrop, request_file.
+
+Parity: ref:core/src/p2p/operations/{ping.rs,spacedrop.rs,request_file.rs}.
+Spacedrop keeps the reference's flow (spacedrop.rs:28-203): sender
+opens a stream, writes `Header::Spacedrop(requests)`, then blocks on a
+single accept(1)/reject(0) byte driven by the remote user's dialog
+(frontend subscribes via the event bus and resolves through
+`accept_spacedrop`/`reject_spacedrop`); on accept the Spaceblock
+transfer runs. `request_file` streams one file range out of a library
+by `file_path` pub_id (request_file.rs:29-102).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import os
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .block import BlockSize, Range, SpaceblockRequest, SpaceblockRequests, Transfer
+from .identity import RemoteIdentity
+from .protocol import FileRequest, Header, HeaderType
+from .wire import Reader, Writer
+
+SPACEDROP_TIMEOUT = 60.0  # ref:spacedrop.rs user-decision timeout
+
+
+async def ping(p2p: Any, identity: RemoteIdentity) -> float:
+    """Round-trip a Ping header (ref:operations/ping.rs)."""
+    import time
+
+    stream = await p2p.new_stream(identity)
+    try:
+        t0 = time.monotonic()
+        await Header(HeaderType.PING).write(stream)
+        pong = await Reader(stream).u8()
+        if pong != 0xAA:
+            raise ValueError("bad pong")
+        return time.monotonic() - t0
+    finally:
+        await stream.close()
+
+
+@dataclass
+class SpacedropRequest:
+    """An inbound offer pending user decision (ref:spacedrop.rs:160-203)."""
+
+    id: uuid.UUID
+    peer: RemoteIdentity
+    files: list[str]
+    total_size: int
+    _decision: asyncio.Future = field(repr=False, default=None)  # type: ignore[assignment]
+
+
+class SpacedropManager:
+    """Hangs off P2PManager: outbound sends + inbound accept/reject map
+    keyed by request id (ref:spacedrop.rs `spacedrop_pairing_reqs`)."""
+
+    def __init__(self, p2p: Any, event_bus: Any = None, save_dir: str | None = None):
+        self.p2p = p2p
+        self.event_bus = event_bus
+        self.save_dir = save_dir or os.path.expanduser("~/Downloads")
+        self.pending: dict[uuid.UUID, SpacedropRequest] = {}
+        self.progress: dict[uuid.UUID, int] = {}
+        self._cancel: dict[uuid.UUID, asyncio.Event] = {}
+
+    # --- outbound (ref:spacedrop.rs:28-110) ---
+
+    async def send(self, identity: RemoteIdentity, paths: list[str]) -> uuid.UUID:
+        sizes = [os.path.getsize(p) for p in paths]
+        requests = SpaceblockRequests(
+            id=uuid.uuid4(),
+            block_size=BlockSize.from_file_size(max(sizes, default=0)),
+            requests=[
+                SpaceblockRequest(name=os.path.basename(p), size=s)
+                for p, s in zip(paths, sizes)
+            ],
+        )
+        stream = await self.p2p.new_stream(identity)
+        cancel = asyncio.Event()
+        self._cancel[requests.id] = cancel
+        try:
+            await Header(HeaderType.SPACEDROP, spacedrop=requests).write(stream)
+            decision = await asyncio.wait_for(
+                Reader(stream).u8(), SPACEDROP_TIMEOUT
+            )
+            if decision != 1:
+                raise PermissionError("spacedrop rejected by peer")
+            transfer = Transfer(
+                requests,
+                on_progress=lambda pct: self._on_progress(requests.id, pct),
+                cancelled=cancel,
+            )
+            files = [open(p, "rb") for p in paths]
+            try:
+                await transfer.send(stream, files)
+            finally:
+                for f in files:
+                    f.close()
+            return requests.id
+        finally:
+            self._cancel.pop(requests.id, None)
+            await stream.close()
+
+    def _on_progress(self, drop_id: uuid.UUID, pct: int) -> None:
+        self.progress[drop_id] = pct
+        if self.event_bus is not None:
+            self.event_bus.emit(("SpacedropProgress", drop_id, pct))
+
+    def cancel(self, drop_id: uuid.UUID) -> None:
+        ev = self._cancel.get(drop_id)
+        if ev is not None:
+            ev.set()
+
+    # --- inbound (ref:spacedrop.rs:160-203 `receiver`) ---
+
+    async def handle_inbound(self, stream: Any, requests: SpaceblockRequests) -> None:
+        loop = asyncio.get_running_loop()
+        req = SpacedropRequest(
+            id=requests.id,
+            peer=stream.remote_identity,
+            files=[r.name for r in requests.requests],
+            total_size=requests.total_size,
+            _decision=loop.create_future(),
+        )
+        self.pending[req.id] = req
+        if self.event_bus is not None:
+            self.event_bus.emit(("SpacedropRequest", req))
+        w = Writer(stream)
+        try:
+            dest = await asyncio.wait_for(req._decision, SPACEDROP_TIMEOUT)
+        except asyncio.TimeoutError:
+            dest = None
+        finally:
+            self.pending.pop(req.id, None)
+        if dest is None:
+            w.u8(0)
+            await w.flush()
+            return
+        w.u8(1)
+        await w.flush()
+        os.makedirs(dest, exist_ok=True)
+        cancel = asyncio.Event()
+        self._cancel[req.id] = cancel
+        transfer = Transfer(
+            requests,
+            on_progress=lambda pct: self._on_progress(req.id, pct),
+            cancelled=cancel,
+        )
+        sinks = [
+            open(os.path.join(dest, os.path.basename(r.name)), "wb")
+            for r in requests.requests
+        ]
+        try:
+            await transfer.receive(stream, sinks)
+        finally:
+            self._cancel.pop(req.id, None)
+            for s in sinks:
+                s.close()
+
+    def accept(self, drop_id: uuid.UUID, dest_dir: str | None = None) -> bool:
+        """rspc `p2p.acceptSpacedrop` with a target dir (ref:spacedrop.rs)."""
+        req = self.pending.get(drop_id)
+        if req is None or req._decision.done():
+            return False
+        req._decision.set_result(dest_dir or self.save_dir)
+        return True
+
+    def reject(self, drop_id: uuid.UUID) -> bool:
+        req = self.pending.get(drop_id)
+        if req is None or req._decision.done():
+            return False
+        req._decision.set_result(None)
+        return True
+
+
+async def request_file(
+    p2p: Any,
+    identity: RemoteIdentity,
+    library_id: uuid.UUID,
+    file_path_pub_id: uuid.UUID,
+    sink: io.RawIOBase | Any,
+    range: Range | None = None,
+) -> int:
+    """Pull one file (range) from a remote library
+    (ref:operations/request_file.rs:29-102)."""
+    rng = range or Range()
+    stream = await p2p.new_stream(identity)
+    try:
+        await Header(
+            HeaderType.FILE,
+            file=FileRequest(library_id, file_path_pub_id, rng),
+        ).write(stream)
+        r = Reader(stream)
+        ok = await r.u8()
+        if ok != 1:
+            err = await r.string()
+            raise FileNotFoundError(err)
+        size = await r.u64()
+        block_size = BlockSize.dangerously_new(await r.u32())
+        requests = SpaceblockRequests(
+            id=uuid.uuid4(),
+            block_size=block_size,
+            requests=[SpaceblockRequest(name="file", size=size, range=rng)],
+        )
+        await Transfer(requests).receive(stream, [sink])
+        return size
+    finally:
+        await stream.close()
+
+
+async def respond_file(stream: Any, req: FileRequest, libraries: Any) -> None:
+    """Server half of `request_file` (ref:request_file.rs receiver)."""
+    w = Writer(stream)
+    lib = libraries.get(req.library_id)
+    row = None
+    if lib is not None:
+        row = lib.db.find_one("file_path", pub_id=req.file_path_pub_id.bytes)
+    path = None
+    if row is not None:
+        from ..files.isolated_path import full_path_from_db_row
+
+        loc = lib.db.find_one("location", id=row["location_id"])
+        if loc is not None:
+            path = full_path_from_db_row(loc["path"], row)
+    if path is None or not os.path.isfile(path):
+        w.u8(0).string("file not found")
+        await w.flush()
+        return
+    size = os.path.getsize(path)
+    bs = BlockSize.from_file_size(size)
+    w.u8(1).u64(size).u32(bs.size)
+    await w.flush()
+    requests = SpaceblockRequests(
+        id=uuid.uuid4(),
+        block_size=bs,
+        requests=[SpaceblockRequest(name="file", size=size, range=req.range)],
+    )
+    with open(path, "rb") as fh:
+        await Transfer(requests).send(stream, [fh])

@@ -1,0 +1,227 @@
+"""Encrypted authenticated stream transport.
+
+Parity: ref:crates/p2p2/src/quic/transport.rs + stream.rs — the
+reference runs QUIC (TLS with identity-derived certs) on a patched
+libp2p, protocol `/sdp2p/1`, and hands out `UnicastStream`s. Here each
+unicast stream is one asyncio TCP connection secured by a Noise-style
+handshake:
+
+  client → server: eph X25519 pub ‖ ed25519 identity pub
+  server → client: eph X25519 pub ‖ identity pub ‖ sig(transcript)
+  client → server: sig(transcript)
+
+Both sides HKDF the X25519 shared secret into two ChaCha20-Poly1305
+directional keys; records are 4-byte-BE-length framed ciphertexts with
+64-bit counter nonces. Mutual identity authentication matches the
+reference's trust model (raw keypairs, no CA); the ephemeral DH gives
+forward secrecy like QUIC's TLS handshake.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Awaitable, Callable
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from .identity import Identity, RemoteIdentity
+
+PROTOCOL = b"/sdp2p/1"  # ref:quic/transport.rs:33
+MAX_RECORD = 1 << 20  # plaintext bytes per encrypted record
+
+
+class HandshakeError(Exception):
+    pass
+
+
+def _derive_keys(shared: bytes, transcript: bytes) -> tuple[bytes, bytes]:
+    okm = HKDF(
+        algorithm=hashes.SHA256(), length=64, salt=transcript, info=PROTOCOL
+    ).derive(shared)
+    return okm[:32], okm[32:]
+
+
+class EncryptedStream:
+    """One bidirectional encrypted stream (ref:stream.rs `UnicastStream`)."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        send_key: bytes,
+        recv_key: bytes,
+        remote_identity: RemoteIdentity,
+    ):
+        self._reader = reader
+        self._writer = writer
+        self._send = ChaCha20Poly1305(send_key)
+        self._recv = ChaCha20Poly1305(recv_key)
+        self._send_ctr = 0
+        self._recv_ctr = 0
+        self._recv_buf = bytearray()
+        self.remote_identity = remote_identity
+        self._closed = False
+
+    # --- raw byte API (wire.Reader/Writer plug in here) ---
+
+    async def write(self, data: bytes) -> None:
+        view = memoryview(data)
+        for off in range(0, max(len(view), 1), MAX_RECORD):
+            chunk = bytes(view[off : off + MAX_RECORD])
+            nonce = struct.pack(">IQ", 0, self._send_ctr)
+            self._send_ctr += 1
+            ct = self._send.encrypt(nonce, chunk, None)
+            self._writer.write(struct.pack(">I", len(ct)) + ct)
+        await self._writer.drain()
+
+    async def read_exact(self, n: int) -> bytes:
+        while len(self._recv_buf) < n:
+            hdr = await self._reader.readexactly(4)
+            (length,) = struct.unpack(">I", hdr)
+            if length > MAX_RECORD + 16:
+                raise ValueError("oversized record")
+            ct = await self._reader.readexactly(length)
+            nonce = struct.pack(">IQ", 0, self._recv_ctr)
+            self._recv_ctr += 1
+            self._recv_buf += self._recv.decrypt(nonce, ct, None)
+        out = bytes(self._recv_buf[:n])
+        del self._recv_buf[:n]
+        return out
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._writer.close()
+            await self._writer.wait_closed()
+        except Exception:
+            pass
+
+    @property
+    def peer_addr(self) -> tuple[str, int] | None:
+        try:
+            return self._writer.get_extra_info("peername")[:2]
+        except Exception:
+            return None
+
+
+async def _client_handshake(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    identity: Identity,
+    expect: RemoteIdentity | None,
+) -> EncryptedStream:
+    eph = X25519PrivateKey.generate()
+    eph_pub = eph.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    my_ident = identity.to_remote_identity().to_bytes()
+    writer.write(PROTOCOL + eph_pub + my_ident)
+    await writer.drain()
+
+    srv = await reader.readexactly(32 + 32 + 64)
+    srv_eph, srv_ident_raw, srv_sig = srv[:32], srv[32:64], srv[64:]
+    srv_ident = RemoteIdentity(srv_ident_raw)
+    transcript = PROTOCOL + eph_pub + my_ident + srv_eph + srv_ident_raw
+    if not srv_ident.verify(srv_sig, transcript + b"server"):
+        raise HandshakeError("server signature invalid")
+    if expect is not None and srv_ident != expect:
+        raise HandshakeError(f"unexpected peer identity {srv_ident}")
+
+    writer.write(identity.sign(transcript + b"client"))
+    await writer.drain()
+
+    shared = eph.exchange(X25519PublicKey.from_public_bytes(srv_eph))
+    c2s, s2c = _derive_keys(shared, transcript)
+    return EncryptedStream(reader, writer, c2s, s2c, srv_ident)
+
+
+async def _server_handshake(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    identity: Identity,
+) -> EncryptedStream:
+    hello = await reader.readexactly(len(PROTOCOL) + 32 + 32)
+    if hello[: len(PROTOCOL)] != PROTOCOL:
+        raise HandshakeError("bad protocol magic")
+    cli_eph = hello[len(PROTOCOL) : len(PROTOCOL) + 32]
+    cli_ident_raw = hello[len(PROTOCOL) + 32 :]
+    cli_ident = RemoteIdentity(cli_ident_raw)
+
+    eph = X25519PrivateKey.generate()
+    eph_pub = eph.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    my_ident = identity.to_remote_identity().to_bytes()
+    transcript = PROTOCOL + cli_eph + cli_ident_raw + eph_pub + my_ident
+    writer.write(eph_pub + my_ident + identity.sign(transcript + b"server"))
+    await writer.drain()
+
+    cli_sig = await reader.readexactly(64)
+    if not cli_ident.verify(cli_sig, transcript + b"client"):
+        raise HandshakeError("client signature invalid")
+
+    shared = eph.exchange(X25519PublicKey.from_public_bytes(cli_eph))
+    c2s, s2c = _derive_keys(shared, transcript)
+    return EncryptedStream(reader, writer, s2c, c2s, cli_ident)
+
+
+class Listener:
+    """Bound accept socket handing each authenticated stream to
+    `on_stream` (ref:transport.rs incoming-stream task)."""
+
+    def __init__(self, server: asyncio.base_events.Server, port: int):
+        self._server = server
+        self.port = port
+
+    async def close(self) -> None:
+        self._server.close()
+        await self._server.wait_closed()
+
+
+async def listen(
+    identity: Identity,
+    on_stream: Callable[[EncryptedStream], Awaitable[None]],
+    host: str = "0.0.0.0",
+    port: int = 0,
+) -> Listener:
+    async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            stream = await _server_handshake(reader, writer, identity)
+        except (HandshakeError, asyncio.IncompleteReadError, OSError):
+            writer.close()
+            return
+        try:
+            await on_stream(stream)
+        finally:
+            await stream.close()
+
+    server = await asyncio.start_server(handle, host, port)
+    bound = server.sockets[0].getsockname()[1]
+    return Listener(server, bound)
+
+
+async def connect(
+    addr: tuple[str, int],
+    identity: Identity,
+    expect: RemoteIdentity | None = None,
+    timeout: float = 10.0,
+) -> EncryptedStream:
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(addr[0], addr[1]), timeout
+    )
+    try:
+        return await asyncio.wait_for(
+            _client_handshake(reader, writer, identity, expect), timeout
+        )
+    except BaseException:
+        writer.close()
+        raise

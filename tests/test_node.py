@@ -78,6 +78,10 @@ def test_node_config_migration_v1(tmp_path):
     mgr = ConfigManager(tmp_path)
     assert mgr.config.version == 2
     assert mgr.config.features == []  # added by the v1→v2 migration
+    # defaults minted at load (identity keypair) are persisted — stable
+    # across restarts, not regenerated every boot
+    mgr2 = ConfigManager(tmp_path)
+    assert mgr2.config.identity.to_bytes() == mgr.config.identity.to_bytes()
 
 
 # --- actors --------------------------------------------------------------
@@ -104,6 +108,11 @@ def test_actors_declare_start_stop_restart():
         assert not actors.is_running("ticker")
         assert actors.restart("ticker")
         assert actors.states() == {"ticker": True}
+        # restart while RUNNING must hand the name to a fresh task
+        before = len(ticks)
+        assert actors.restart("ticker")
+        await asyncio.sleep(0.05)
+        assert actors.is_running("ticker") and len(ticks) > before
         await actors.shutdown()
 
     asyncio.run(run())
@@ -142,6 +151,11 @@ def test_preferences_roundtrip():
     assert out["location"] == doc["location"]
     clear_preference(db, "location")
     assert "location" not in read_preferences(db)
+    # a key may flip between leaf and subtree without corrupting reads
+    write_preferences(db, {"theme": {"mode": "system"}})
+    assert read_preferences(db)["theme"] == {"mode": "system"}
+    write_preferences(db, {"theme": "dark"})
+    assert read_preferences(db)["theme"] == "dark"
 
 
 # --- notifications -------------------------------------------------------
