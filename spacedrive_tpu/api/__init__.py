@@ -1,0 +1,24 @@
+"""API layer: typed router, normalized cache, invalidation, HTTP host.
+
+Parity: ref:core/src/api (rspc router + CoreEvent + invalidation),
+crates/cache (normalised results), core/src/custom_uri (file and
+thumbnail serving), apps/server (Axum host).
+"""
+
+from .cache import normalise, normalise_one
+from .invalidate import InvalidateOperation, invalidate_query
+from .namespaces import mount
+from .router import CoreEventKind, Router, RspcError
+from .server import ApiServer
+
+__all__ = [
+    "ApiServer",
+    "CoreEventKind",
+    "InvalidateOperation",
+    "Router",
+    "RspcError",
+    "invalidate_query",
+    "mount",
+    "normalise",
+    "normalise_one",
+]

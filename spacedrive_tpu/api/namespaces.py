@@ -1,0 +1,936 @@
+"""api::mount() — every procedure namespace.
+
+Parity: ref:core/src/api/mod.rs:197-218 — the namespace list mirrors
+the reference router merge order: buildInfo/nodeState root procedures,
+then library, locations (incl. indexer rules), files, ephemeralFiles,
+jobs, search (+ saved searches), tags, labels, sync, cloud, p2p, nodes,
+volumes, preferences, notifications, backups, auth, models,
+invalidation. Handlers are (node[, library][, arg]) per router.py;
+mutations fire `invalidate_query` exactly where the reference does.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import uuid
+from typing import Any, AsyncIterator
+
+from ..db.database import blob_u64, new_pub_id, now_iso
+from ..node.config import BackendFeature
+from ..node.preferences import read_preferences, write_preferences
+from ..node.statistics import get_statistics, update_statistics
+from ..node.volumes import get_volumes, save_volumes
+from ..node.notifications import Notifications
+from .cache import normalise, normalise_one
+from .invalidate import install_registry, invalidate_query
+from .router import CoreEventKind, Router, RspcError
+from .search import search_objects, search_paths
+
+VERSION = "0.1.0"
+
+
+def mount() -> Router:
+    """Build the full router (ref:api/mod.rs:124 `mount`)."""
+    r = Router()
+    _root(r)
+    _library(r)
+    _locations(r)
+    _files(r)
+    _ephemeral(r)
+    _jobs(r)
+    _search(r)
+    _tags(r)
+    _labels(r)
+    _sync(r)
+    _p2p(r)
+    _nodes(r)
+    _volumes(r)
+    _preferences(r)
+    _notifications(r)
+    _backups(r)
+    _auth(r)
+    _models(r)
+    _invalidation(r)
+    install_registry(r)
+    return r
+
+
+# --- root ----------------------------------------------------------------
+
+
+def _root(r: Router) -> None:
+    @r.query("buildInfo")
+    def build_info(node):
+        return {"version": VERSION, "commit": "tpu-native"}
+
+    @r.query("nodeState")
+    def node_state(node):
+        cfg = node.config.config
+        import jax
+
+        return {
+            "id": str(cfg.id),
+            "name": cfg.name,
+            "identity": str(cfg.identity.to_remote_identity()),
+            "data_path": node.data_dir,
+            "p2p": cfg.p2p.to_dict(),
+            "features": [f.value for f in cfg.features],
+            "device_model": jax.devices()[0].device_kind if jax.devices() else "cpu",
+            "image_labeler_version": cfg.image_labeler_version,
+        }
+
+    @r.mutation("toggleFeatureFlag")
+    def toggle_feature(node, arg):
+        feature = BackendFeature(arg["feature"])
+        node.toggle_feature(feature, bool(arg["enabled"]))
+        invalidate_query(node, "nodeState")
+        return node.is_feature_enabled(feature)
+
+
+# --- library -------------------------------------------------------------
+
+
+def _library(r: Router) -> None:
+    @r.query("library.list")
+    def list_libraries(node):
+        return [
+            {
+                "uuid": str(lib.id),
+                "config": lib.config.to_dict(),
+                "instance_id": lib.config.instance_id,
+                "instance_public_key": str(lib.instance_uuid),
+            }
+            for lib in node.libraries.libraries.values()
+        ]
+
+    @r.query("library.statistics", library=True)
+    def statistics(node, library):
+        update_statistics(library.db, node.thumbnailer.data_dir)
+        return get_statistics(library.db)
+
+    @r.mutation("library.create")
+    async def create(node, arg):
+        lib = await node.create_library(
+            arg["name"], arg.get("description", "")
+        )
+        invalidate_query(node, "library.list")
+        return {"uuid": str(lib.id), "config": lib.config.to_dict()}
+
+    @r.mutation("library.edit")
+    def edit(node, arg):
+        lib = node.libraries.get(uuid.UUID(arg["id"]))
+        if lib is None:
+            raise RspcError.not_found("library")
+        if "name" in arg:
+            lib.config.name = arg["name"]
+        if "description" in arg:
+            lib.config.description = arg["description"]
+        node.libraries.save_config(lib)
+        invalidate_query(node, "library.list")
+        return None
+
+    @r.mutation("library.delete")
+    async def delete(node, arg):
+        lib_id = uuid.UUID(arg if isinstance(arg, str) else arg["id"])
+        await node.close_library(lib_id)  # stop actors/jobs before rm
+        node.libraries.delete(lib_id)
+        invalidate_query(node, "library.list")
+        return None
+
+
+# --- locations -----------------------------------------------------------
+
+
+def _locations(r: Router) -> None:
+    from ..location.indexer.rules import (
+        IndexerRule,
+        RuleKind,
+        RulePerKind,
+        load_rules_for_location,
+    )
+    from ..location.locations import (
+        LocationCreateArgs,
+        light_scan_location,
+        relink_location,
+        scan_location,
+    )
+
+    @r.query("locations.list", library=True)
+    def list_locations(node, library):
+        return normalise("location", library.db.find("location"))
+
+    @r.query("locations.get", library=True)
+    def get_location(node, library, arg):
+        row = library.db.find_one("location", id=int(arg))
+        if row is None:
+            raise RspcError.not_found("location")
+        return normalise_one("location", row)
+
+    @r.mutation("locations.create", library=True)
+    async def create(node, library, arg):
+        args = LocationCreateArgs(
+            path=arg["path"],
+            name=arg.get("name"),
+            dry_run=bool(arg.get("dry_run", False)),
+            indexer_rules_ids=arg.get("indexer_rules_ids", []),
+        )
+        loc = args.create(library)
+        if loc is None:
+            return None
+        await scan_location(library, loc, node.jobs)
+        invalidate_query(node, "locations.list", library)
+        return loc["id"]
+
+    @r.mutation("locations.update", library=True)
+    def update(node, library, arg):
+        fields = {
+            k: arg[k] for k in ("name", "hidden", "sync_preview_media") if k in arg
+        }
+        if fields:
+            library.db.update("location", {"id": int(arg["id"])}, **fields)
+        if "indexer_rules_ids" in arg:
+            library.db.delete("indexer_rule_in_location", location_id=int(arg["id"]))
+            for rid in arg["indexer_rules_ids"]:
+                library.db.insert(
+                    "indexer_rule_in_location",
+                    location_id=int(arg["id"]),
+                    indexer_rule_id=int(rid),
+                )
+        invalidate_query(node, "locations.list", library)
+        return None
+
+    @r.mutation("locations.delete", library=True)
+    def delete(node, library, arg):
+        loc_id = int(arg)
+        with library.db.transaction() as conn:
+            conn.execute(
+                "DELETE FROM indexer_rule_in_location WHERE location_id = ?",
+                (loc_id,),
+            )
+            conn.execute("DELETE FROM file_path WHERE location_id = ?", (loc_id,))
+            conn.execute("DELETE FROM location WHERE id = ?", (loc_id,))
+        invalidate_query(node, "locations.list", library)
+        return None
+
+    @r.mutation("locations.fullRescan", library=True)
+    async def full_rescan(node, library, arg):
+        loc = library.db.find_one("location", id=int(arg["location_id"]))
+        if loc is None:
+            raise RspcError.not_found("location")
+        await scan_location(library, loc, node.jobs)
+        return None
+
+    @r.mutation("locations.subPathRescan", library=True)
+    async def sub_path_rescan(node, library, arg):
+        loc = library.db.find_one("location", id=int(arg["location_id"]))
+        if loc is None:
+            raise RspcError.not_found("location")
+        await light_scan_location(library, loc, arg.get("sub_path", "/"), node.jobs)
+        return None
+
+    @r.mutation("locations.relink", library=True)
+    def relink(node, library, arg):
+        return relink_location(library, arg["path"])
+
+    # indexer rules sub-namespace (ref:api/locations.rs indexer_rules)
+    @r.query("locations.indexerRules.list", library=True)
+    def rules_list(node, library):
+        rows = library.db.find("indexer_rule")
+        return [
+            {
+                "id": row["id"],
+                "name": row["name"],
+                "default": bool(row["default"]),
+                "date_created": row["date_created"],
+            }
+            for row in rows
+        ]
+
+    @r.query("locations.indexerRules.listForLocation", library=True)
+    def rules_for_location(node, library, arg):
+        return [rule.name for rule in load_rules_for_location(library.db, int(arg))]
+
+    @r.mutation("locations.indexerRules.create", library=True)
+    def rules_create(node, library, arg):
+        kind = RuleKind[arg["kind"]] if isinstance(arg["kind"], str) else RuleKind(arg["kind"])
+        rule = IndexerRule(
+            pub_id=new_pub_id(),
+            name=arg["name"],
+            default=False,
+            rules=[RulePerKind(kind=kind, parameters=list(arg["parameters"]))],
+        )
+        rid = library.db.insert(
+            "indexer_rule",
+            pub_id=rule.pub_id,
+            name=rule.name,
+            rules_per_kind=rule.serialize_rules(),
+            date_created=now_iso(),
+            date_modified=now_iso(),
+            **{"default": 0},
+        )
+        invalidate_query(node, "locations.indexerRules.list", library)
+        return rid
+
+    @r.mutation("locations.indexerRules.delete", library=True)
+    def rules_delete(node, library, arg):
+        row = library.db.find_one("indexer_rule", id=int(arg))
+        if row and row["default"]:
+            raise RspcError.bad_request("cannot delete a system rule")
+        library.db.delete("indexer_rule_in_location", indexer_rule_id=int(arg))
+        library.db.delete("indexer_rule", id=int(arg))
+        invalidate_query(node, "locations.indexerRules.list", library)
+        return None
+
+
+# --- files ---------------------------------------------------------------
+
+
+def _files(r: Router) -> None:
+    from ..jobs.manager import JobBuilder
+    from ..object.fs.copy import FileCopierJob
+    from ..object.fs.cut import FileCutterJob
+    from ..object.fs.delete import FileDeleterJob
+    from ..object.fs.erase import FileEraserJob
+    from ..object.validation.job import ObjectValidatorJob
+
+    @r.query("files.get", library=True)
+    def get_file(node, library, arg):
+        row = library.db.find_one("file_path", id=int(arg["id"]))
+        if row is None:
+            raise RspcError.not_found("file_path")
+        row["size_in_bytes"] = blob_u64(row.pop("size_in_bytes_bytes", None)) or 0
+        obj = (
+            library.db.find_one("object", id=row["object_id"])
+            if row["object_id"]
+            else None
+        )
+        out = normalise_one("file_path", row)
+        out["object"] = obj and {k: v.hex() if isinstance(v, bytes) else v for k, v in obj.items()}
+        return out
+
+    @r.mutation("files.setNote", library=True)
+    def set_note(node, library, arg):
+        _object_update(node, library, int(arg["id"]), note=arg.get("note"))
+        return None
+
+    @r.mutation("files.setFavorite", library=True)
+    def set_favorite(node, library, arg):
+        _object_update(node, library, int(arg["id"]), favorite=int(bool(arg["favorite"])))
+        return None
+
+    @r.mutation("files.renameFile", library=True)
+    def rename(node, library, arg):
+        from ..files.isolated_path import full_path_from_db_row, separate_name_and_extension
+
+        row = library.db.find_one("file_path", id=int(arg["id"]))
+        if row is None:
+            raise RspcError.not_found("file_path")
+        loc = library.db.find_one("location", id=row["location_id"])
+        old_path = full_path_from_db_row(loc["path"], row)
+        new_name = arg["new_name"]
+        new_path = os.path.join(os.path.dirname(old_path), new_name)
+        if os.path.exists(new_path):
+            raise RspcError.bad_request("target name already exists")
+        os.rename(old_path, new_path)
+        name, ext = separate_name_and_extension(new_name)
+        rid = row["pub_id"].hex()
+        ops = [
+            library.sync.shared_update("file_path", rid, "name", name),
+            library.sync.shared_update("file_path", rid, "extension", ext),
+        ]
+        library.sync.write_ops(
+            ops,
+            lambda conn: conn.execute(
+                "UPDATE file_path SET name = ?, extension = ?, date_modified = ? "
+                "WHERE id = ?",
+                (name, ext, now_iso(), row["id"]),
+            ),
+        )
+        invalidate_query(node, "search.paths", library)
+        return None
+
+    @r.mutation("files.deleteFiles", library=True)
+    async def delete_files(node, library, arg):
+        await JobBuilder(
+            FileDeleterJob(
+                {
+                    "location_id": int(arg["location_id"]),
+                    "file_path_ids": [int(i) for i in arg["file_path_ids"]],
+                }
+            )
+        ).spawn(node.jobs, library)
+        return None
+
+    @r.mutation("files.eraseFiles", library=True)
+    async def erase_files(node, library, arg):
+        await JobBuilder(
+            FileEraserJob(
+                {
+                    "location_id": int(arg["location_id"]),
+                    "file_path_ids": [int(i) for i in arg["file_path_ids"]],
+                    "passes": int(arg.get("passes", 1)),
+                }
+            )
+        ).spawn(node.jobs, library)
+        return None
+
+    @r.mutation("files.copyFiles", library=True)
+    async def copy_files(node, library, arg):
+        await JobBuilder(FileCopierJob(dict(arg))).spawn(node.jobs, library)
+        return None
+
+    @r.mutation("files.cutFiles", library=True)
+    async def cut_files(node, library, arg):
+        await JobBuilder(FileCutterJob(dict(arg))).spawn(node.jobs, library)
+        return None
+
+    @r.mutation("files.validate", library=True)
+    async def validate(node, library, arg):
+        await JobBuilder(ObjectValidatorJob(dict(arg))).spawn(node.jobs, library)
+        return None
+
+
+def _object_update(node: Any, library: Any, file_path_id: int, **fields: Any) -> None:
+    row = library.db.find_one("file_path", id=file_path_id)
+    if row is None or not row["object_id"]:
+        raise RspcError.not_found("object for file_path")
+    library.db.update("object", {"id": row["object_id"]}, **fields)
+    invalidate_query(node, "search.objects", library)
+
+
+# --- ephemeralFiles ------------------------------------------------------
+
+
+def _ephemeral(r: Router) -> None:
+    @r.query("ephemeralFiles.list")
+    async def list_dir(node, arg):
+        """Non-indexed browse (ref:core/src/location/non_indexed.rs);
+        hashing/stat work runs off the event loop."""
+        from ..location.non_indexed import walk_dir
+
+        return await asyncio.to_thread(
+            walk_dir, node, arg["path"], with_hidden=bool(arg.get("with_hidden", False))
+        )
+
+
+# --- jobs ----------------------------------------------------------------
+
+
+def _jobs(r: Router) -> None:
+    from ..jobs.report import JobReport, JobStatus
+
+    @r.query("jobs.reports", library=True)
+    def reports(node, library):
+        rows = library.db.query(
+            "SELECT * FROM job ORDER BY date_created DESC LIMIT 100"
+        )
+        out = []
+        for row in rows:
+            rep = JobReport.from_row(row)
+            out.append(
+                {
+                    "id": str(rep.id),
+                    "name": rep.name,
+                    "action": rep.action,
+                    "status": rep.status.name,
+                    "task_count": rep.task_count,
+                    "completed_task_count": rep.completed_task_count,
+                    "errors": rep.errors_text,
+                    "created_at": rep.created_at,
+                    "completed_at": rep.completed_at,
+                    "parent_id": str(rep.parent_id) if rep.parent_id else None,
+                }
+            )
+        return out
+
+    @r.query("jobs.isActive", library=True)
+    def is_active(node, library):
+        return bool(node.jobs._active)
+
+    @r.mutation("jobs.pause")
+    async def pause(node, arg):
+        await node.jobs.pause(uuid.UUID(arg))
+        return None
+
+    @r.mutation("jobs.resume")
+    async def resume(node, arg):
+        await node.jobs.resume(uuid.UUID(arg))
+        return None
+
+    @r.mutation("jobs.cancel")
+    async def cancel(node, arg):
+        await node.jobs.cancel(uuid.UUID(arg))
+        return None
+
+    @r.mutation("jobs.clear", library=True)
+    def clear(node, library, arg):
+        library.db.delete("job", id=uuid.UUID(arg).bytes)
+        invalidate_query(node, "jobs.reports", library)
+        return None
+
+    @r.mutation("jobs.clearAll", library=True)
+    def clear_all(node, library):
+        library.db.execute(
+            "DELETE FROM job WHERE status NOT IN (?, ?)",
+            (int(JobStatus.RUNNING), int(JobStatus.PAUSED)),
+        )
+        invalidate_query(node, "jobs.reports", library)
+        return None
+
+    @r.subscription("jobs.progress", library=True)
+    async def progress(node, library) -> AsyncIterator[Any]:
+        async for event in _bus_events(node):
+            if (
+                isinstance(event, tuple)
+                and event[0] == CoreEventKind.JOB_PROGRESS
+            ):
+                yield event[1]
+
+
+# --- search --------------------------------------------------------------
+
+
+def _search(r: Router) -> None:
+    @r.query("search.paths", library=True)
+    def paths(node, library, arg):
+        return search_paths(library, arg)
+
+    @r.query("search.objects", library=True)
+    def objects(node, library, arg):
+        return search_objects(library, arg)
+
+    @r.query("search.saved.list", library=True)
+    def saved_list(node, library):
+        return normalise("saved_search", library.db.find("saved_search"))
+
+    @r.mutation("search.saved.create", library=True)
+    def saved_create(node, library, arg):
+        sid = library.db.insert(
+            "saved_search",
+            pub_id=new_pub_id(),
+            name=arg.get("name"),
+            search=arg.get("search"),
+            filters=arg.get("filters"),
+            icon=arg.get("icon"),
+            description=arg.get("description"),
+            date_created=now_iso(),
+            date_modified=now_iso(),
+        )
+        invalidate_query(node, "search.saved.list", library)
+        return sid
+
+    @r.mutation("search.saved.delete", library=True)
+    def saved_delete(node, library, arg):
+        library.db.delete("saved_search", id=int(arg))
+        invalidate_query(node, "search.saved.list", library)
+        return None
+
+
+# --- tags ----------------------------------------------------------------
+
+
+def _tags(r: Router) -> None:
+    @r.query("tags.list", library=True)
+    def list_tags(node, library):
+        return normalise("tag", library.db.find("tag"))
+
+    @r.query("tags.getForObject", library=True)
+    def for_object(node, library, arg):
+        rows = library.db.query(
+            "SELECT t.* FROM tag t JOIN tag_on_object tobj ON tobj.tag_id = t.id "
+            "WHERE tobj.object_id = ?",
+            (int(arg),),
+        )
+        return normalise("tag", rows)
+
+    @r.mutation("tags.create", library=True)
+    def create(node, library, arg):
+        tid = library.db.insert(
+            "tag",
+            pub_id=new_pub_id(),
+            name=arg["name"],
+            color=arg.get("color"),
+            date_created=now_iso(),
+            date_modified=now_iso(),
+        )
+        invalidate_query(node, "tags.list", library)
+        return tid
+
+    @r.mutation("tags.update", library=True)
+    def update(node, library, arg):
+        fields = {k: arg[k] for k in ("name", "color") if k in arg}
+        library.db.update("tag", {"id": int(arg["id"])}, **fields)
+        invalidate_query(node, "tags.list", library)
+        return None
+
+    @r.mutation("tags.delete", library=True)
+    def delete(node, library, arg):
+        library.db.delete("tag_on_object", tag_id=int(arg))
+        library.db.delete("tag", id=int(arg))
+        invalidate_query(node, "tags.list", library)
+        return None
+
+    @r.mutation("tags.assign", library=True)
+    def assign(node, library, arg):
+        tag_id = int(arg["tag_id"])
+        for oid in arg["object_ids"]:
+            if arg.get("unassign"):
+                library.db.delete("tag_on_object", tag_id=tag_id, object_id=int(oid))
+            else:
+                library.db.upsert(
+                    "tag_on_object",
+                    {"tag_id": tag_id, "object_id": int(oid)},
+                    date_created=now_iso(),
+                )
+        invalidate_query(node, "tags.getForObject", library)
+        return None
+
+
+# --- labels --------------------------------------------------------------
+
+
+def _labels(r: Router) -> None:
+    @r.query("labels.list", library=True)
+    def list_labels(node, library):
+        return normalise("label", library.db.find("label"))
+
+    @r.query("labels.getForObject", library=True)
+    def for_object(node, library, arg):
+        rows = library.db.query(
+            "SELECT l.* FROM label l JOIN label_on_object lo ON lo.label_id = l.id "
+            "WHERE lo.object_id = ?",
+            (int(arg),),
+        )
+        return normalise("label", rows)
+
+    @r.query("labels.getWithObjects", library=True)
+    def with_objects(node, library, arg):
+        if not arg:
+            return {}
+        rows = library.db.query(
+            "SELECT l.id AS label_id, lo.object_id FROM label l "
+            "JOIN label_on_object lo ON lo.label_id = l.id "
+            f"WHERE l.id IN ({','.join('?' * len(arg))})",
+            [int(i) for i in arg],
+        )
+        out: dict[int, list[int]] = {}
+        for row in rows:
+            out.setdefault(row["label_id"], []).append(row["object_id"])
+        return out
+
+    @r.mutation("labels.delete", library=True)
+    def delete(node, library, arg):
+        library.db.delete("label_on_object", label_id=int(arg))
+        library.db.delete("label", id=int(arg))
+        invalidate_query(node, "labels.list", library)
+        return None
+
+
+# --- sync ----------------------------------------------------------------
+
+
+def _sync(r: Router) -> None:
+    from ..sync.ingest import backfill_operations
+
+    @r.query("sync.enabled", library=True)
+    def enabled(node, library):
+        return library.sync.emit_messages
+
+    @r.query("sync.messages", library=True)
+    def messages(node, library, arg):
+        count = int((arg or {}).get("count", 100))
+        return [op.to_wire() for op in library.sync.get_ops(count=count)]
+
+    @r.mutation("sync.backfill", library=True)
+    def backfill(node, library):
+        return backfill_operations(library.sync)
+
+    @r.subscription("sync.newMessage", library=True)
+    async def new_message(node, library) -> AsyncIterator[Any]:
+        async for event in _bus_events_for(library.event_bus):
+            if event == ("SyncMessage", "Created") or event == (
+                "SyncMessage",
+                "Ingested",
+            ):
+                yield event[1]
+
+
+# --- p2p -----------------------------------------------------------------
+
+
+def _p2p(r: Router) -> None:
+    @r.query("p2p.state")
+    def state(node):
+        if node.p2p is None:
+            return {"enabled": False, "peers": []}
+        return {
+            "enabled": True,
+            "port": node.p2p.port,
+            "identity": str(node.p2p.p2p.remote_identity),
+            "peers": [
+                {
+                    "identity": str(p.identity),
+                    "metadata": p.metadata,
+                    "addrs": sorted(f"{h}:{pt}" for h, pt in p.addrs),
+                    "connected": p.is_connected,
+                }
+                for p in node.p2p.p2p.peers.values()
+            ],
+        }
+
+    @r.mutation("p2p.spacedrop")
+    async def spacedrop(node, arg):
+        from ..p2p.identity import RemoteIdentity
+
+        if node.p2p is None:
+            raise RspcError.bad_request("p2p disabled")
+        drop_id = await node.p2p.spacedrop.send(
+            RemoteIdentity.from_str(arg["identity"]), list(arg["file_paths"])
+        )
+        return str(drop_id)
+
+    def _require_p2p(node):
+        if node.p2p is None:
+            raise RspcError.bad_request("p2p disabled")
+        return node.p2p
+
+    @r.mutation("p2p.acceptSpacedrop")
+    def accept(node, arg):
+        ok = _require_p2p(node).spacedrop.accept(
+            uuid.UUID(arg["id"]), arg.get("target_dir")
+        )
+        if not ok:
+            raise RspcError.not_found("spacedrop request")
+        return None
+
+    @r.mutation("p2p.cancelSpacedrop")
+    def cancel(node, arg):
+        _require_p2p(node).spacedrop.cancel(uuid.UUID(arg))
+        return None
+
+    @r.mutation("p2p.rejectSpacedrop")
+    def reject(node, arg):
+        _require_p2p(node).spacedrop.reject(uuid.UUID(arg))
+        return None
+
+    @r.subscription("p2p.events")
+    async def events(node) -> AsyncIterator[Any]:
+        if node.p2p is None:
+            return
+        async for event in _bus_events_for(node.p2p.p2p.events):
+            kind = event[0] if isinstance(event, tuple) else None
+            if kind in ("PeerDiscovered", "PeerExpired", "PeerConnected", "PeerDisconnected"):
+                yield {"kind": kind, "identity": str(event[1])}
+
+
+# --- nodes / volumes / preferences / notifications -----------------------
+
+
+def _nodes(r: Router) -> None:
+    @r.mutation("nodes.edit")
+    def edit(node, arg):
+        if arg.get("name"):
+            node.config.update(name=arg["name"])
+        if "p2p_enabled" in arg:
+            node.config.config.p2p.enabled = bool(arg["p2p_enabled"])
+            node.config.save()
+        invalidate_query(node, "nodeState")
+        return None
+
+    @r.mutation("nodes.updateThumbnailerPreferences")
+    def thumbnailer_prefs(node, arg):
+        node.thumbnailer.set_background_percentage(
+            int(arg.get("background_processing_percentage", 50))
+        )
+        return None
+
+
+def _volumes(r: Router) -> None:
+    @r.query("volumes.list")
+    def list_volumes(node):
+        return [v.to_dict() for v in get_volumes()]
+
+    @r.mutation("volumes.track", library=True)
+    def track(node, library):
+        return save_volumes(library.db)
+
+
+def _preferences(r: Router) -> None:
+    @r.query("preferences.get", library=True)
+    def get(node, library):
+        return read_preferences(library.db)
+
+    @r.mutation("preferences.update", library=True)
+    def update(node, library, arg):
+        write_preferences(library.db, arg or {})
+        invalidate_query(node, "preferences.get", library)
+        return None
+
+
+def _notifications(r: Router) -> None:
+    @r.query("notifications.get")
+    def get(node):
+        out = [
+            {"id": vars(n.id), "data": n.data, "read": n.read}
+            for n in node.notifications.list_node()
+        ]
+        for lib in node.libraries.libraries.values():
+            out.extend(
+                {"id": vars(n.id), "data": n.data, "read": n.read}
+                for n in Notifications.list_library(lib.db, str(lib.id))
+            )
+        return out
+
+    @r.mutation("notifications.dismiss", library=True)
+    def dismiss(node, library, arg):
+        Notifications.mark_read(library.db, int(arg))
+        return None
+
+    @r.mutation("notifications.dismissAll", library=True)
+    def dismiss_all(node, library):
+        library.db.execute("UPDATE notification SET read = 1")
+        return None
+
+    @r.subscription("notifications.listen")
+    async def listen(node) -> AsyncIterator[Any]:
+        async for event in _bus_events(node):
+            if isinstance(event, tuple) and event and event[0] == "notification":
+                n = event[1]
+                yield {"id": vars(n.id), "data": n.data}
+
+
+# --- backups -------------------------------------------------------------
+
+
+def _backups(r: Router) -> None:
+    import json
+    import shutil
+    import zipfile
+
+    def backups_dir(node) -> str:
+        d = os.path.join(node.data_dir, "backups")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    @r.query("backups.getAll")
+    def get_all(node):
+        out = []
+        for name in sorted(os.listdir(backups_dir(node))):
+            if not name.endswith(".zip"):
+                continue
+            path = os.path.join(backups_dir(node), name)
+            try:
+                with zipfile.ZipFile(path) as z:
+                    header = json.loads(z.read("header.json"))
+            except Exception:
+                continue
+            header["path"] = path
+            out.append(header)
+        return out
+
+    @r.mutation("backups.backup", library=True)
+    def backup(node, library):
+        """Zip the library DB + config with a header
+        (ref:core/src/api/backups.rs `start_backup`)."""
+        backup_id = str(uuid.uuid4())
+        path = os.path.join(backups_dir(node), f"{backup_id}.zip")
+        library.db.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        config_path, db_path = node.libraries.paths(library.id)
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr(
+                "header.json",
+                json.dumps(
+                    {
+                        "id": backup_id,
+                        "timestamp": now_iso(),
+                        "library_id": str(library.id),
+                        "library_name": library.name,
+                    }
+                ),
+            )
+            z.write(db_path, "library.db")
+            z.write(config_path, "library.sdlibrary")
+        return backup_id
+
+    @r.mutation("backups.restore")
+    async def restore(node, arg):
+        """ref:backups.rs `start_restore` — close, overwrite, reload."""
+        with zipfile.ZipFile(arg["path"]) as z:
+            header = json.loads(z.read("header.json"))
+            lib_id = uuid.UUID(header["library_id"])
+            await node.close_library(lib_id)  # full teardown, not just close
+            config_path, db_path = node.libraries.paths(lib_id)
+            for suffix in ("-wal", "-shm"):
+                if os.path.exists(db_path + suffix):
+                    os.remove(db_path + suffix)
+            with z.open("library.db") as src, open(db_path, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            with z.open("library.sdlibrary") as src, open(config_path, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+        lib = node.libraries.load(lib_id)
+        await node._init_library(lib)
+        invalidate_query(node, "library.list")
+        return str(lib_id)
+
+    @r.mutation("backups.delete")
+    def delete(node, arg):
+        path = arg["path"] if isinstance(arg, dict) else arg
+        if os.path.dirname(os.path.abspath(path)) != os.path.abspath(
+            backups_dir(node)
+        ):
+            raise RspcError.bad_request("not a backup path")
+        os.remove(path)
+        return None
+
+
+# --- auth / models / invalidation ---------------------------------------
+
+
+def _auth(r: Router) -> None:
+    @r.query("auth.me")
+    def me(node):
+        # cloud auth is an online service; offline deployments report logged-out
+        return None
+
+    @r.mutation("auth.logout")
+    def logout(node):
+        return None
+
+
+def _models(r: Router) -> None:
+    @r.query("models.imageDetection.list")
+    def list_models(node):
+        # ref:crates/ai image_labeler/model listing; one built-in JAX model
+        return ["labeler-net-v1"]
+
+
+def _invalidation(r: Router) -> None:
+    @r.subscription("invalidation.listen")
+    async def listen(node) -> AsyncIterator[Any]:
+        async for event in _bus_events(node):
+            if (
+                isinstance(event, tuple)
+                and event[0] == CoreEventKind.INVALIDATE_OPERATION
+            ):
+                yield event[1].to_wire()
+
+
+# --- helpers -------------------------------------------------------------
+
+
+async def _bus_events(node: Any) -> AsyncIterator[Any]:
+    async for event in _bus_events_for(node.event_bus):
+        yield event
+
+
+async def _bus_events_for(bus: Any) -> AsyncIterator[Any]:
+    """Bridge the thread-safe EventBus into an async stream."""
+    sub = bus.subscribe()
+    try:
+        while True:
+            for event in sub.poll():
+                yield event
+            await asyncio.sleep(0.02)
+    finally:
+        sub.close()

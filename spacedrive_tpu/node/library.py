@@ -148,6 +148,18 @@ class Libraries:
     def get(self, lib_id: uuid.UUID) -> Library | None:
         return self.libraries.get(lib_id)
 
+    def save_config(self, lib: Library) -> None:
+        """Persist a library's (possibly edited) config file."""
+        _config_vm.save(self._config_path(lib.id), lib.config.to_dict())
+
+    def paths(self, lib_id: uuid.UUID) -> tuple[str, str]:
+        """(config_path, db_path) on disk — the backup/restore surface."""
+        return self._config_path(lib_id), self._db_path(lib_id)
+
+    def load(self, lib_id: uuid.UUID) -> Library:
+        """(Re)load one library from disk (restore path)."""
+        return self._load(lib_id)
+
     def delete(self, lib_id: uuid.UUID) -> None:
         lib = self.libraries.pop(lib_id, None)
         if lib is not None:

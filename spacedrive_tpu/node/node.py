@@ -55,7 +55,10 @@ class Node:
         )
         self.use_device = use_device
         self.p2p: Any = None  # P2PManager, attached by start() when enabled
-        self.http: Any = None  # custom_uri server handle
+        self.http: Any = None  # ApiServer handle from start_api()
+        from ..api.namespaces import mount
+
+        self.router = mount()  # ref:lib.rs Node::new returns (node, router)
         self._started = False
 
     # --- identity ------------------------------------------------------
@@ -118,10 +121,40 @@ class Node:
             self.p2p.register_library(lib)
         return lib
 
+    async def close_library(self, lib_id: uuid.UUID) -> None:
+        """Tear down one loaded library: stop its actors, persist and stop
+        its jobs, close the DB, drop it from the registry (the per-library
+        half of shutdown(); used by delete/restore)."""
+        from ..jobs.manager import shutdown_jobs
+
+        lib = self.libraries.get(lib_id)
+        if lib is None:
+            return
+        await shutdown_jobs(self.jobs, lib)
+        remover = getattr(lib, "orphan_remover", None)
+        if remover is not None:
+            await remover.stop()
+        ingest = getattr(lib, "ingest", None)
+        if ingest is not None:
+            await ingest.stop()
+        lib.close()
+        self.libraries.libraries.pop(lib_id, None)
+
+    async def start_api(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Serve /rspc + custom-URI over HTTP (ref:apps/server/src/main.rs)."""
+        from ..api.server import ApiServer
+
+        self.http = ApiServer(self, self.router)
+        return await self.http.start(host, port)
+
     async def shutdown(self) -> None:
         """ref:lib.rs:240-250: stop jobs (persisting state), thumbnailer
         (persisting queues), actors, p2p, then close libraries."""
         from ..jobs.manager import shutdown_jobs
+
+        if self.http is not None:
+            await self.http.shutdown()
+            self.http = None
 
         for lib in list(self.libraries.libraries.values()):
             await shutdown_jobs(self.jobs, lib)

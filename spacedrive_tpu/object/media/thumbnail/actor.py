@@ -139,6 +139,12 @@ class Thumbnailer:
                 self._cond.notify_all()
 
     # ---- dispatch API (ref:actor.rs new_*_thumbnails_batch) ------------
+    def set_background_percentage(self, pct: int) -> None:
+        """Re-derive background parallelism from a percentage of cores
+        (ref:actor.rs:98 `background_processing_percentage` update)."""
+        cores = os.cpu_count() or 1
+        self._bg_parallelism = max(1, cores * max(0, min(100, pct)) // 100)
+
     def new_indexed_thumbnails_batch(
         self,
         library_id: str,

@@ -173,11 +173,21 @@ class Transfer:
         if len(sinks) != len(self.requests.requests):
             raise ValueError("sinks/requests length mismatch")
         w, r = Writer(stream), Reader(stream)
+        bs = self.requests.block_size.size
         for req, out in zip(self.requests.requests, sinks):
             _start, remaining = self._file_span(req)
             while remaining > 0:
                 _offset = await r.u64()
                 length = await r.u32()
+                # don't trust the sender: a block must be non-empty, within
+                # the negotiated block size, and within the advertised span
+                if length == 0 or length > bs or length > remaining:
+                    w.u8(1)
+                    await w.flush()
+                    raise ValueError(
+                        f"peer sent invalid block length {length} "
+                        f"(block_size={bs}, remaining={remaining})"
+                    )
                 data = await r.exact(length)
                 if self.cancelled.is_set():
                     w.u8(1)
