@@ -179,6 +179,7 @@ def _locations(r: Router) -> None:
         if loc is None:
             return None
         await scan_location(library, loc, node.jobs)
+        await node.location_manager.add(library, loc)
         invalidate_query(node, "locations.list", library)
         return loc["id"]
 
@@ -201,8 +202,9 @@ def _locations(r: Router) -> None:
         return None
 
     @r.mutation("locations.delete", library=True)
-    def delete(node, library, arg):
+    async def delete(node, library, arg):
         loc_id = int(arg)
+        await node.location_manager.remove(library, loc_id)
         with library.db.transaction() as conn:
             conn.execute(
                 "DELETE FROM indexer_rule_in_location WHERE location_id = ?",

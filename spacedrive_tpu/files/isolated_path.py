@@ -157,6 +157,14 @@ class IsolatedFilePathData:
         return self.relative_path
 
 
+def materialized_prefix(sub_path: str | None) -> str:
+    """Materialized-path prefix for a location-relative sub_path; root
+    ("", "/") is "/" so `LIKE prefix%` covers the whole location."""
+    if not sub_path or sub_path.strip("/") == "":
+        return "/"
+    return f"/{sub_path.strip('/')}/"
+
+
 def full_path_from_db_row(location_path: str | os.PathLike, row: dict) -> str:
     """Absolute path of a file_path DB row — the one canonical
     reconstruction used by every pipeline."""

@@ -103,6 +103,30 @@ async def scan_location(
     return await builder.spawn(job_manager, library)
 
 
+async def deep_rescan_sub_path(
+    library: Library,
+    location: dict[str, Any],
+    sub_path: str,
+    job_manager: JobManager,
+    *,
+    backend: str = "auto",
+) -> uuid.UUID:
+    """Full (recursive) rescan of one subtree — what a directory moved
+    into the location needs (a shallow scan of its parent would index
+    only the dir row, not its pre-existing contents)."""
+    from ..object.file_identifier.job import FileIdentifierJob
+    from ..object.media.job import MediaProcessorJob
+    from .indexer.job import IndexerJob
+
+    init = {"location_id": location["id"], "sub_path": sub_path}
+    builder = (
+        JobBuilder(IndexerJob(dict(init)))
+        .queue_next(FileIdentifierJob({**init, "backend": backend}))
+        .queue_next(MediaProcessorJob({**init, "backend": backend}))
+    )
+    return await builder.spawn(job_manager, library)
+
+
 async def light_scan_location(
     library: Library,
     location: dict[str, Any],

@@ -1,0 +1,258 @@
+"""Location manager — per-location watchers + event application.
+
+Parity: ref:core/src/location/manager/mod.rs:36-60 — an actor that
+(un)registers locations for watching, can pause/resume a location's
+watcher (used by fs-ops jobs to ignore their own writes), holds an
+ignore-path set, and applies normalized watcher events to the library
+DB (watcher/utils.rs, 1,072 LoC):
+
+- RENAME → rewrite the file_path row (and the whole subtree's
+  materialized_paths for directories) — precise, no rescan;
+- REMOVE → delete the row/subtree;
+- CREATE/MODIFY → debounced shallow rescan of the affected parent dirs
+  (`light_scan_location`), which batches the new/changed files into the
+  indexer → identifier (TPU cas_id) → media pipeline. The reference
+  applies per-file inline updates; routing through the shallow-scan job
+  chain instead keeps device work batched (§SURVEY.md 2.4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..db.database import now_iso
+from ..files.isolated_path import IsolatedFilePathData
+from .locations import deep_rescan_sub_path, light_scan_location
+from .watcher import EventKind, WatchEvent, new_watcher
+
+logger = logging.getLogger(__name__)
+
+DEBOUNCE = 0.2  # event settle window before shallow rescans fire
+
+
+@dataclass
+class _Watched:
+    library: Any
+    location: dict[str, Any]
+    watcher: Any
+    paused: int = 0  # pause() nesting depth
+    dirty_dirs: set[str] = field(default_factory=set)  # shallow rescan targets
+    deep_dirs: set[str] = field(default_factory=set)  # recursive rescan targets
+    flush_handle: Any = None
+
+
+class LocationManager:
+    """One per node (ref:manager/mod.rs `LocationManagerActor`)."""
+
+    def __init__(self, node: Any):
+        self.node = node
+        self._watched: dict[tuple[str, int], _Watched] = {}
+        self.ignore_paths: set[str] = set()
+        self.events_applied = 0
+
+    # --- registration (ref:manager/mod.rs:36-60) -----------------------
+
+    async def add(self, library: Any, location: dict[str, Any],
+                  *, force_polling: bool = False, poll_interval: float = 1.0) -> None:
+        key = (str(library.id), location["id"])
+        if key in self._watched or not os.path.isdir(location["path"]):
+            return
+        entry = _Watched(library=library, location=location, watcher=None)
+
+        def emit(event: WatchEvent, entry=entry):
+            return self._on_event(entry, event)
+
+        entry.watcher = new_watcher(
+            location["path"], emit,
+            force_polling=force_polling, poll_interval=poll_interval,
+        )
+        await entry.watcher.start_async()  # tree walk off the event loop
+        self._watched[key] = entry
+
+    async def remove(self, library: Any, location_id: int) -> None:
+        entry = self._watched.pop((str(library.id), location_id), None)
+        if entry is not None:
+            entry.watcher.stop()
+            if entry.flush_handle is not None:
+                entry.flush_handle.cancel()
+
+    def pause(self, library: Any, location_id: int) -> None:
+        """Temporarily ignore events (fs-ops jobs writing into the
+        location; ref:manager/mod.rs stop_watcher/reinit_watcher)."""
+        entry = self._watched.get((str(library.id), location_id))
+        if entry is not None:
+            entry.paused += 1
+
+    def resume(self, library: Any, location_id: int) -> None:
+        entry = self._watched.get((str(library.id), location_id))
+        if entry is not None and entry.paused > 0:
+            entry.paused -= 1
+
+    def is_watched(self, library: Any, location_id: int) -> bool:
+        return (str(library.id), location_id) in self._watched
+
+    async def shutdown(self) -> None:
+        for entry in self._watched.values():
+            entry.watcher.stop()
+            if entry.flush_handle is not None:
+                entry.flush_handle.cancel()
+        self._watched.clear()
+
+    # --- event application (ref:watcher/utils.rs) ----------------------
+
+    def _rel(self, entry: _Watched, path: str) -> str | None:
+        root = os.path.abspath(entry.location["path"])
+        ap = os.path.abspath(path)
+        if ap == root:
+            return ""
+        if not ap.startswith(root + os.sep):
+            return None
+        return ap[len(root) + 1 :]
+
+    def _ignored(self, path: str) -> bool:
+        ap = os.path.abspath(path)
+        return any(
+            ap == ig or ap.startswith(ig + os.sep) for ig in self.ignore_paths
+        )
+
+    async def _on_event(self, entry: _Watched, event: WatchEvent) -> None:
+        if entry.paused > 0 or self._ignored(event.path):
+            return
+        rel = self._rel(entry, event.path)
+        if rel is None:
+            return
+        rel = rel.replace(os.sep, "/")
+        self.events_applied += 1
+        db = entry.library.db
+        loc_id = entry.location["id"]
+        kind = event.kind
+        try:
+            if kind == EventKind.RENAME:
+                old_rel = self._rel(entry, event.old_path or "")
+                if old_rel is not None:
+                    old_rel = old_rel.replace(os.sep, "/")
+                    self._apply_rename(db, loc_id, old_rel, rel, event.is_dir)
+                    return
+                kind = EventKind.CREATE  # renamed in from outside = create
+            if kind == EventKind.REMOVE:
+                self._apply_remove(db, loc_id, rel, event.is_dir)
+                return
+            if kind == EventKind.MODIFY and rel == "" and event.is_dir:
+                # inotify queue overflow recovery: events were lost at
+                # unknown depths — full rescan, not a shallow root pass
+                entry.deep_dirs.add("/")
+            elif kind == EventKind.CREATE and event.is_dir:
+                # a dir moved/created with pre-existing contents emits no
+                # per-child events: recursively scan the dir itself
+                entry.deep_dirs.add("/" + rel.strip("/"))
+            else:
+                # CREATE/MODIFY file: shallow rescan of the parent batches
+                # new/changed files into the indexer→identifier pipeline
+                parent = os.path.dirname(rel)
+                entry.dirty_dirs.add("/" + parent.replace(os.sep, "/").strip("/"))
+            self._schedule_flush(entry)
+        except Exception:
+            logger.exception("watcher event application failed: %s", event)
+
+    def _apply_rename(
+        self, db: Any, loc_id: int, old_rel: str, new_rel: str, is_dir: bool
+    ) -> None:
+        old_iso = IsolatedFilePathData.from_relative_str(loc_id, old_rel, is_dir)
+        row = db.find_one(
+            "file_path",
+            location_id=loc_id,
+            materialized_path=old_iso.materialized_path,
+            name=old_iso.name,
+            is_dir=int(is_dir),
+        )
+        new_iso = IsolatedFilePathData.from_relative_str(loc_id, new_rel, is_dir)
+        if row is None:
+            return  # never indexed; the next rescan picks it up
+        db.update(
+            "file_path",
+            {"id": row["id"]},
+            materialized_path=new_iso.materialized_path,
+            name=new_iso.name,
+            extension=new_iso.extension,
+            date_modified=now_iso(),
+        )
+        if is_dir:
+            # rewrite the subtree's materialized paths (ref:utils.rs rename)
+            old_prefix = f"{old_iso.materialized_path}{old_iso.name}/"
+            new_prefix = f"{new_iso.materialized_path}{new_iso.name}/"
+            rows = db.query(
+                "SELECT id, materialized_path FROM file_path "
+                "WHERE location_id = ? AND substr(materialized_path, 1, ?) = ?",
+                (loc_id, len(old_prefix), old_prefix),
+            )
+            for child in rows:
+                db.update(
+                    "file_path",
+                    {"id": child["id"]},
+                    materialized_path=new_prefix
+                    + child["materialized_path"][len(old_prefix):],
+                )
+
+    def _apply_remove(self, db: Any, loc_id: int, rel: str, is_dir: bool) -> None:
+        # the event's is_dir can be unknowable post-deletion: try file then dir
+        for as_dir in ([is_dir] if is_dir else [False, True]):
+            iso = IsolatedFilePathData.from_relative_str(loc_id, rel, as_dir)
+            row = db.find_one(
+                "file_path",
+                location_id=loc_id,
+                materialized_path=iso.materialized_path,
+                name=iso.name,
+                is_dir=int(as_dir),
+            )
+            if row is None:
+                continue
+            if as_dir:
+                prefix = f"{iso.materialized_path}{iso.name}/"
+                db.execute(
+                    "DELETE FROM file_path WHERE location_id = ? "
+                    "AND substr(materialized_path, 1, ?) = ?",
+                    (loc_id, len(prefix), prefix),
+                )
+            db.delete("file_path", id=row["id"])
+            return
+
+    # --- debounced shallow rescan --------------------------------------
+
+    def _schedule_flush(self, entry: _Watched) -> None:
+        if entry.flush_handle is not None:
+            entry.flush_handle.cancel()
+        loop = asyncio.get_running_loop()
+        entry.flush_handle = loop.call_later(
+            DEBOUNCE, lambda: loop.create_task(self._flush(entry))
+        )
+
+    async def _flush(self, entry: _Watched) -> None:
+        dirs, entry.dirty_dirs = entry.dirty_dirs, set()
+        deep, entry.deep_dirs = entry.deep_dirs, set()
+        entry.flush_handle = None
+        # a deep scan of an ancestor covers shallow/deep scans below it
+        def covered(sub: str, by: str) -> bool:
+            return by == "/" or sub == by or sub.startswith(by.rstrip("/") + "/")
+
+        deep = {
+            d for d in deep if not any(covered(d, other) for other in deep if other != d)
+        }
+        dirs = {d for d in dirs if not any(covered(d, dd) for dd in deep)}
+        for sub in sorted(deep):
+            try:
+                await deep_rescan_sub_path(
+                    entry.library, entry.location, sub or "/", self.node.jobs
+                )
+            except Exception:
+                logger.exception("deep rescan of %r failed", sub)
+        for sub in sorted(dirs):
+            try:
+                await light_scan_location(
+                    entry.library, entry.location, sub or "/", self.node.jobs
+                )
+            except Exception:
+                logger.exception("shallow rescan of %r failed", sub)

@@ -48,6 +48,9 @@ class Node:
         self.jobs = JobManager(self.task_system)
         self.libraries = Libraries(self.data_dir, node=self)
         self.actors = Actors()
+        from ..location.manager import LocationManager
+
+        self.location_manager = LocationManager(self)
         self.thumbnailer = Thumbnailer(
             os.path.join(self.data_dir, "thumbnails"),
             event_bus=self.event_bus,
@@ -107,6 +110,9 @@ class Node:
         lib.node = self
         lib.orphan_remover = OrphanRemoverActor(lib.db)
         lib.orphan_remover.start()
+        self.location_manager.ignore_paths.add(self.thumbnailer.data_dir)
+        for loc in lib.db.find("location"):
+            await self.location_manager.add(lib, loc)
         await self.jobs.cold_resume(lib)
 
     async def create_library(self, name: str, description: str = "") -> Library:
@@ -162,6 +168,7 @@ class Node:
             if remover is not None:
                 await remover.stop()
         await self.thumbnailer.shutdown()
+        await self.location_manager.shutdown()
         await self.actors.shutdown()
         if self.p2p is not None:
             await self.p2p.shutdown()

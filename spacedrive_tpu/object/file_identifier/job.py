@@ -24,6 +24,7 @@ from typing import Any
 from ...db.database import blob_u64, escape_like, new_pub_id, now_iso
 from ...files.extensions import from_str as ext_from_str
 from ...files.isolated_path import full_path_from_db_row as _row_full_path
+from ...files.isolated_path import materialized_prefix
 from ...files.kind import ObjectKind
 from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
@@ -70,7 +71,7 @@ class FileIdentifierJob(StatefulJob):
         params: list[Any] = [loc_id]
         where = orphan_where_clause(self.init.get("sub_path") and self.init["sub_path"])
         if self.init.get("sub_path"):
-            params.append(escape_like(f"/{self.init['sub_path'].strip('/')}/") + "%")
+            params.append(escape_like(materialized_prefix(self.init['sub_path'])) + "%")
         total = library.db.count("file_path", where, tuple(params))
 
         self.data.update(
@@ -98,7 +99,7 @@ class FileIdentifierJob(StatefulJob):
         params: list[Any] = [d["location_id"]]
         where = orphan_where_clause(self.init.get("sub_path"))
         if self.init.get("sub_path"):
-            params.append(escape_like(f"/{self.init['sub_path'].strip('/')}/") + "%")
+            params.append(escape_like(materialized_prefix(self.init['sub_path'])) + "%")
         # cursor pagination by id (ref:file_identifier_job.rs:126-165)
         rows = library.db.query(
             f"SELECT * FROM file_path WHERE {where} AND id > ? ORDER BY id LIMIT ?",

@@ -15,6 +15,7 @@ from typing import Any
 
 from ...db.database import escape_like
 from ...files.isolated_path import full_path_from_db_row as _full_path
+from ...files.isolated_path import materialized_prefix
 from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
 from ...jobs.manager import register_job
@@ -53,7 +54,7 @@ class MediaProcessorJob(StatefulJob):
         params: list[Any] = [loc_id, *THUMBNAILABLE_EXTENSIONS]
         if self.init.get("sub_path"):
             sub_filter = " AND materialized_path LIKE ? ESCAPE '\\'"
-            params.append(escape_like(f"/{self.init['sub_path'].strip('/')}/") + "%")
+            params.append(escape_like(materialized_prefix(self.init['sub_path'])) + "%")
         rows = library.db.query(
             f"SELECT id, pub_id, cas_id, object_id, materialized_path, name, extension "
             f"FROM file_path WHERE location_id = ? AND is_dir = 0 "

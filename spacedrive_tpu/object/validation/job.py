@@ -16,7 +16,7 @@ from __future__ import annotations
 from typing import Any
 
 from ...db.database import escape_like
-from ...files.isolated_path import full_path_from_db_row
+from ...files.isolated_path import full_path_from_db_row, materialized_prefix
 from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
 from ...jobs.manager import register_job
@@ -39,7 +39,7 @@ class ObjectValidatorJob(StatefulJob):
         params: list[Any] = [self.init["location_id"]]
         if self.init.get("sub_path"):
             where += " AND materialized_path LIKE ? ESCAPE '\\'"
-            params.append(escape_like(f"/{self.init['sub_path'].strip('/')}/") + "%")
+            params.append(escape_like(materialized_prefix(self.init['sub_path'])) + "%")
         return where, params
 
     async def init_job(self, ctx: JobContext) -> None:
