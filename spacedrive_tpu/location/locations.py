@@ -83,6 +83,33 @@ class LocationCreateArgs:
         return library.db.find_one("location", id=loc_id)
 
 
+async def _spawn_scan_chain(
+    library: Library,
+    location: dict[str, Any],
+    job_manager: JobManager,
+    *,
+    sub_path: str | None = None,
+    shallow: bool = False,
+    backend: str = "auto",
+) -> uuid.UUID:
+    """The one Indexer → FileIdentifier → MediaProcessor chain every
+    scan variant spawns (ref:location/mod.rs:443-475 JobBuilder chain)."""
+    from ..object.file_identifier.job import FileIdentifierJob
+    from ..object.media.job import MediaProcessorJob
+    from .indexer.job import IndexerJob
+
+    init: dict[str, Any] = {"location_id": location["id"]}
+    if sub_path is not None:
+        init["sub_path"] = sub_path
+    indexer_init = {**init, "shallow": True} if shallow else dict(init)
+    builder = (
+        JobBuilder(IndexerJob(indexer_init))
+        .queue_next(FileIdentifierJob({**init, "backend": backend}))
+        .queue_next(MediaProcessorJob({**init, "backend": backend}))
+    )
+    return await builder.spawn(job_manager, library)
+
+
 async def scan_location(
     library: Library,
     location: dict[str, Any],
@@ -91,16 +118,7 @@ async def scan_location(
     backend: str = "auto",
 ) -> uuid.UUID:
     """Full scan job chain (ref:location/mod.rs:443-475)."""
-    from ..object.file_identifier.job import FileIdentifierJob
-    from ..object.media.job import MediaProcessorJob
-    from .indexer.job import IndexerJob
-
-    builder = (
-        JobBuilder(IndexerJob({"location_id": location["id"]}))
-        .queue_next(FileIdentifierJob({"location_id": location["id"], "backend": backend}))
-        .queue_next(MediaProcessorJob({"location_id": location["id"], "backend": backend}))
-    )
-    return await builder.spawn(job_manager, library)
+    return await _spawn_scan_chain(library, location, job_manager, backend=backend)
 
 
 async def deep_rescan_sub_path(
@@ -114,17 +132,9 @@ async def deep_rescan_sub_path(
     """Full (recursive) rescan of one subtree — what a directory moved
     into the location needs (a shallow scan of its parent would index
     only the dir row, not its pre-existing contents)."""
-    from ..object.file_identifier.job import FileIdentifierJob
-    from ..object.media.job import MediaProcessorJob
-    from .indexer.job import IndexerJob
-
-    init = {"location_id": location["id"], "sub_path": sub_path}
-    builder = (
-        JobBuilder(IndexerJob(dict(init)))
-        .queue_next(FileIdentifierJob({**init, "backend": backend}))
-        .queue_next(MediaProcessorJob({**init, "backend": backend}))
+    return await _spawn_scan_chain(
+        library, location, job_manager, sub_path=sub_path, backend=backend
     )
-    return await builder.spawn(job_manager, library)
 
 
 async def light_scan_location(
@@ -134,24 +144,9 @@ async def light_scan_location(
     job_manager: JobManager,
 ) -> uuid.UUID:
     """Shallow re-scan of one directory (ref:location/mod.rs:517)."""
-    from ..object.file_identifier.job import FileIdentifierJob
-    from ..object.media.job import MediaProcessorJob
-    from .indexer.job import IndexerJob
-
-    builder = (
-        JobBuilder(
-            IndexerJob(
-                {"location_id": location["id"], "sub_path": sub_path, "shallow": True}
-            )
-        )
-        .queue_next(
-            FileIdentifierJob({"location_id": location["id"], "sub_path": sub_path})
-        )
-        .queue_next(
-            MediaProcessorJob({"location_id": location["id"], "sub_path": sub_path})
-        )
+    return await _spawn_scan_chain(
+        library, location, job_manager, sub_path=sub_path, shallow=True
     )
-    return await builder.spawn(job_manager, library)
 
 
 def relink_location(library: Library, path: str) -> dict[str, Any] | None:

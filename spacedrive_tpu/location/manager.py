@@ -141,10 +141,11 @@ class LocationManager:
             if kind == EventKind.REMOVE:
                 self._apply_remove(db, loc_id, rel, event.is_dir)
                 return
-            if kind == EventKind.MODIFY and rel == "" and event.is_dir:
-                # inotify queue overflow recovery: events were lost at
-                # unknown depths — full rescan, not a shallow root pass
-                entry.deep_dirs.add("/")
+            if kind == EventKind.RESCAN:
+                # events were lost at unknown depths — full rescan
+                entry.deep_dirs.add("/" + rel.strip("/"))
+            elif kind == EventKind.MODIFY and rel == "" and event.is_dir:
+                return  # attrib touch on the location root: nothing to do
             elif kind == EventKind.CREATE and event.is_dir:
                 # a dir moved/created with pre-existing contents emits no
                 # per-child events: recursively scan the dir itself
@@ -167,6 +168,7 @@ class LocationManager:
             location_id=loc_id,
             materialized_path=old_iso.materialized_path,
             name=old_iso.name,
+            extension=old_iso.extension,
             is_dir=int(is_dir),
         )
         new_iso = IsolatedFilePathData.from_relative_str(loc_id, new_rel, is_dir)
@@ -206,6 +208,7 @@ class LocationManager:
                 location_id=loc_id,
                 materialized_path=iso.materialized_path,
                 name=iso.name,
+                extension=iso.extension,
                 is_dir=int(as_dir),
             )
             if row is None:

@@ -19,6 +19,7 @@ class EventKind(enum.Enum):
     MODIFY = "modify"
     RENAME = "rename"
     REMOVE = "remove"
+    RESCAN = "rescan"  # events were lost (queue overflow) — reconcile
 
 
 @dataclass(frozen=True)

@@ -147,9 +147,8 @@ class InotifyWatcher:
 
     def _handle(self, wd: int, mask: int, cookie: int, name: str) -> None:
         if mask & IN_Q_OVERFLOW:
-            # kernel queue overflow: callers should rescan; surface as a
-            # MODIFY of the root so the debounced rescan machinery fires
-            self._emit(WatchEvent(EventKind.MODIFY, self.root, is_dir=True))
+            # kernel queue overflow: events lost at unknown depths
+            self._emit(WatchEvent(EventKind.RESCAN, self.root, is_dir=True))
             return
         if mask & IN_IGNORED:
             path = self._wd_paths.pop(wd, None)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import stat as stat_mod
 from typing import Awaitable, Callable
 
 from .events import EventKind, WatchEvent
@@ -31,7 +32,12 @@ def take_snapshot(root: str) -> Snapshot:
                 st = os.stat(p, follow_symlinks=False)
             except OSError:
                 continue
-            snap[p] = (st.st_mtime, st.st_size, os.path.isdir(p), st.st_ino)
+            snap[p] = (
+                st.st_mtime,
+                st.st_size,
+                stat_mod.S_ISDIR(st.st_mode),
+                st.st_ino,
+            )
     return snap
 
 

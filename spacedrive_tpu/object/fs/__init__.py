@@ -11,6 +11,7 @@ mod.rs:154-200), `fetch_source_and_target_location_paths`
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 from dataclasses import dataclass
@@ -30,6 +31,22 @@ class FileData:
 
     row: dict
     full_path: str
+
+
+@contextlib.contextmanager
+def watcher_pause(ctx, location_id: int):
+    """Suppress the location watcher while a job scribbles in its own
+    location (ref:location/manager/mod.rs stop_watcher/reinit_watcher —
+    the reference's fs jobs ignore their own write events the same way)."""
+    node = getattr(ctx.library, "node", None)
+    mgr = getattr(node, "location_manager", None) if node is not None else None
+    if mgr is not None:
+        mgr.pause(ctx.library, location_id)
+    try:
+        yield
+    finally:
+        if mgr is not None:
+            mgr.resume(ctx.library, location_id)
 
 
 def get_location_path(db, location_id: int) -> str:

@@ -1,0 +1,133 @@
+"""FileEncryptor/FileDecryptor jobs — sd-crypto over library files.
+
+Parity: ref:core/src/object/fs/{encrypt.rs,decrypt.rs} (reference
+pre-rewrite file-crypto jobs) on top of crates/crypto: encrypt writes
+`<name>.sdenc` next to the source with a keyslotted header (optional
+embedded metadata = the file_path row essentials, optional preview
+media = the existing thumbnail, matching the reference's header
+extras); decrypt reverses by password. The location watcher's pause
+window keeps the jobs' own writes from echoing back as events.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ...crypto.header import decrypt_file, encrypt_file
+from ...crypto.hashing import HashingAlgorithm
+from ...crypto.stream import Algorithm
+from ...jobs import StatefulJob
+from ...jobs.job import JobContext, StepResult
+from ...jobs.manager import register_job
+from . import get_location_path, get_many_files_datas, watcher_pause
+
+ENCRYPTED_EXT = "sdenc"
+
+
+@register_job
+class FileEncryptorJob(StatefulJob):
+    """init: {location_id, file_path_ids, password, algorithm?,
+    with_metadata?, with_preview_media?, erase_original?}"""
+
+    NAME = "file_encryptor"
+
+    async def init_job(self, ctx: JobContext) -> None:
+        db = ctx.library.db
+        loc_path = get_location_path(db, self.init["location_id"])
+        for fd in get_many_files_datas(db, loc_path, self.init["file_path_ids"]):
+            if fd.row.get("is_dir"):
+                continue  # ref:encrypt.rs skips directories
+            self.steps.append(
+                {
+                    "full_path": fd.full_path,
+                    "cas_id": fd.row.get("cas_id"),
+                    "name": fd.row.get("name"),
+                    "extension": fd.row.get("extension"),
+                }
+            )
+        ctx.progress(task_count=len(self.steps), phase="encrypting")
+
+    async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
+        src = step["full_path"]
+        dst = f"{src}.{ENCRYPTED_EXT}"
+        metadata = None
+        if self.init.get("with_metadata", True):
+            metadata = {
+                "name": step["name"],
+                "extension": step["extension"],
+                "cas_id": step["cas_id"],
+            }
+        preview = None
+        if self.init.get("with_preview_media") and step["cas_id"]:
+            node = getattr(ctx.library, "node", None)
+            if node is not None:
+                thumb = node.thumbnailer.store.path_for(
+                    str(ctx.library.id), step["cas_id"]
+                )
+                if os.path.exists(thumb):
+                    with open(thumb, "rb") as f:
+                        preview = f.read()
+        with watcher_pause(ctx, self.init["location_id"]):
+            encrypt_file(
+                src,
+                dst,
+                self.init["password"].encode(),
+                algorithm=Algorithm(self.init.get("algorithm", 0)),
+                hashing=HashingAlgorithm(
+                    self.init.get("hashing", HashingAlgorithm.ARGON2ID)
+                ),
+                metadata=metadata,
+                preview_media=preview,
+                _test_overrides=tuple(self.init["_test_overrides"])
+                if self.init.get("_test_overrides")
+                else None,
+            )
+            if self.init.get("erase_original"):
+                from .erase import erase_file
+
+                erase_file(src, passes=1)
+                os.remove(src)
+        ctx.progress(completed_task_count=step_number + 1)
+        return StepResult()
+
+    async def finalize(self, ctx: JobContext):
+        return {"encrypted": len(self.steps)}
+
+
+@register_job
+class FileDecryptorJob(StatefulJob):
+    """init: {location_id, file_path_ids, password, erase_original?}"""
+
+    NAME = "file_decryptor"
+
+    async def init_job(self, ctx: JobContext) -> None:
+        db = ctx.library.db
+        loc_path = get_location_path(db, self.init["location_id"])
+        for fd in get_many_files_datas(db, loc_path, self.init["file_path_ids"]):
+            if fd.row.get("is_dir"):
+                continue
+            self.steps.append({"full_path": fd.full_path})
+        ctx.progress(task_count=len(self.steps), phase="decrypting")
+
+    async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
+        src = step["full_path"]
+        if src.endswith(f".{ENCRYPTED_EXT}"):
+            dst = src[: -(len(ENCRYPTED_EXT) + 1)]
+        else:
+            dst = src + ".decrypted"
+        with watcher_pause(ctx, self.init["location_id"]):
+            decrypt_file(
+                src,
+                dst,
+                self.init["password"].encode(),
+                _test_overrides=tuple(self.init["_test_overrides"])
+                if self.init.get("_test_overrides")
+                else None,
+            )
+            if self.init.get("erase_original"):
+                os.remove(src)
+        ctx.progress(completed_task_count=step_number + 1)
+        return StepResult()
+
+    async def finalize(self, ctx: JobContext):
+        return {"decrypted": len(self.steps)}

@@ -89,8 +89,13 @@ class FileEraserJob(StatefulJob):
             return StepResult(more_steps=more, metadata={"directories_to_remove": dirs})
 
         try:
-            erase_file(full_path, self.init.get("passes", 1))
-            os.remove(full_path)
+            # the overwrite passes fire MODIFY storms; don't let the
+            # watcher rescan a file that's being scrambled
+            from . import watcher_pause
+
+            with watcher_pause(ctx, self.init["location_id"]):
+                erase_file(full_path, self.init.get("passes", 1))
+                os.remove(full_path)
         except FileNotFoundError:
             pass
         except OSError as e:
