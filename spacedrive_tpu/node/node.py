@@ -35,6 +35,7 @@ class Node:
         *,
         use_device: bool = True,
         with_logger: bool = False,
+        with_labeler: bool = True,
     ):
         self.data_dir = os.fspath(data_dir)
         os.makedirs(self.data_dir, exist_ok=True)
@@ -57,6 +58,18 @@ class Node:
             use_device=use_device,
         )
         self.use_device = use_device
+        # ref:lib.rs:142 ImageLabeler::new [feature ai] — on by default,
+        # disable with with_labeler=False (the reference's feature gate)
+        self.image_labeler: Any = None
+        if with_labeler:
+            from ..models.labeler_actor import ImageLabeler
+
+            self.image_labeler = ImageLabeler(
+                os.path.join(self.data_dir, "image_labeler"),
+                use_device=use_device,
+            )
+            if self.config.config.image_labeler_version != "labeler-net-v1":
+                self.config.update(image_labeler_version="labeler-net-v1")
         self.p2p: Any = None  # P2PManager, attached by start() when enabled
         self.http: Any = None  # ApiServer handle from start_api()
         from ..api.namespaces import mount
@@ -111,6 +124,8 @@ class Node:
         lib.orphan_remover = OrphanRemoverActor(lib.db)
         lib.orphan_remover.start()
         self.location_manager.ignore_paths.add(self.thumbnailer.data_dir)
+        if self.image_labeler is not None:
+            self.image_labeler.register_library(lib)
         for loc in lib.db.find("location"):
             await self.location_manager.add(lib, loc)
         await self.jobs.cold_resume(lib)
@@ -168,6 +183,8 @@ class Node:
             if remover is not None:
                 await remover.stop()
         await self.thumbnailer.shutdown()
+        if self.image_labeler is not None:
+            await self.image_labeler.shutdown()
         await self.location_manager.shutdown()
         await self.actors.shutdown()
         if self.p2p is not None:

@@ -26,8 +26,19 @@ from ....ops import thumbnail_jax as tj
 logger = logging.getLogger(__name__)
 
 WEBP_QUALITY = 30  # ref:process.rs:440
-MAX_FILE_SIZE = 192 * 1024 * 1024  # ref:crates/images/src/consts.rs:9
+from ..images import MAXIMUM_FILE_SIZE as MAX_FILE_SIZE  # ref:consts.rs:9
+
 MAX_DIM = 4096  # ref:crates/images/src/consts.rs:33
+
+
+def shrink_to_max_dim(arr: "np.ndarray") -> "np.ndarray":
+    """Stride-downsample oversized decodes to fit the largest bucket
+    (the reference rejects >4096² outright; we degrade instead)."""
+    h, w = arr.shape[:2]
+    if max(h, w) > MAX_DIM:
+        step = math.ceil(max(h, w) / MAX_DIM)
+        arr = np.ascontiguousarray(arr[::step, ::step])
+    return arr
 
 # Decodable subsets of the taxonomy (the taxonomy stays the single
 # source of truth, ref:crates/file-ext; the reference fans out to the
@@ -44,9 +55,12 @@ _CV2_DECODABLE = {
     "mp4", "mov", "avi", "mkv", "webm", "m4v", "mpg", "mpeg", "mpe",
     "wmv", "flv", "3gp", "ogv", "mts", "m2ts", "m2v", "ts", "vob", "qt",
 }
+from ..images import HEIF_EXTENSIONS, format_image, heif_available
+
 IMAGE_EXTENSIONS = tuple(
     e for e in _all_extensions("Image") if e in _PIL_DECODABLE
-)
+) + (tuple(e for e in _all_extensions("Image") if e in HEIF_EXTENSIONS)
+     if heif_available() else ())
 VIDEO_EXTENSIONS = tuple(
     e for e in _all_extensions("Video") if e in _CV2_DECODABLE
 )
@@ -98,13 +112,8 @@ def decode_image(path: str) -> Decoded:
             img.draft("RGB", (tw, th))  # smallest DCT scale ≥ target
         img = img.convert("RGBA")
         arr = np.asarray(img)
+    arr = shrink_to_max_dim(arr)
     h, w = arr.shape[:2]
-    if max(h, w) > MAX_DIM:
-        # Pre-shrink oversized decodes so they fit the largest bucket
-        # (the reference rejects >4096² outright; we degrade instead).
-        step = math.ceil(max(h, w) / MAX_DIM)
-        arr = arr[::step, ::step]
-        h, w = arr.shape[:2]
     if min(h, w) < 1:
         raise ThumbError(f"empty image: {path}")
     return Decoded(array=arr, target=(th, tw), orientation=orientation)
@@ -144,20 +153,27 @@ def decode_video_frame(path: str) -> Decoded:
             raise ThumbError(f"no decodable frame: {path}")
     finally:
         cap.release()
-    rgb = frame[:, :, ::-1]  # BGR → RGB
+    rgb = shrink_to_max_dim(frame[:, :, ::-1])  # BGR → RGB
     h, w = rgb.shape[:2]
-    if max(h, w) > MAX_DIM:
-        step = math.ceil(max(h, w) / MAX_DIM)
-        rgb = rgb[::step, ::step]
-        h, w = rgb.shape[:2]
     arr = np.dstack([rgb, np.full((h, w, 1), 255, np.uint8)])
     tw, th = tj.video_dimensions(w, h)
     return Decoded(array=np.ascontiguousarray(arr), target=(th, tw))
 
 
+def decode_heif_image(path: str, extension: str) -> Decoded:
+    """HEIC/HEIF/AVIF through the libheif dispatch (ref:crates/images
+    HEIF handler); orientation is baked in by libheif's transforms."""
+    arr = shrink_to_max_dim(format_image(path, extension))
+    h, w = arr.shape[:2]
+    tw, th = tj.scale_dimensions(w, h)
+    return Decoded(array=arr, target=(th, tw))
+
+
 def decode(path: str, extension: str | None) -> Decoded:
     if is_video(extension):
         return decode_video_frame(path)
+    if (extension or "").lower() in HEIF_EXTENSIONS:
+        return decode_heif_image(path, extension)
     return decode_image(path)
 
 

@@ -22,6 +22,15 @@ try:
     import jax
     import jax._src.xla_bridge as _xb
 
+    # chex (via optax/flax) registers TPU lowering rules at import time,
+    # which needs "tpu" still present in known_platforms — import them
+    # BEFORE deregistering the accelerator backends below
+    try:
+        import optax  # noqa: F401
+        import flax  # noqa: F401
+    except Exception:
+        pass
+
     for _name in list(getattr(_xb, "_backend_factories", {})):
         if _name not in ("cpu", "interpreter"):
             _xb._backend_factories.pop(_name, None)

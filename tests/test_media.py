@@ -1,0 +1,180 @@
+"""Media subsystem: image decode dispatch (incl. native libheif),
+video thumbnails, labeler actor with resume, end-to-end labels.
+
+Parity targets: ref:crates/images (handler dispatch), crates/ffmpeg
+(movie_decoder), crates/ai (image_labeler actor).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.object.media.images import (
+    UnsupportedImage,
+    format_image,
+    heif_available,
+)
+from spacedrive_tpu.object.media.thumbnail import process
+
+
+def _jpeg(path, size=(320, 240), color=(200, 60, 30)):
+    from PIL import Image
+
+    Image.new("RGB", size, color).save(path)
+
+
+# --- decode dispatch ------------------------------------------------------
+
+
+def test_format_image_generic(tmp_path):
+    p = tmp_path / "a.jpg"
+    _jpeg(p)
+    arr = format_image(str(p))
+    assert arr.shape == (240, 320, 4) and arr.dtype == np.uint8
+    assert arr[0, 0, 0] > 150  # red-ish
+
+
+def test_format_image_svg_pdf_gated(tmp_path):
+    (tmp_path / "x.svg").write_text("<svg/>")
+    with pytest.raises(UnsupportedImage):
+        format_image(str(tmp_path / "x.svg"))
+    (tmp_path / "x.pdf").write_bytes(b"%PDF-1.4")
+    with pytest.raises(UnsupportedImage):
+        format_image(str(tmp_path / "x.pdf"))
+
+
+@pytest.mark.skipif(not heif_available(), reason="libheif unavailable")
+def test_heif_binding_loads():
+    # without a HEIF encoder we can't make a fixture; assert the binding
+    # wires and errors cleanly on a non-HEIF payload
+    from spacedrive_tpu.object.media.images import ImageHandlerError, decode_heif
+
+    with pytest.raises(ImageHandlerError):
+        decode_heif("/dev/null")
+
+
+def test_video_thumbnail_via_cv2(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    path = str(tmp_path / "clip.mp4")
+    w, h = 128, 96
+    vw = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 10, (w, h))
+    assert vw.isOpened()
+    for i in range(30):
+        frame = np.full((h, w, 3), (i * 8) % 255, np.uint8)
+        vw.write(frame)
+    vw.release()
+    d = process.decode_video_frame(path)
+    assert d.array.shape[2] == 4 and d.array.shape[0] > 0
+    webp = process.generate_one_cpu(path, "mp4")
+    assert webp[:4] == b"RIFF" and webp[8:12] == b"WEBP"
+
+
+# --- labeler actor --------------------------------------------------------
+
+
+def test_labeler_actor_writes_labels(tmp_path):
+    async def run():
+        from spacedrive_tpu.db.database import LibraryDb
+        from spacedrive_tpu.models.labeler_actor import ImageLabeler
+
+        class FakeLib:
+            id = "11111111-1111-1111-1111-111111111111"
+            db = LibraryDb(None, memory=True)
+
+        lib = FakeLib()
+        oid = lib.db.insert("object", pub_id=os.urandom(16), kind=5)
+        img = tmp_path / "cat.jpg"
+        _jpeg(img, size=(64, 64))
+        labeler = ImageLabeler(
+            str(tmp_path / "labeler"), use_device=False, image_size=64,
+            threshold=0.0,  # untrained net: accept everything → labels exist
+        )
+        batch_id = labeler.new_batch(
+            lib, [{"file_path_id": 1, "object_id": oid, "path": str(img)}]
+        )
+        assert batch_id != 0
+        await asyncio.wait_for(labeler.wait_batch(batch_id), 120)
+        assert labeler.labeled == 1
+        n_links = lib.db.count("label_on_object")
+        assert n_links > 0 and lib.db.count("label") == n_links
+        await labeler.shutdown()
+
+    asyncio.run(run())
+
+
+def test_labeler_resume_file(tmp_path):
+    async def run():
+        from spacedrive_tpu.db.database import LibraryDb
+        from spacedrive_tpu.models.labeler_actor import RESUME_FILE, ImageLabeler
+
+        class FakeLib:
+            id = "22222222-2222-2222-2222-222222222222"
+            db = LibraryDb(None, memory=True)
+
+        lib = FakeLib()
+        oid = lib.db.insert("object", pub_id=os.urandom(16), kind=5)
+        img = tmp_path / "dog.jpg"
+        _jpeg(img, size=(64, 64))
+        data_dir = str(tmp_path / "labeler")
+
+        # queue a batch but never start an event loop worker for it:
+        # shutdown persists it to to_resume_batches.bin
+        labeler = ImageLabeler(data_dir, use_device=False, image_size=64)
+        labeler._stopped = True  # prevent the worker from grabbing it
+        labeler.new_batch(
+            lib, [{"file_path_id": 1, "object_id": oid, "path": str(img)}]
+        )
+        await labeler.shutdown()
+        assert os.path.exists(os.path.join(data_dir, RESUME_FILE))
+
+        # a fresh actor + re-registered library resumes and completes it
+        labeler2 = ImageLabeler(
+            data_dir, use_device=False, image_size=64, threshold=0.0
+        )
+        labeler2.register_library(lib)
+        for _ in range(600):
+            if labeler2.labeled >= 1:
+                break
+            await asyncio.sleep(0.1)
+        assert labeler2.labeled == 1
+        assert lib.db.count("label_on_object") > 0
+        await labeler2.shutdown()
+
+    asyncio.run(run())
+
+
+# --- end-to-end through the media job ------------------------------------
+
+
+def test_media_job_labels_end_to_end(tmp_path):
+    async def run():
+        from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+        from spacedrive_tpu.node import Node
+
+        corpus = tmp_path / "corpus"
+        corpus.mkdir()
+        for i in range(3):
+            _jpeg(corpus / f"photo{i}.jpg", size=(100, 80), color=(i * 50, 90, 120))
+        node = Node(str(tmp_path / "node"), use_device=False)
+        node.config.config.p2p.enabled = False
+        node.image_labeler.threshold = 0.0  # untrained net emits all classes
+        node.image_labeler.image_size = 64
+        await node.start()
+        lib = await node.create_library("pics")
+        loc = LocationCreateArgs(path=str(corpus)).create(lib)
+        await scan_location(lib, loc, node.jobs)
+        await node.jobs.wait_idle()
+        try:
+            assert node.image_labeler.labeled == 3
+            assert lib.db.count("label_on_object") > 0
+            # labels are queryable through the API
+            labels = await node.router.exec(
+                node, "labels.list", library_id=str(lib.id)
+            )
+            assert labels["nodes"]
+        finally:
+            await node.shutdown()
+
+    asyncio.run(run())
