@@ -40,6 +40,7 @@ def mount() -> Router:
     _ephemeral(r)
     _jobs(r)
     _search(r)
+    _cloud(r)
     _tags(r)
     _labels(r)
     _sync(r)
@@ -527,6 +528,61 @@ def _search(r: Router) -> None:
         library.db.delete("saved_search", id=int(arg))
         invalidate_query(node, "search.saved.list", library)
         return None
+
+
+# --- cloud ---------------------------------------------------------------
+
+
+def _cloud(r: Router) -> None:
+    @r.query("cloud.getApiOrigin")
+    def get_origin(node):
+        return node.config.config.preferences.get("cloud_api_origin")
+
+    @r.mutation("cloud.setApiOrigin")
+    def set_origin(node, arg):
+        node.config.config.preferences["cloud_api_origin"] = str(arg)
+        node.config.save()
+        invalidate_query(node, "cloud.getApiOrigin")
+        return None
+
+    @r.query("cloud.library.get", library=True)
+    async def get_library(node, library):
+        from ..cloud.api import CloudApiError, CloudClient
+
+        origin = node.config.config.preferences.get("cloud_api_origin")
+        if not origin:
+            return None
+        client = CloudClient(origin)
+        try:
+            return await client.get_library(str(library.id))
+        except CloudApiError:
+            return None
+        finally:
+            await client.close()
+
+    @r.mutation("cloud.sync.enable", library=True)
+    async def enable(node, library):
+        from ..cloud.api import CloudApiError
+
+        try:
+            cloud = await node.enable_cloud_sync(library)
+        except ValueError as e:
+            raise RspcError.bad_request(str(e))
+        except CloudApiError as e:
+            raise RspcError(502, f"cloud relay unreachable: {e}")
+        return {"instance": str(library.sync.instance), "enabled": cloud is not None}
+
+    @r.query("cloud.sync.state", library=True)
+    def state(node, library):
+        cloud = getattr(library, "cloud_sync", None)
+        if cloud is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "sent_ops": cloud.sent_ops,
+            "received_collections": cloud.received_collections,
+            "ingested_ops": cloud.ingested_ops,
+        }
 
 
 # --- tags ----------------------------------------------------------------

@@ -1,0 +1,18 @@
+"""Cloud relay sync: self-hostable relay server, typed client, actors.
+
+Parity: ref:core/src/cloud (sender/receiver/ingester actors) +
+crates/cloud-api (REST client); the relay server itself replaces the
+reference's closed-source cloud so WAN sync works self-hosted.
+"""
+
+from .api import CloudApiError, CloudClient
+from .relay import CloudRelay
+from .sync import OPS_PER_REQUEST, CloudSync
+
+__all__ = [
+    "CloudApiError",
+    "CloudClient",
+    "CloudRelay",
+    "CloudSync",
+    "OPS_PER_REQUEST",
+]

@@ -1,0 +1,103 @@
+"""Typed cloud REST client.
+
+Parity: ref:crates/cloud-api/src/lib.rs — `library::{create,get}`
+(:120,203), `library::instances` (:359), `sync::messageCollections::
+{request_add(push), get}` (:448,485) against the relay's REST surface.
+One aiohttp session per client; all methods raise `CloudApiError` on
+non-2xx like the reference's `Result<_, rspc::Error>` surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import aiohttp
+
+from .relay import b64, unb64
+
+
+class CloudApiError(Exception):
+    pass
+
+
+class CloudClient:
+    def __init__(self, api_origin: str):
+        self.origin = api_origin.rstrip("/")
+        self._session: aiohttp.ClientSession | None = None
+
+    async def _request(
+        self, method: str, path: str, json: Any = None
+    ) -> Any:
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        try:
+            async with self._session.request(
+                method, f"{self.origin}{path}", json=json
+            ) as resp:
+                if resp.status >= 400:
+                    raise CloudApiError(
+                        f"{method} {path} -> {resp.status}: {await resp.text()}"
+                    )
+                return await resp.json()
+        except aiohttp.ClientError as e:
+            raise CloudApiError(f"{method} {path} failed: {e}") from e
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    # --- libraries (ref:lib.rs:120,203) --------------------------------
+
+    async def create_library(self, library_uuid: str, name: str) -> Any:
+        return await self._request(
+            "POST", "/api/libraries", {"uuid": library_uuid, "name": name}
+        )
+
+    async def get_library(self, library_uuid: str) -> Any:
+        return await self._request("GET", f"/api/libraries/{library_uuid}")
+
+    # --- instances (ref:lib.rs:359) ------------------------------------
+
+    async def add_instance(
+        self, library_uuid: str, instance_uuid: str, identity: str = "",
+        node_name: str = "",
+    ) -> Any:
+        return await self._request(
+            "POST",
+            f"/api/libraries/{library_uuid}/instances",
+            {"uuid": instance_uuid, "identity": identity, "node_name": node_name},
+        )
+
+    async def list_instances(self, library_uuid: str) -> list[Any]:
+        return await self._request(
+            "GET", f"/api/libraries/{library_uuid}/instances"
+        )
+
+    # --- message collections (ref:lib.rs:448,485) ----------------------
+
+    async def push_ops(
+        self, library_uuid: str, instance_uuid: str, packed_ops: bytes
+    ) -> int:
+        out = await self._request(
+            "POST",
+            f"/api/libraries/{library_uuid}/messageCollections",
+            {"instance_uuid": instance_uuid, "contents": b64(packed_ops)},
+        )
+        return out["id"]
+
+    async def pull_ops(
+        self,
+        library_uuid: str,
+        instance_uuid: str,
+        cursors: dict[str, int],
+        count: int = 100,
+    ) -> list[dict[str, Any]]:
+        out = await self._request(
+            "POST",
+            f"/api/libraries/{library_uuid}/messageCollections/get",
+            {"instance_uuid": instance_uuid, "cursors": cursors, "count": count},
+        )
+        for c in out:
+            c["contents"] = unb64(c["contents"])
+        return out

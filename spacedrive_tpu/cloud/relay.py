@@ -1,0 +1,140 @@
+"""Cloud relay server — the WAN sync rendezvous.
+
+Parity role: the reference's closed-source Spacedrive cloud exposes
+libraries / instances / sync *message collections* over REST
+(ref:crates/cloud-api/src/lib.rs:35-61,120,203,359-448,485). This
+framework ships the relay itself so WAN sync is self-hostable: an
+aiohttp app storing, per library, the registered instances and each
+instance's append-only op-collection log. Collections are opaque
+msgpack blobs (CompressedCRDTOperations.pack()) keyed by a
+monotonically increasing ULID-like row id; receivers poll with
+`from_id` cursors exactly like the reference's
+`messageCollections.get(instanceTimestamps)` flow.
+
+Endpoints (JSON bodies; op payloads base64):
+  POST /api/libraries                         {uuid, name}
+  GET  /api/libraries/{lib}
+  POST /api/libraries/{lib}/instances         {uuid, identity}
+  GET  /api/libraries/{lib}/instances
+  POST /api/libraries/{lib}/messageCollections     push one collection
+  POST /api/libraries/{lib}/messageCollections/get  pull w/ cursors
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+from typing import Any
+
+from aiohttp import web
+
+
+class CloudRelay:
+    def __init__(self) -> None:
+        self.libraries: dict[str, dict[str, Any]] = {}
+        self._collection_ids = itertools.count(1)
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/api/libraries", self._create_library),
+                web.get("/api/libraries/{lib}", self._get_library),
+                web.post("/api/libraries/{lib}/instances", self._add_instance),
+                web.get("/api/libraries/{lib}/instances", self._list_instances),
+                web.post(
+                    "/api/libraries/{lib}/messageCollections", self._push
+                ),
+                web.post(
+                    "/api/libraries/{lib}/messageCollections/get", self._pull
+                ),
+            ]
+        )
+        self._runner: web.AppRunner | None = None
+        self.port: int | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        return self.port
+
+    async def shutdown(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # --- handlers ------------------------------------------------------
+
+    def _lib(self, request: web.Request) -> dict[str, Any]:
+        lib = self.libraries.get(request.match_info["lib"])
+        if lib is None:
+            raise web.HTTPNotFound(text="library")
+        return lib
+
+    async def _create_library(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        lib_id = body["uuid"]
+        self.libraries.setdefault(
+            lib_id,
+            {"uuid": lib_id, "name": body.get("name", ""), "instances": {},
+             "collections": []},
+        )
+        return web.json_response({"uuid": lib_id})
+
+    async def _get_library(self, request: web.Request) -> web.Response:
+        lib = self._lib(request)
+        return web.json_response({"uuid": lib["uuid"], "name": lib["name"]})
+
+    async def _add_instance(self, request: web.Request) -> web.Response:
+        lib = self._lib(request)
+        body = await request.json()
+        lib["instances"][body["uuid"]] = {
+            "uuid": body["uuid"],
+            "identity": body.get("identity"),
+            "node_name": body.get("node_name", ""),
+        }
+        return web.json_response({"ok": True})
+
+    async def _list_instances(self, request: web.Request) -> web.Response:
+        lib = self._lib(request)
+        return web.json_response(list(lib["instances"].values()))
+
+    async def _push(self, request: web.Request) -> web.Response:
+        lib = self._lib(request)
+        body = await request.json()
+        instance = body["instance_uuid"]
+        if instance not in lib["instances"]:
+            raise web.HTTPBadRequest(text="unknown instance")
+        cid = next(self._collection_ids)
+        lib["collections"].append(
+            {
+                "id": cid,
+                "instance_uuid": instance,
+                "contents": body["contents"],  # base64 packed ops
+            }
+        )
+        return web.json_response({"id": cid})
+
+    async def _pull(self, request: web.Request) -> web.Response:
+        """Collections from OTHER instances after the caller's cursors:
+        body {instance_uuid, cursors: {instance_uuid: last_seen_id}}."""
+        lib = self._lib(request)
+        body = await request.json()
+        me = body["instance_uuid"]
+        cursors = {k: int(v) for k, v in body.get("cursors", {}).items()}
+        out = [
+            c
+            for c in lib["collections"]
+            if c["instance_uuid"] != me
+            and c["id"] > cursors.get(c["instance_uuid"], 0)
+        ]
+        return web.json_response(out[: int(body.get("count", 100))])
+
+
+def b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def unb64(data: str) -> bytes:
+    return base64.b64decode(data)

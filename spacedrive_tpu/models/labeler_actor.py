@@ -64,6 +64,7 @@ class ImageLabeler:
         self.threshold = threshold
         self.image_size = image_size
         self._queue: collections.deque[Batch] = collections.deque()
+        self._work: asyncio.Event | None = None  # set when queue non-empty
         self._batch_ids = itertools.count((secrets.randbits(40) << 20) | 1)
         self._batch_pending: dict[int, int] = {}
         self._libraries: dict[str, Any] = {}
@@ -130,6 +131,8 @@ class ImageLabeler:
         self._batch_pending[batch.id] = len(entries)
         self._persist()
         self._ensure_started()
+        if self._work is not None:
+            self._work.set()
         return batch.id
 
     async def wait_batch(self, batch_id: int) -> None:
@@ -153,6 +156,10 @@ class ImageLabeler:
             return
         if self._cond is None:
             self._cond = asyncio.Condition()
+        if self._work is None:
+            self._work = asyncio.Event()
+        if self._queue:
+            self._work.set()
         if self._worker is None or self._worker.done():
             self._worker = loop.create_task(self._run(), name="image-labeler")
 
@@ -187,24 +194,30 @@ class ImageLabeler:
     # --- worker ---------------------------------------------------------
 
     async def _run(self) -> None:
+        assert self._work is not None
         while not self._stopped:
             if not self._queue:
-                await asyncio.sleep(0.05)
+                self._work.clear()
+                await self._work.wait()
                 continue
             batch = self._queue.popleft()
             self._inflight = batch  # stays in the resume file until done
             try:
                 await self._process(batch)
+            except asyncio.CancelledError:
+                # shutdown mid-batch: keep it in the resume file
+                # (_inflight still set) for the next boot
+                self._persist()
+                raise
             except Exception:
                 logger.exception("labeler batch %d failed", batch.id)
                 self.errors += len(batch.entries)
-            finally:
-                self._inflight = None
-                self._persist()
-                self._batch_pending[batch.id] = 0
-                assert self._cond is not None
-                async with self._cond:
-                    self._cond.notify_all()
+            self._inflight = None
+            self._persist()
+            assert self._cond is not None
+            async with self._cond:
+                self._batch_pending.pop(batch.id, None)
+                self._cond.notify_all()
 
     async def _process(self, batch: Batch) -> None:
         library = self._libraries.get(batch.library_id)

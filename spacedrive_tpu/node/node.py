@@ -142,6 +142,42 @@ class Node:
             self.p2p.register_library(lib)
         return lib
 
+    async def enable_cloud_sync(self, lib: Library, api_origin: str | None = None):
+        """Start the cloud sender/receiver/ingester trio for a library
+        (ref:core/src/cloud/sync/mod.rs:14 declare_actors; the origin
+        persists in node preferences like the reference's sd-cloud-api
+        env)."""
+        from ..cloud.api import CloudClient
+        from ..cloud.sync import CloudSync
+
+        prev_origin = self.config.config.preferences.get("cloud_api_origin")
+        if api_origin is not None and api_origin != prev_origin:
+            self.config.config.preferences["cloud_api_origin"] = api_origin
+            self.config.save()
+        origin = self.config.config.preferences.get("cloud_api_origin")
+        if not origin:
+            raise ValueError("no cloud api origin configured")
+        existing = getattr(lib, "cloud_sync", None)
+        if existing is not None:
+            if existing.client.origin == origin.rstrip("/"):
+                return existing
+            # origin changed: move sync to the new relay
+            await existing.shutdown()
+            await existing.client.close()
+            lib.cloud_sync = None
+        client = CloudClient(origin)
+        cloud = CloudSync(lib, client)
+        try:
+            await cloud.start()
+        except BaseException:
+            await cloud.shutdown()
+            await client.close()
+            raise
+        lib.cloud_sync = cloud
+        if BackendFeature.CLOUD_SYNC not in self.config.config.features:
+            self.toggle_feature(BackendFeature.CLOUD_SYNC, True)
+        return cloud
+
     async def close_library(self, lib_id: uuid.UUID) -> None:
         """Tear down one loaded library: stop its actors, persist and stop
         its jobs, close the DB, drop it from the registry (the per-library
@@ -151,6 +187,10 @@ class Node:
         lib = self.libraries.get(lib_id)
         if lib is None:
             return
+        cloud = getattr(lib, "cloud_sync", None)
+        if cloud is not None:
+            await cloud.shutdown()
+            await cloud.client.close()
         await shutdown_jobs(self.jobs, lib)
         remover = getattr(lib, "orphan_remover", None)
         if remover is not None:
@@ -182,6 +222,10 @@ class Node:
             remover = getattr(lib, "orphan_remover", None)
             if remover is not None:
                 await remover.stop()
+            cloud = getattr(lib, "cloud_sync", None)
+            if cloud is not None:
+                await cloud.shutdown()
+                await cloud.client.close()
         await self.thumbnailer.shutdown()
         if self.image_labeler is not None:
             await self.image_labeler.shutdown()

@@ -1,0 +1,157 @@
+"""Cloud sync: relay REST surface, client, and two libraries converging
+through the relay only (no P2P).
+
+Parity targets: ref:core/src/cloud/sync/{send,receive,ingest}.rs,
+crates/cloud-api. The two-node test mirrors the reference's multi-node
+channel-transport pattern (§4) with the relay as rendezvous.
+"""
+
+import asyncio
+import os
+import uuid
+
+import pytest
+
+from spacedrive_tpu.cloud import CloudClient, CloudRelay, CloudSync
+
+
+def test_relay_and_client_roundtrip():
+    async def run():
+        relay = CloudRelay()
+        port = await relay.start()
+        client = CloudClient(f"http://127.0.0.1:{port}")
+        try:
+            lib_id = str(uuid.uuid4())
+            inst_a, inst_b = str(uuid.uuid4()), str(uuid.uuid4())
+            await client.create_library(lib_id, "cloudlib")
+            assert (await client.get_library(lib_id))["name"] == "cloudlib"
+            await client.add_instance(lib_id, inst_a)
+            await client.add_instance(lib_id, inst_b)
+            assert len(await client.list_instances(lib_id)) == 2
+
+            cid = await client.push_ops(lib_id, inst_a, b"packed-ops-1")
+            await client.push_ops(lib_id, inst_a, b"packed-ops-2")
+            # B pulls: both collections from A, in order
+            cols = await client.pull_ops(lib_id, inst_b, {})
+            assert [c["contents"] for c in cols] == [b"packed-ops-1", b"packed-ops-2"]
+            # cursor resume: nothing new after the last id
+            cols2 = await client.pull_ops(
+                lib_id, inst_b, {inst_a: cols[-1]["id"]}
+            )
+            assert cols2 == []
+            # A doesn't receive its own collections
+            assert await client.pull_ops(lib_id, inst_a, {}) == []
+            # unknown instance push rejected
+            from spacedrive_tpu.cloud import CloudApiError
+
+            with pytest.raises(CloudApiError):
+                await client.push_ops(lib_id, str(uuid.uuid4()), b"x")
+        finally:
+            await client.close()
+            await relay.shutdown()
+
+    asyncio.run(run())
+
+
+def test_two_nodes_converge_via_cloud(tmp_path):
+    async def run():
+        from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+        from spacedrive_tpu.node import Node
+        from spacedrive_tpu.sync.ingest import backfill_operations
+
+        relay = CloudRelay()
+        port = await relay.start()
+        origin = f"http://127.0.0.1:{port}"
+
+        a = Node(str(tmp_path / "a"), use_device=False, with_labeler=False)
+        b = Node(str(tmp_path / "b"), use_device=False, with_labeler=False)
+        for n in (a, b):
+            n.config.config.p2p.enabled = False
+            await n.start()
+        lib_a = await a.create_library("shared")
+        # same-library pairing on B (same id, own instance row)
+        import shutil
+
+        lib_b_tmp = b.libraries.create("shared")
+        old = lib_b_tmp.id
+        lib_b_tmp.close()
+        b.libraries.libraries.clear()
+        for suffix in (".sdlibrary", ".db"):
+            shutil.move(
+                os.path.join(b.libraries.dir, f"{old}{suffix}"),
+                os.path.join(b.libraries.dir, f"{lib_a.id}{suffix}"),
+            )
+        for s in ("-wal", "-shm"):
+            p = os.path.join(b.libraries.dir, f"{old}.db{s}")
+            if os.path.exists(p):
+                shutil.move(p, os.path.join(b.libraries.dir, f"{lib_a.id}.db{s}"))
+        lib_b = b.libraries.load(lib_a.id)
+        await b._init_library(lib_b)
+        try:
+            cloud_a = await a.enable_cloud_sync(lib_a, origin)
+            cloud_b = await b.enable_cloud_sync(lib_b, origin)
+            cloud_a.poll_interval = cloud_b.poll_interval = 0.1
+
+            # alpha indexes; ops flow A → relay → B
+            corpus = tmp_path / "corpus"
+            corpus.mkdir()
+            for i in range(3):
+                (corpus / f"f{i}.bin").write_bytes(os.urandom(1024 + i))
+            loc = LocationCreateArgs(path=str(corpus)).create(lib_a)
+            backfill_operations(lib_a.sync)
+            await scan_location(lib_a, loc, a.jobs)
+            await a.jobs.wait_idle()
+
+            for _ in range(300):
+                if (
+                    lib_b.db.count("file_path") == lib_a.db.count("file_path")
+                    and lib_b.db.count("location") == 1
+                ):
+                    break
+                await asyncio.sleep(0.1)
+            assert lib_b.db.count("location") == 1
+            assert lib_b.db.count("file_path") == lib_a.db.count("file_path")
+            a_cas = {
+                r["name"]: r["cas_id"]
+                for r in lib_a.db.query(
+                    "SELECT name, cas_id FROM file_path WHERE is_dir = 0"
+                )
+            }
+            b_cas = {
+                r["name"]: r["cas_id"]
+                for r in lib_b.db.query(
+                    "SELECT name, cas_id FROM file_path WHERE is_dir = 0"
+                )
+            }
+            assert a_cas == b_cas and len(a_cas) == 3
+            assert cloud_a.sent_ops > 0
+            assert cloud_b.ingested_ops > 0
+            # cache table drains after ingest
+            for _ in range(50):
+                if lib_b.db.count("cloud_crdt_operation") == 0:
+                    break
+                await asyncio.sleep(0.1)
+            assert lib_b.db.count("cloud_crdt_operation") == 0
+
+            # reverse direction: a synced write on B reaches A
+            ops = lib_b.sync.shared_create(
+                "tag", os.urandom(16).hex(), [("name", "from-beta")]
+            )
+            lib_b.sync.write_ops(list(ops))
+            for _ in range(100):
+                if lib_a.db.find_one("tag", name="from-beta") is not None:
+                    break
+                await asyncio.sleep(0.1)
+            assert lib_a.db.find_one("tag", name="from-beta") is not None
+
+            # state over API
+            state = await b.router.exec(
+                b, "cloud.sync.state", library_id=str(lib_b.id)
+            )
+            assert state["enabled"] and state["ingested_ops"] > 0
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+            await relay.shutdown()
+
+    asyncio.run(run())
