@@ -102,32 +102,29 @@ def test_two_nodes_converge_via_cloud(tmp_path):
             await scan_location(lib_a, loc, a.jobs)
             await a.jobs.wait_idle()
 
+            def cas_map(db):
+                return {
+                    r["name"]: r["cas_id"]
+                    for r in db.query(
+                        "SELECT name, cas_id FROM file_path WHERE is_dir = 0"
+                    )
+                }
+
+            a_cas = cas_map(lib_a.db)
             for _ in range(300):
                 if (
-                    lib_b.db.count("file_path") == lib_a.db.count("file_path")
-                    and lib_b.db.count("location") == 1
+                    lib_b.db.count("location") == 1
+                    and cas_map(lib_b.db) == a_cas  # cas updates land last
                 ):
                     break
                 await asyncio.sleep(0.1)
             assert lib_b.db.count("location") == 1
             assert lib_b.db.count("file_path") == lib_a.db.count("file_path")
-            a_cas = {
-                r["name"]: r["cas_id"]
-                for r in lib_a.db.query(
-                    "SELECT name, cas_id FROM file_path WHERE is_dir = 0"
-                )
-            }
-            b_cas = {
-                r["name"]: r["cas_id"]
-                for r in lib_b.db.query(
-                    "SELECT name, cas_id FROM file_path WHERE is_dir = 0"
-                )
-            }
-            assert a_cas == b_cas and len(a_cas) == 3
+            assert cas_map(lib_b.db) == a_cas and len(a_cas) == 3
             assert cloud_a.sent_ops > 0
             assert cloud_b.ingested_ops > 0
             # cache table drains after ingest
-            for _ in range(50):
+            for _ in range(300):
                 if lib_b.db.count("cloud_crdt_operation") == 0:
                     break
                 await asyncio.sleep(0.1)
@@ -138,7 +135,7 @@ def test_two_nodes_converge_via_cloud(tmp_path):
                 "tag", os.urandom(16).hex(), [("name", "from-beta")]
             )
             lib_b.sync.write_ops(list(ops))
-            for _ in range(100):
+            for _ in range(300):
                 if lib_a.db.find_one("tag", name="from-beta") is not None:
                     break
                 await asyncio.sleep(0.1)
