@@ -503,6 +503,27 @@ def _search(r: Router) -> None:
     def objects(node, library, arg):
         return search_objects(library, arg)
 
+    @r.query("search.duplicates", library=True)
+    async def duplicates(node, library, arg):
+        """Near + exact duplicate groups (device pHash; BASELINE cfg 5).
+        Runs off the event loop — the matmuls + grouping take seconds on
+        big libraries."""
+        from ..object.duplicates import find_duplicates
+
+        return await asyncio.to_thread(
+            find_duplicates, library, int((arg or {}).get("threshold", 8))
+        )
+
+    @r.mutation("search.detectDuplicates", library=True)
+    async def detect_duplicates(node, library, arg):
+        from ..jobs.manager import JobBuilder
+        from ..object.duplicates import DuplicateDetectorJob
+
+        job_id = await JobBuilder(
+            DuplicateDetectorJob(dict(arg or {}))
+        ).spawn(node.jobs, library)
+        return str(job_id)
+
     @r.query("search.saved.list", library=True)
     def saved_list(node, library):
         return normalise("saved_search", library.db.find("saved_search"))

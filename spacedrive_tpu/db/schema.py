@@ -12,8 +12,6 @@ Versioning via PRAGMA user_version + ordered migration list.
 
 from __future__ import annotations
 
-SCHEMA_VERSION = 1
-
 SCHEMA: list[str] = [
     # --- sync infrastructure -------------------------------------------------
     """
@@ -306,4 +304,13 @@ SCHEMA: list[str] = [
 
 # Ordered migrations: MIGRATIONS[v] upgrades user_version v -> v+1.
 # Version 0 is an empty database.
-MIGRATIONS: list[list[str]] = [SCHEMA]
+MIGRATIONS: list[list[str]] = [
+    SCHEMA,
+    # v1 -> v2: 64-bit perceptual hash for near-duplicate detection
+    # (device-computed, ops/phash_jax.py; no reference counterpart —
+    # spacedrive dedups by exact cas_id only)
+    ["ALTER TABLE object ADD COLUMN phash BLOB"],
+]
+
+# The version every migrated database reports via PRAGMA user_version.
+SCHEMA_VERSION = len(MIGRATIONS)

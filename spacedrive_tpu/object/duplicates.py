@@ -1,0 +1,162 @@
+"""Duplicate detection — pHash job + grouping query.
+
+BASELINE.json config 5. The job walks image objects that lack an
+`object.phash`, decodes the *originals* (JPEG draft mode decodes at
+1/8 DCT scale, so this is cheap and avoids the distance inflation of
+re-hashing webp-q30 thumbnails; the thumbnail is only the fallback),
+batches 32×32 grayscale planes, and runs the device pHash
+(ops/phash_jax.py). `find_duplicates` then groups objects by Hamming
+distance via blockwise MXU matmuls. Exact-duplicate grouping by cas_id
+(the reference's only dedup, ref:core/src/object/file_identifier
+object reuse by cas_id) falls out of the same query.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import numpy as np
+
+from ..files.kind import ObjectKind
+from ..jobs import StatefulJob
+from ..jobs.job import JobContext, StepResult
+from ..jobs.manager import register_job
+from ..ops import phash_jax
+
+logger = logging.getLogger(__name__)
+
+CHUNK = 64
+
+
+@register_job
+class DuplicateDetectorJob(StatefulJob):
+    """init: {location_id?, threshold?} — hashes image objects missing
+    a phash; finalize records the duplicate groups found."""
+
+    NAME = "duplicate_detector"
+    IS_BATCHED = True
+
+    async def init_job(self, ctx: JobContext) -> None:
+        db = ctx.library.db
+        conds = ["o.kind = ?", "o.phash IS NULL", "fp.cas_id IS NOT NULL"]
+        params: list[Any] = [int(ObjectKind.Image)]
+        if self.init.get("location_id"):
+            conds.append("fp.location_id = ?")
+            params.append(int(self.init["location_id"]))
+        rows = db.query(
+            "SELECT o.id AS object_id, fp.cas_id, fp.location_id, "
+            "fp.materialized_path, fp.name, fp.extension, fp.is_dir "
+            "FROM object o JOIN file_path fp ON fp.object_id = o.id "
+            f"WHERE {' AND '.join(conds)} GROUP BY o.id",
+            params,
+        )
+        for off in range(0, len(rows), CHUNK):
+            self.steps.append({"rows": rows[off : off + CHUNK]})
+        self.run_metadata.update(hashed=0, skipped=0)
+        ctx.progress(
+            task_count=len(self.steps),
+            message=f"hashing {len(rows)} images",
+            phase="phash",
+        )
+
+    def _decode_gray(self, ctx: JobContext, row: dict) -> np.ndarray | None:
+        """Original-first decode: JPEG draft mode pulls a 1/8-scale DCT
+        decode, so cost stays low while avoiding the distance inflation
+        of re-hashing webp-q30 (possibly upscaled) thumbnails; the
+        thumbnail is the fallback when the original is gone/undecodable."""
+        from PIL import Image
+
+        locs = self.data.setdefault("_loc_cache", {})
+        loc = locs.get(row["location_id"])
+        if loc is None:
+            loc = ctx.library.db.find_one("location", id=row["location_id"])
+            locs[row["location_id"]] = loc
+        if loc is not None:
+            from ..files.isolated_path import full_path_from_db_row
+
+            path = full_path_from_db_row(loc["path"], row)
+            try:
+                with Image.open(path) as img:
+                    if img.format == "JPEG":
+                        img.draft("RGB", (phash_jax.DCT_SIZE, phash_jax.DCT_SIZE))
+                    return phash_jax.to_gray32(np.asarray(img.convert("RGBA")))
+            except Exception:
+                pass
+        node = getattr(ctx.library, "node", None)
+        if node is not None:
+            thumb = node.thumbnailer.store.path_for(
+                str(ctx.library.id), row["cas_id"]
+            )
+            if os.path.exists(thumb):
+                try:
+                    with Image.open(thumb) as img:
+                        return phash_jax.to_gray32(
+                            np.asarray(img.convert("RGBA"))
+                        )
+                except Exception:
+                    pass
+        return None
+
+    async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
+        import asyncio
+
+        rows = step["rows"]
+        grays = await asyncio.to_thread(
+            lambda: [self._decode_gray(ctx, r) for r in rows]
+        )
+        ok = [(r, g) for r, g in zip(rows, grays) if g is not None]
+        skipped = len(rows) - len(ok)
+        if ok:
+            batch = np.stack([g for _r, g in ok])
+            hashes = await asyncio.to_thread(phash_jax.phash_batch, batch)
+            ctx.library.db.executemany(
+                "UPDATE object SET phash = ? WHERE id = ?",
+                [
+                    (h.tobytes(), row["object_id"])
+                    for (row, _g), h in zip(ok, hashes)
+                ],
+            )
+        self.run_metadata["hashed"] += len(ok)
+        self.run_metadata["skipped"] += skipped
+        ctx.progress(completed_task_count=step_number + 1)
+        return StepResult()
+
+    async def finalize(self, ctx: JobContext) -> Any:
+        import asyncio
+
+        self.data.pop("_loc_cache", None)  # not serializable state
+        groups = await asyncio.to_thread(
+            find_duplicates, ctx.library, int(self.init.get("threshold", 8))
+        )
+        self.run_metadata["duplicate_groups"] = len(groups)
+        return {
+            "hashed": self.run_metadata["hashed"],
+            "duplicate_groups": len(groups),
+        }
+
+
+def find_duplicates(library: Any, threshold: int = 8) -> list[dict[str, Any]]:
+    """Near-duplicate groups over all hashed objects + exact cas_id
+    groups. Returns [{object_ids, kind: 'near'|'exact'}]."""
+    rows = library.db.query(
+        "SELECT id, phash FROM object WHERE phash IS NOT NULL"
+    )
+    near = phash_jax.duplicate_groups(
+        [(r["id"], r["phash"]) for r in rows], threshold=threshold
+    )
+    out = [{"object_ids": g, "kind": "near"} for g in near]
+    exact = library.db.query(
+        "SELECT cas_id, GROUP_CONCAT(DISTINCT object_id) AS ids FROM file_path "
+        "WHERE cas_id IS NOT NULL AND object_id IS NOT NULL "
+        "GROUP BY cas_id HAVING COUNT(DISTINCT object_id) > 1"
+    )
+    for r in exact:
+        out.append(
+            {
+                "object_ids": [int(i) for i in r["ids"].split(",")],
+                "kind": "exact",
+            }
+        )
+    return out

@@ -1,0 +1,225 @@
+"""Perceptual hashing + all-pairs similarity on the device.
+
+BASELINE.json config 5 (full-library dedup) — no reference counterpart
+(spacedrive dedups by exact cas_id only); this is the TPU-native
+extension the survey's build plan calls for (SURVEY.md §7 compute
+plane): batched 64-bit DCT pHash, then all-pairs Hamming distance as
+one ±1 matmul on the MXU, shardable over a device mesh for
+million-image libraries.
+
+Math: image → grayscale 32×32 → 2-D DCT-II (two matmuls with the
+orthonormal DCT basis — MXU work, not a specialized transform) → the
+8×8 low-frequency block minus the DC term → threshold at the median →
+64 bits. Similarity: with bits mapped to ±1, G = B @ B.T counts
+(agreements − disagreements), so hamming = (64 − G) / 2 — an [N,64] ×
+[64,N] matmul instead of N²·64 XOR/popcounts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+
+HASH_BITS = 64
+DCT_SIZE = 32
+LOW_FREQ = 8
+
+
+@functools.lru_cache(maxsize=4)
+def _dct_basis(n: int = DCT_SIZE) -> np.ndarray:
+    """Orthonormal DCT-II basis matrix [n, n]: X = C @ x @ C.T."""
+    k = np.arange(n)[:, None]
+    i = np.arange(n)[None, :]
+    c = np.sqrt(2.0 / n) * np.cos(np.pi * (2 * i + 1) * k / (2 * n))
+    c[0] /= np.sqrt(2.0)
+    return c.astype(np.float32)
+
+
+@functools.lru_cache(maxsize=1)
+def _phash_fn():
+    import jax
+    import jax.numpy as jnp
+
+    basis = jnp.asarray(_dct_basis())
+
+    @jax.jit
+    def phash_batch(gray: jax.Array) -> jax.Array:
+        """float32[B, 32, 32] (0..1 grayscale) -> bool[B, 64]."""
+        # 2-D DCT via two matmuls: C @ img @ C.T  (batched on the MXU)
+        coeffs = jnp.einsum("ij,bjk,lk->bil", basis, gray, basis)
+        low = coeffs[:, :LOW_FREQ, :LOW_FREQ].reshape(-1, LOW_FREQ * LOW_FREQ)
+        ac = low.at[:, 0].set(0.0)  # drop the DC term
+        med = jnp.median(ac[:, 1:], axis=1, keepdims=True)
+        return ac > med
+
+    return phash_batch
+
+
+@functools.lru_cache(maxsize=1)
+def _hamming_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def hamming_all_pairs(bits: jax.Array) -> jax.Array:
+        """bool[N, 64] -> uint8[N, N] pairwise Hamming distances."""
+        pm = jnp.where(bits, 1.0, -1.0).astype(jnp.bfloat16)
+        gram = (pm @ pm.T).astype(jnp.float32)  # agreements − disagreements
+        return ((HASH_BITS - gram) * 0.5).astype(jnp.uint8)
+
+    return hamming_all_pairs
+
+
+def to_gray32(rgba: np.ndarray) -> np.ndarray:
+    """HxWx4 uint8 → 32×32 float32 grayscale (area-mean downsample)."""
+    from PIL import Image
+
+    img = Image.fromarray(rgba[..., :3]).convert("L").resize(
+        (DCT_SIZE, DCT_SIZE), Image.BILINEAR
+    )
+    return np.asarray(img, np.float32) / 255.0
+
+
+def phash_batch(gray: np.ndarray) -> np.ndarray:
+    """float32[B, 32, 32] → packed uint8[B, 8] hashes (big-endian bits)."""
+    bits = np.asarray(_phash_fn()(gray))
+    return np.packbits(bits, axis=1)
+
+
+def phash_one(rgba: np.ndarray) -> bytes:
+    return phash_batch(to_gray32(rgba)[None])[0].tobytes()
+
+
+def unpack_hashes(hashes: list[bytes]) -> np.ndarray:
+    """list of 8-byte hashes → bool[N, 64]."""
+    arr = np.frombuffer(b"".join(hashes), np.uint8).reshape(-1, 8)
+    return np.unpackbits(arr, axis=1).astype(bool)
+
+
+def hamming_matrix(hashes: list[bytes]) -> np.ndarray:
+    """All-pairs Hamming distances, device matmul (uint8[N, N])."""
+    if not hashes:
+        return np.zeros((0, 0), np.uint8)
+    return np.asarray(_hamming_fn()(unpack_hashes(hashes)))
+
+
+_sharded_fns: dict[tuple, Any] = {}
+
+
+def _sharded_pairs_fn(mesh: Any):
+    """One compiled program per mesh (jit caches key on the fn object,
+    so the closure must be cached, not re-created per call)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    key = tuple(d.id for d in mesh.devices.flat)
+    fn = _sharded_fns.get(key)
+    if fn is None:
+        fn = jax.jit(
+            lambda b: (
+                (
+                    HASH_BITS
+                    - (
+                        (w := jnp.where(b, 1.0, -1.0).astype(jnp.bfloat16))
+                        @ w.T
+                    ).astype(jnp.float32)
+                )
+                * 0.5
+            ).astype(jnp.uint8),
+            in_shardings=NamedSharding(mesh, P("dp", None)),
+            out_shardings=NamedSharding(mesh, P("dp", None)),
+        )
+        _sharded_fns[key] = fn
+    return fn
+
+
+def hamming_matrix_sharded(hashes: list[bytes], mesh: Any = None) -> np.ndarray:
+    """Mesh-sharded all-pairs for large N: rows split over the 'dp'
+    axis, each device holding the full ±1 matrix columns (64 wide —
+    tiny), XLA inserting the all-gather (SURVEY §2.4 DP analogue)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if not hashes:
+        return np.zeros((0, 0), np.uint8)
+    if mesh is None:
+        devices = jax.devices()
+        mesh = Mesh(np.array(devices), ("dp",))
+    bits = unpack_hashes(hashes)
+    n = bits.shape[0]
+    pad = (-n) % mesh.devices.size
+    if pad:
+        bits = np.concatenate([bits, np.zeros((pad, HASH_BITS), bool)])
+    out = np.asarray(_sharded_pairs_fn(mesh)(bits))
+    return out[:n, :n]
+
+
+@functools.lru_cache(maxsize=1)
+def _block_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def block(rows: jax.Array, all_bits: jax.Array) -> jax.Array:
+        """bool[B, 64] x bool[N, 64] -> uint8[B, N] distances."""
+        a = jnp.where(rows, 1.0, -1.0).astype(jnp.bfloat16)
+        b = jnp.where(all_bits, 1.0, -1.0).astype(jnp.bfloat16)
+        gram = (a @ b.T).astype(jnp.float32)
+        return ((HASH_BITS - gram) * 0.5).astype(jnp.uint8)
+
+    return block
+
+
+PAIR_BLOCK = 4096
+
+
+def near_pairs(hashes: list[bytes], threshold: int):
+    """Yield (i, j) index pairs (i < j) within `threshold` bits, in
+    fixed-size row blocks — device memory and host transfers stay at
+    O(block × N) so million-image libraries never materialize N²."""
+    if not hashes:
+        return
+    bits = unpack_hashes(hashes)
+    n = bits.shape[0]
+    pad = (-n) % PAIR_BLOCK
+    padded = (
+        np.concatenate([bits, np.ones((pad, HASH_BITS), bool)]) if pad else bits
+    )
+    block = _block_fn()
+    for off in range(0, n, PAIR_BLOCK):
+        dist = np.asarray(block(padded[off : off + PAIR_BLOCK], bits))
+        rows, cols = np.nonzero(dist <= threshold)
+        for r, c in zip(rows, cols):
+            i = off + int(r)
+            if i < int(c) and i < n:
+                yield i, int(c)
+
+
+def duplicate_groups(
+    hashes: list[tuple[Any, bytes]], threshold: int = 8, **_compat: Any
+) -> list[list[Any]]:
+    """Group ids whose pHashes are within `threshold` bits (union-find
+    over blockwise-thresholded pairs; never builds the N×N matrix)."""
+    if not hashes:
+        return []
+    ids = [i for i, _h in hashes]
+    n = len(ids)
+    parent = list(range(n))
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for r, c in near_pairs([h for _i, h in hashes], threshold):
+        ra, rb = find(r), find(c)
+        if ra != rb:
+            parent[rb] = ra
+    groups: dict[int, list[Any]] = {}
+    for idx in range(n):
+        groups.setdefault(find(idx), []).append(ids[idx])
+    return [g for g in groups.values() if len(g) > 1]

@@ -1,0 +1,138 @@
+"""pHash dedup: DCT hash properties, Hamming matmul (plain + sharded
+mesh), duplicate grouping, end-to-end job over a library.
+
+BASELINE.json config 5 — the TPU-native dedup extension (SURVEY §7).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.ops import phash_jax
+
+
+def _img(color, size=(128, 96), noise=0.0, seed=0):
+    """Photo-like fixture: blurred random structure (smooth gradients are
+    pathological for pHash — near-zero AC energy makes bits coin flips)."""
+    from PIL import Image, ImageFilter
+
+    rng = np.random.default_rng(seed)
+    base = (rng.random((size[1], size[0], 3)) * 255).astype(np.uint8)
+    img = Image.fromarray(base).filter(ImageFilter.GaussianBlur(6))
+    rgb = np.asarray(img).astype(np.float64)
+    rgb = np.clip(rgb * 0.6 + np.asarray(color, np.float64) * 0.4, 0, 255)
+    if noise:
+        rgb = np.clip(rgb + rng.normal(0, noise * 255, rgb.shape), 0, 255)
+    return np.dstack(
+        [rgb.astype(np.uint8), np.full((size[1], size[0], 1), 255, np.uint8)]
+    )
+
+
+def _hamming(a: bytes, b: bytes) -> int:
+    return int(
+        np.unpackbits(np.frombuffer(a, np.uint8))
+        .astype(int)
+        .__xor__(np.unpackbits(np.frombuffer(b, np.uint8)).astype(int))
+        .sum()
+    )
+
+
+def test_phash_properties():
+    base = _img((200, 40, 40))
+    same = phash_jax.phash_one(base)
+    assert len(same) == 8
+    # deterministic
+    assert phash_jax.phash_one(base) == same
+    # resize-invariant-ish: same image at half size hashes close
+    from PIL import Image
+
+    small = np.asarray(
+        Image.fromarray(base).resize((64, 48)).convert("RGBA")
+    )
+    assert _hamming(same, phash_jax.phash_one(small)) <= 6
+    # slight noise stays close, different structure lands far
+    noisy = _img((200, 40, 40), noise=0.02, seed=0)  # same structure + noise
+    assert _hamming(same, phash_jax.phash_one(noisy)) <= 10
+    other = _img((10, 220, 30), seed=2)  # different random structure
+    assert _hamming(same, phash_jax.phash_one(other)) > 12
+
+
+def test_hamming_matmul_matches_xor():
+    rng = np.random.default_rng(0)
+    hashes = [rng.integers(0, 256, 8, np.uint8).tobytes() for _ in range(17)]
+    mat = phash_jax.hamming_matrix(hashes)
+    assert mat.shape == (17, 17) and mat.dtype == np.uint8
+    for i in range(17):
+        assert mat[i, i] == 0
+        for j in range(17):
+            assert mat[i, j] == _hamming(hashes[i], hashes[j])
+
+
+def test_hamming_sharded_matches_plain():
+    rng = np.random.default_rng(1)
+    hashes = [rng.integers(0, 256, 8, np.uint8).tobytes() for _ in range(21)]
+    plain = phash_jax.hamming_matrix(hashes)
+    sharded = phash_jax.hamming_matrix_sharded(hashes)  # 8-dev CPU mesh
+    assert np.array_equal(plain, sharded)
+
+
+def test_duplicate_groups_union_find():
+    h0 = b"\x00" * 8
+    h1 = b"\x01" + b"\x00" * 7  # 1 bit from h0
+    h2 = b"\x03" + b"\x00" * 7  # 1 bit from h1, 2 from h0 (chain merge)
+    far = b"\xff" * 8
+    groups = phash_jax.duplicate_groups(
+        [("a", h0), ("b", h1), ("c", h2), ("d", far)], threshold=1
+    )
+    assert sorted(groups[0]) == ["a", "b", "c"] and len(groups) == 1
+
+
+def test_duplicate_job_end_to_end(tmp_path):
+    async def run():
+        from PIL import Image
+
+        from spacedrive_tpu.jobs.manager import JobBuilder
+        from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+        from spacedrive_tpu.node import Node
+        from spacedrive_tpu.object.duplicates import DuplicateDetectorJob, find_duplicates
+
+        corpus = tmp_path / "corpus"
+        corpus.mkdir()
+        base = _img((180, 80, 40), size=(200, 150))
+        Image.fromarray(base).convert("RGB").save(corpus / "original.jpg", quality=95)
+        # near-duplicate: recompressed + slightly resized
+        Image.fromarray(base).convert("RGB").resize((190, 142)).save(
+            corpus / "copy.jpg", quality=70
+        )
+        distinct = _img((20, 200, 60), size=(200, 150), seed=5)
+        Image.fromarray(distinct).convert("RGB").save(corpus / "other.jpg")
+
+        node = Node(str(tmp_path / "node"), use_device=False, with_labeler=False)
+        node.config.config.p2p.enabled = False
+        await node.start()
+        lib = await node.create_library("pics")
+        loc = LocationCreateArgs(path=str(corpus)).create(lib)
+        await scan_location(lib, loc, node.jobs)
+        await node.jobs.wait_idle()
+        try:
+            await JobBuilder(DuplicateDetectorJob({})).spawn(node.jobs, lib)
+            await node.jobs.wait_idle()
+            hashed = lib.db.count("object", "phash IS NOT NULL")
+            assert hashed == 3
+            groups = find_duplicates(lib, threshold=10)
+            near = [g for g in groups if g["kind"] == "near"]
+            assert len(near) == 1 and len(near[0]["object_ids"]) == 2
+            # the pair is original+copy, not `other`
+            other_obj = lib.db.find_one("file_path", name="other")["object_id"]
+            assert other_obj not in near[0]["object_ids"]
+            # over the API
+            api_groups = await node.router.exec(
+                node, "search.duplicates", {"threshold": 10}, library_id=str(lib.id)
+            )
+            assert api_groups == groups
+        finally:
+            await node.shutdown()
+
+    asyncio.run(run())
