@@ -1,0 +1,7 @@
+"""`python -m spacedrive_tpu` → the sdx CLI."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

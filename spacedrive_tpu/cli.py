@@ -1,0 +1,284 @@
+"""sdx — the command-line host.
+
+Parity: two reference hosts in one binary — the headless server
+(ref:apps/server/src/main.rs: node + HTTP API) and the crypto
+inspector CLI (ref:apps/cli/src/main.rs: prints encrypted-file header
+details). Plus the survey's build-plan surface (SURVEY §7 step 4):
+`sdx index <path> --backend=tpu|cpu` and `sdx bench`.
+
+Run as `python -m spacedrive_tpu <command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from typing import Any
+
+DEFAULT_DATA_DIR = os.path.expanduser("~/.spacedrive_tpu")
+
+
+def _make_node(args: argparse.Namespace, **kwargs: Any):
+    from .node import Node
+
+    node = Node(
+        args.data_dir,
+        use_device=(getattr(args, "backend", "tpu") != "cpu"),
+        **kwargs,
+    )
+    if getattr(args, "no_p2p", False):
+        node.config.config.p2p.enabled = False
+    return node
+
+
+async def _get_or_create_library(node, name: str):
+    for lib in node.libraries.libraries.values():
+        if lib.name == name:
+            return lib
+    return await node.create_library(name)
+
+
+# --- commands -------------------------------------------------------------
+
+
+async def cmd_index(args: argparse.Namespace) -> int:
+    from .location.locations import LocationCreateArgs, scan_location
+    from .node.statistics import update_statistics
+
+    node = _make_node(args)
+    await node.start()
+    try:
+        lib = await _get_or_create_library(node, args.library)
+        existing = lib.db.find_one("location", path=os.path.abspath(args.path))
+        t0 = time.perf_counter()
+        if existing is None:
+            loc = LocationCreateArgs(path=args.path).create(lib)
+        else:
+            loc = existing
+        await scan_location(lib, loc, node.jobs, backend=args.backend)
+        await node.jobs.wait_idle()
+        await node.thumbnailer.wait_library_batch(str(lib.id))
+        elapsed = time.perf_counter() - t0
+        stats = update_statistics(lib.db, node.thumbnailer.data_dir)
+        files = lib.db.count("file_path", "is_dir = 0")
+        print(
+            json.dumps(
+                {
+                    "library": lib.name,
+                    "location_id": loc["id"],
+                    "files": files,
+                    "objects": stats["total_object_count"],
+                    "bytes": int(stats["total_bytes_used"]),
+                    "thumbnails": node.thumbnailer.generated,
+                    "labeled": node.image_labeler.labeled
+                    if node.image_labeler
+                    else 0,
+                    "backend": args.backend,
+                    "seconds": round(elapsed, 2),
+                }
+            )
+        )
+        return 0
+    finally:
+        await node.shutdown()
+
+
+async def cmd_serve(args: argparse.Namespace) -> int:
+    node = _make_node(args)
+    await node.start()
+    port = await node.start_api(host=args.host, port=args.port)
+    print(f"sdx serving on http://{args.host}:{port}  (rspc: /rspc/<key>)")
+    if node.p2p is not None:
+        print(f"p2p on port {node.p2p.port}, identity {node.p2p.p2p.remote_identity}")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await node.shutdown()
+    return 0
+
+
+async def cmd_status(args: argparse.Namespace) -> int:
+    node = _make_node(args, with_labeler=False)
+    await node.start()
+    try:
+        out = await node.router.exec(node, "nodeState")
+        out["libraries"] = []
+        for lib in node.libraries.libraries.values():
+            reports = await node.router.exec(
+                node, "jobs.reports", library_id=str(lib.id)
+            )
+            out["libraries"].append(
+                {
+                    "id": str(lib.id),
+                    "name": lib.name,
+                    "file_paths": lib.db.count("file_path"),
+                    "objects": lib.db.count("object"),
+                    "recent_jobs": reports[:5],
+                }
+            )
+        print(json.dumps(out, indent=2))
+        return 0
+    finally:
+        await node.shutdown()
+
+
+async def cmd_browse(args: argparse.Namespace) -> int:
+    from .location.non_indexed import walk_dir
+
+    node = _make_node(args, with_labeler=False)
+    try:
+        listing = walk_dir(node, args.path, with_hidden=args.hidden,
+                           queue_thumbnails=False)
+        for e in listing["entries"]:
+            kind = "dir " if e["is_dir"] else "file"
+            print(f"{kind}  {e['size_in_bytes']:>12}  {e['name']}"
+                  + (f".{e['extension']}" if e["extension"] else ""))
+        return 0
+    finally:
+        await node.shutdown()
+
+
+async def cmd_duplicates(args: argparse.Namespace) -> int:
+    from .jobs.manager import JobBuilder
+    from .object.duplicates import DuplicateDetectorJob, find_duplicates
+
+    node = _make_node(args, with_labeler=False)
+    await node.start()
+    try:
+        lib = await _get_or_create_library(node, args.library)
+        await JobBuilder(
+            DuplicateDetectorJob({"threshold": args.threshold})
+        ).spawn(node.jobs, lib)
+        await node.jobs.wait_idle()
+        groups = find_duplicates(lib, threshold=args.threshold)
+        print(json.dumps(groups, indent=2))
+        return 0
+    finally:
+        await node.shutdown()
+
+
+def cmd_crypto(args: argparse.Namespace) -> int:
+    from .crypto import FileHeader, decrypt_file, encrypt_file
+
+    if args.crypto_cmd == "inspect":
+        # ref:apps/cli/src/main.rs — print header details
+        with open(args.file, "rb") as f:
+            header, raw = FileHeader.from_reader(f)
+        print(
+            json.dumps(
+                {
+                    "version": header.version,
+                    "algorithm": header.algorithm.name,
+                    "keyslots": [
+                        {
+                            "hashing": ks.hashing_algorithm.kind,
+                            "params": int(ks.hashing_algorithm.params),
+                        }
+                        for ks in header.keyslots
+                    ],
+                    "has_metadata": header.metadata is not None,
+                    "has_preview_media": header.preview_media is not None,
+                    "header_bytes": len(raw),
+                },
+                indent=2,
+            )
+        )
+    elif args.crypto_cmd == "encrypt":
+        import getpass
+
+        pw = args.password or getpass.getpass("password: ")
+        encrypt_file(args.file, args.file + ".sdenc", pw.encode())
+        print(f"wrote {args.file}.sdenc")
+    elif args.crypto_cmd == "decrypt":
+        import getpass
+
+        pw = args.password or getpass.getpass("password: ")
+        out = (
+            args.file[: -len(".sdenc")]
+            if args.file.endswith(".sdenc")
+            else args.file + ".decrypted"
+        )
+        meta = decrypt_file(args.file, out, pw.encode())
+        print(f"wrote {out}" + (f"  metadata: {meta}" if meta else ""))
+    return 0
+
+
+def cmd_bench(_args: argparse.Namespace) -> int:
+    import runpy
+
+    bench = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+    runpy.run_path(bench, run_name="__main__")
+    return 0
+
+
+# --- argument parsing -----------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="sdx", description=__doc__)
+    p.add_argument("--data-dir", default=DEFAULT_DATA_DIR)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ix = sub.add_parser("index", help="index a directory into a library")
+    ix.add_argument("path")
+    ix.add_argument("--backend", choices=["tpu", "cpu", "auto"], default="auto")
+    ix.add_argument("--library", default="default")
+    ix.add_argument("--no-p2p", action="store_true")
+
+    sv = sub.add_parser("serve", help="run the node + HTTP API")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8080)
+    sv.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
+
+    st = sub.add_parser("status", help="node + library status")
+    st.add_argument("--no-p2p", action="store_true", default=True)
+
+    br = sub.add_parser("browse", help="ephemeral (non-indexed) listing")
+    br.add_argument("path")
+    br.add_argument("--hidden", action="store_true")
+
+    du = sub.add_parser("duplicates", help="find duplicate images")
+    du.add_argument("--library", default="default")
+    du.add_argument("--threshold", type=int, default=8)
+    du.add_argument("--no-p2p", action="store_true", default=True)
+
+    cr = sub.add_parser("crypto", help="encrypted-file tools")
+    crs = cr.add_subparsers(dest="crypto_cmd", required=True)
+    for name in ("inspect", "encrypt", "decrypt"):
+        c = crs.add_parser(name)
+        c.add_argument("file")
+        if name != "inspect":
+            c.add_argument("--password")
+
+    sub.add_parser("bench", help="run the headline benchmark")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "index":
+        return asyncio.run(cmd_index(args))
+    if args.cmd == "serve":
+        return asyncio.run(cmd_serve(args))
+    if args.cmd == "status":
+        return asyncio.run(cmd_status(args))
+    if args.cmd == "browse":
+        return asyncio.run(cmd_browse(args))
+    if args.cmd == "duplicates":
+        return asyncio.run(cmd_duplicates(args))
+    if args.cmd == "crypto":
+        return cmd_crypto(args)
+    if args.cmd == "bench":
+        return cmd_bench(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,9 +99,15 @@ class ImageLabeler:
         import jax
 
         self._model = labeler_model.LabelerNet()
-        self._params = labeler_model.init_params(
-            jax.random.key(0), image_size=self.image_size, model=self._model
-        )
+        # init on host CPU: flax init traced over a tunneled TPU pays a
+        # ~100 s round-trip-heavy compile for what is just param setup;
+        # one eager device_put below replaces all that traffic
+        with jax.default_device(jax.devices("cpu")[0]):
+            self._params = labeler_model.init_params(
+                jax.random.key(0), image_size=self.image_size, model=self._model
+            )
+        if self.use_device:
+            self._params = jax.device_put(self._params, jax.devices()[0])
         model = self._model
 
         @jax.jit

@@ -268,7 +268,13 @@ class Thumbnailer:
     async def _account(self, batch: Batch, n: int) -> None:
         assert self._cond is not None
         async with self._cond:
-            self._pending[self._ns(batch.library_id)] -= n
+            ns = self._ns(batch.library_id)
+            self._pending[ns] -= n
+            if self._pending[ns] <= 0:
+                # drop zeroed keys: a Counter with zero values is still
+                # truthy, which turns `while thumbnailer._pending` polls
+                # into infinite loops
+                del self._pending[ns]
             self._batch_pending[batch.id] -= n
             if self._batch_pending[batch.id] <= 0:
                 del self._batch_pending[batch.id]

@@ -1,0 +1,76 @@
+"""sdx CLI smoke: index → status → browse → duplicates → crypto.
+
+Parity targets: ref:apps/server (headless host), apps/cli (crypto
+inspector), SURVEY §7 step 4 CLI surface.
+"""
+
+import json
+import os
+
+from spacedrive_tpu.cli import build_parser, main
+
+
+def test_parser_covers_commands():
+    p = build_parser()
+    args = p.parse_args(["index", "/x", "--backend", "cpu"])
+    assert args.cmd == "index" and args.backend == "cpu"
+    args = p.parse_args(["crypto", "inspect", "/y"])
+    assert args.crypto_cmd == "inspect"
+    for cmd in (["serve"], ["status"], ["browse", "/x"], ["duplicates"], ["bench"]):
+        assert p.parse_args(cmd).cmd == cmd[0]
+
+
+def test_cli_index_browse_crypto(tmp_path, capsys):
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    (corpus / "a.txt").write_bytes(b"hello world" * 100)
+    (corpus / "b.bin").write_bytes(os.urandom(4096))
+    data_dir = str(tmp_path / "home")
+
+    rc = main(
+        ["--data-dir", data_dir, "index", str(corpus), "--backend", "cpu", "--no-p2p"]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["files"] == 2 and out["objects"] == 2 and out["backend"] == "cpu"
+
+    rc = main(["--data-dir", data_dir, "browse", str(corpus)])
+    assert rc == 0
+    listing = capsys.readouterr().out
+    assert "a.txt" in listing and "b.bin" in listing
+
+    rc = main(["--data-dir", data_dir, "status"])
+    assert rc == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["libraries"][0]["file_paths"] >= 2
+    assert {j["name"] for j in status["libraries"][0]["recent_jobs"]} >= {
+        "indexer",
+        "file_identifier",
+    }
+
+    # crypto roundtrip through the CLI (reference apps/cli surface)
+    secret = tmp_path / "s.txt"
+    secret.write_text("classified")
+    rc = main(
+        ["--data-dir", data_dir, "crypto", "encrypt", str(secret), "--password", "pw"]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["--data-dir", data_dir, "crypto", "inspect", str(secret) + ".sdenc"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["algorithm"] == "XCHACHA20_POLY1305" and len(info["keyslots"]) == 1
+    secret.unlink()
+    rc = main(
+        [
+            "--data-dir",
+            data_dir,
+            "crypto",
+            "decrypt",
+            str(secret) + ".sdenc",
+            "--password",
+            "pw",
+        ]
+    )
+    assert rc == 0
+    assert secret.read_text() == "classified"
