@@ -42,6 +42,8 @@ def mount() -> Router:
     _search(r)
     _cloud(r)
     _tags(r)
+    _spaces(r)
+    _albums(r)
     _labels(r)
     _sync(r)
     _p2p(r)
@@ -664,6 +666,76 @@ def _tags(r: Router) -> None:
                 )
         invalidate_query(node, "tags.getForObject", library)
         return None
+
+
+# --- spaces / albums (ref:schema.prisma space/album models) --------------
+
+
+def _collection_ns(r: Router, ns: str, table: str, link_table: str, link_col: str) -> None:
+    """spaces and albums share the same CRUD shape."""
+
+    @r.query(f"{ns}.list", library=True)
+    def list_all(node, library):
+        return normalise(table, library.db.find(table))
+
+    @r.query(f"{ns}.getObjects", library=True)
+    def get_objects(node, library, arg):
+        rows = library.db.query(
+            f"SELECT o.* FROM object o JOIN {link_table} l ON l.object_id = o.id "
+            f"WHERE l.{link_col} = ?",
+            (int(arg),),
+        )
+        return normalise("object", rows)
+
+    @r.mutation(f"{ns}.create", library=True)
+    def create(node, library, arg):
+        cols = dict(
+            pub_id=new_pub_id(),
+            name=arg["name"],
+            date_created=now_iso(),
+            date_modified=now_iso(),
+        )
+        if table == "space":
+            cols["description"] = arg.get("description")
+        rid = library.db.insert(table, **cols)
+        invalidate_query(node, f"{ns}.list", library)
+        return rid
+
+    @r.mutation(f"{ns}.delete", library=True)
+    def delete(node, library, arg):
+        library.db.delete(link_table, **{link_col: int(arg)})
+        library.db.delete(table, id=int(arg))
+        invalidate_query(node, f"{ns}.list", library)
+        return None
+
+    @r.mutation(f"{ns}.addObjects", library=True)
+    def add_objects(node, library, arg):
+        for oid in arg["object_ids"]:
+            if arg.get("remove"):
+                library.db.delete(
+                    link_table, **{link_col: int(arg["id"]), "object_id": int(oid)}
+                )
+            else:
+                extra = (
+                    {"date_created": now_iso()}
+                    if link_table == "object_in_album"  # space link has no column
+                    else {}
+                )
+                library.db.upsert(
+                    link_table,
+                    {link_col: int(arg["id"]), "object_id": int(oid)},
+                    **extra,
+                )
+        invalidate_query(node, f"{ns}.getObjects", library)
+        return None
+
+
+def _spaces(r: Router) -> None:
+    _collection_ns(r, "spaces", "space", "object_in_space", "space_id")
+
+
+def _albums(r: Router) -> None:
+    _collection_ns(r, "albums", "album", "object_in_album", "album_id")
 
 
 # --- labels --------------------------------------------------------------

@@ -54,6 +54,7 @@ class ApiServer:
         self.app = web.Application()
         self.app.add_routes(
             [
+                web.get("/", self._index),
                 web.post("/rspc/{key}", self._rspc_http),
                 web.get("/rspc/ws", self._rspc_ws),
                 web.get("/spacedrive/thumbnail/{ns}/{shard}/{name}", self._thumbnail),
@@ -80,6 +81,13 @@ class ApiServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+
+    async def _index(self, _request: web.Request) -> web.FileResponse:
+        """The explorer web UI (role parity: ref:interface/ + apps/web)."""
+        return web.FileResponse(
+            os.path.join(os.path.dirname(__file__), "static", "explorer.html"),
+            headers={"Content-Type": "text/html; charset=utf-8"},
+        )
 
     # --- rspc ----------------------------------------------------------
 
@@ -215,6 +223,11 @@ class ApiServer:
         if os.path.commonpath([full, loc_root]) != loc_root:
             raise web.HTTPBadRequest(text="bad path")
         if not os.path.isfile(full):
+            # the file may live on another node: ServeFrom::Remote
+            # (ref:custom_uri/mod.rs:240-268 streams it over P2P)
+            remote = await self._serve_remote(request, lib, loc, rel)
+            if remote is not None:
+                return remote
             raise web.HTTPNotFound()
         ctype = mimetypes.guess_type(full)[0] or _sniff_mime(full)
         # FileResponse implements Range (206/Content-Range/416, incl.
@@ -223,6 +236,141 @@ class ApiServer:
             full,
             headers={"Content-Type": ctype, "Accept-Ranges": "bytes"},
         )
+
+
+    async def _serve_remote(
+        self, request: web.Request, lib: Any, loc: dict[str, Any], rel: str
+    ) -> web.StreamResponse | None:
+        """Pull a file owned by another instance over P2P and serve it
+        (ref:custom_uri/mod.rs ServeFrom::Remote)."""
+        import io
+
+        from ..files.isolated_path import IsolatedFilePathData
+        from ..node.config import BackendFeature
+
+        p2p = self.node.p2p
+        if p2p is None or not self.node.is_feature_enabled(
+            BackendFeature.FILES_OVER_P2P
+        ):
+            return None
+        iso = IsolatedFilePathData.from_relative_str(
+            loc["id"], rel.replace(os.sep, "/"), False
+        )
+        row = lib.db.find_one(
+            "file_path",
+            location_id=loc["id"],
+            materialized_path=iso.materialized_path,
+            name=iso.name,
+            extension=iso.extension,
+        )
+        if row is None:
+            return None
+        # owner instance first when known (instance_id is a local-only
+        # cache, ref:schema.prisma:126), then every other library peer
+        peers = []
+        if loc.get("instance_id") is not None:
+            inst = lib.db.find_one("instance", id=loc["instance_id"])
+            if inst is not None:
+                peer = p2p.peer_for_instance(uuid.UUID(bytes=inst["pub_id"]))
+                if peer is not None:
+                    peers.append(peer)
+        for peer in p2p.peers_for_library(lib.id):
+            if peer not in peers:
+                peers.append(peer)
+        from ..p2p.block import Range as BlockRange
+        from ..p2p.operations import request_file
+
+        # honor HTTP Range: fetch only the requested span over P2P
+        from ..db.database import blob_u64
+
+        total = blob_u64(row.get("size_in_bytes_bytes")) or 0
+        try:
+            rng = request.http_range
+            start, stop = rng.start, rng.stop
+        except ValueError:
+            raise web.HTTPRequestRangeNotSatisfiable()
+        ranged = start is not None or stop is not None
+        if ranged:
+            start = start if start is not None else 0
+            if start < 0:  # suffix range bytes=-N
+                start = max(0, total + start)
+            stop = min(stop, total) if stop is not None else total
+            if total and start >= total:
+                raise web.HTTPRequestRangeNotSatisfiable(
+                    headers={"Content-Range": f"bytes */{total}"}
+                )
+            block_range = BlockRange(start, stop)
+        else:
+            block_range = BlockRange()
+
+        ctype = mimetypes.guess_type(rel)[0] or "application/octet-stream"
+        for peer in peers:
+            sink = _StreamSink()
+            fetch = asyncio.ensure_future(
+                request_file(
+                    p2p.p2p, peer.identity, lib.id,
+                    uuid.UUID(bytes=row["pub_id"]), sink, range=block_range,
+                )
+            )
+            try:
+                # wait for the first block before committing a response,
+                # so a failed peer falls through to the next one
+                first = await sink.next_chunk(fetch)
+            except Exception as e:
+                logger.debug("remote fetch from %s failed: %s", peer.identity, e)
+                continue
+            if ranged:
+                resp = web.StreamResponse(
+                    status=206,
+                    headers={
+                        "Content-Type": ctype,
+                        "Content-Range": f"bytes {start}-{stop - 1}/{total}",
+                        "Accept-Ranges": "bytes",
+                    },
+                )
+            else:
+                resp = web.StreamResponse(
+                    headers={"Content-Type": ctype, "Accept-Ranges": "bytes"}
+                )
+            await resp.prepare(request)
+            if first is not None:
+                await resp.write(first)
+                while (chunk := await sink.next_chunk(fetch)) is not None:
+                    await resp.write(chunk)
+            await fetch
+            await resp.write_eof()
+            return resp
+        return None
+
+
+class _StreamSink:
+    """File-like sink bridging Transfer.receive's synchronous writes
+    into an async chunk stream (blocks arrive on the same loop)."""
+
+    def __init__(self) -> None:
+        self._chunks: list[bytes] = []
+        self._event = asyncio.Event()
+
+    def write(self, data: bytes) -> None:
+        self._chunks.append(data)
+        self._event.set()
+
+    async def next_chunk(self, fetch: "asyncio.Future") -> bytes | None:
+        """Next block, or None when the transfer completed; re-raises
+        the fetch task's error (incl. before the first block)."""
+        while not self._chunks:
+            if fetch.done():
+                fetch.result()  # raises on failure
+                return None
+            self._event.clear()
+            done, _pending = await asyncio.wait(
+                [fetch, asyncio.ensure_future(self._event.wait())],
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            for task in _pending:
+                if task is not fetch:
+                    task.cancel()
+        return self._chunks.pop(0)
 
 
 def _sniff_mime(path: str) -> str:

@@ -38,6 +38,7 @@ class BackendFeature(str, Enum):
 
     FILES_OVER_P2P = "filesOverP2P"
     CLOUD_SYNC = "cloudSync"
+    REMOTE_RSPC = "remoteRspc"  # serve queries to mesh peers (off by default)
 
 
 class P2PDiscoveryState(str, Enum):

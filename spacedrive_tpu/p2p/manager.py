@@ -73,6 +73,12 @@ class P2PManager:
                 "libraries": ",".join(
                     str(lid) for lid in self.node.libraries.libraries
                 ),
+                # instance → node mapping for remote file serving
+                # (ref:custom_uri/mod.rs ServeFrom::Remote resolution)
+                "instances": ",".join(
+                    str(lib.sync.instance)
+                    for lib in self.node.libraries.libraries.values()
+                ),
             }
         )
 
@@ -126,6 +132,15 @@ class P2PManager:
             if lid in p.metadata.get("libraries", "").split(",")
         ]
 
+    def peer_for_instance(self, instance: uuid.UUID) -> Any | None:
+        """The discovered peer advertising a library instance
+        (ref:p2p/libraries.rs instance discovery)."""
+        needle = str(instance)
+        for p in self.p2p.discovered_peers():
+            if needle in p.metadata.get("instances", "").split(","):
+                return p
+        return None
+
     # --- inbound dispatch (ref:manager.rs stream handler) --------------
 
     async def _handle_stream(self, stream: Any) -> None:
@@ -154,6 +169,10 @@ class P2PManager:
                 w = Writer(stream)
                 w.u8(0).string("filesOverP2P disabled")
                 await w.flush()
+        elif header.type == HeaderType.RSPC:
+            from .rspc import respond_rspc
+
+            await respond_rspc(stream, self.node)
         else:
             logger.warning("unhandled header type %s", header.type)
 

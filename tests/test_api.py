@@ -224,6 +224,32 @@ def test_api_full_flow(tmp_path, corpus):
                     seen.append(ev[1].key)
             assert "tags.list" in seen
 
+            # spaces + albums CRUD over existing objects
+            for ns in ("spaces", "albums"):
+                cid = await r.exec(
+                    node, f"{ns}.create", {"name": f"my-{ns}"}, library_id=lid
+                )
+                await r.exec(
+                    node,
+                    f"{ns}.addObjects",
+                    {"id": cid, "object_ids": [fp["object_id"]]},
+                    library_id=lid,
+                )
+                objs = await r.exec(node, f"{ns}.getObjects", cid, library_id=lid)
+                assert len(objs["items"]) == 1
+                listing = await r.exec(node, f"{ns}.list", library_id=lid)
+                assert listing["nodes"][0]["name"] == f"my-{ns}"
+                await r.exec(
+                    node,
+                    f"{ns}.addObjects",
+                    {"id": cid, "object_ids": [fp["object_id"]], "remove": True},
+                    library_id=lid,
+                )
+                objs = await r.exec(node, f"{ns}.getObjects", cid, library_id=lid)
+                assert objs["items"] == []
+                await r.exec(node, f"{ns}.delete", cid, library_id=lid)
+                assert (await r.exec(node, f"{ns}.list", library_id=lid))["items"] == []
+
             # ephemeral browse of a non-indexed dir
             eph = await r.exec(node, "ephemeralFiles.list", {"path": corpus})
             assert any(e["name"] == "nested" and e["is_dir"] for e in eph["entries"])
@@ -256,6 +282,13 @@ def test_http_server_and_custom_uri(tmp_path, corpus):
             port = await node.start_api()
             base = f"http://127.0.0.1:{port}"
             async with aiohttp.ClientSession() as http:
+                # explorer web UI at the root
+                async with http.get(f"{base}/") as resp:
+                    assert resp.status == 200
+                    page = await resp.text()
+                    assert "spacedrive-tpu explorer" in page
+                    assert "/rspc/ws" in page  # live-update wiring present
+
                 # rspc over HTTP
                 async with http.post(f"{base}/rspc/buildInfo", json={}) as resp:
                     assert resp.status == 200

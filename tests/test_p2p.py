@@ -516,6 +516,65 @@ def test_two_node_sync_convergence_and_file_request(tmp_path):
                 sink,
             )
             assert sink.getvalue() == blobs["doc1.bin"] and size == len(blobs["doc1.bin"])
+
+            # rspc-over-p2p: beta drives alpha's API across the mesh —
+            # refused until alpha opts into remoteRspc, queries only
+            from spacedrive_tpu.p2p.rspc import RemoteRspcError, remote_exec
+
+            with pytest.raises(RemoteRspcError) as exc:
+                await remote_exec(
+                    b.p2p.p2p, a.p2p.p2p.remote_identity, "buildInfo"
+                )
+            assert exc.value.code == 403
+            a.toggle_feature(BackendFeature.REMOTE_RSPC, True)
+            with pytest.raises(RemoteRspcError):  # mutations stay blocked
+                await remote_exec(
+                    b.p2p.p2p, a.p2p.p2p.remote_identity,
+                    "tags.create", {"name": "evil"}, library_id=str(lib_a.id),
+                )
+            info = await remote_exec(
+                b.p2p.p2p, a.p2p.p2p.remote_identity, "buildInfo"
+            )
+            assert info["version"]
+            remote_paths = await remote_exec(
+                b.p2p.p2p,
+                a.p2p.p2p.remote_identity,
+                "search.paths",
+                {"take": 10},
+                library_id=str(lib_a.id),
+            )
+            assert len(remote_paths["items"]) == lib_a.db.count("file_path")
+            with pytest.raises(RemoteRspcError):
+                await remote_exec(
+                    b.p2p.p2p, a.p2p.p2p.remote_identity, "nope.nothing"
+                )
+
+            # custom_uri ServeFrom::Remote: beta's HTTP serves a file
+            # whose on-disk location only alpha can resolve (the corpus
+            # moves; only alpha's DB learns the new path)
+            import aiohttp
+
+            moved = corpus + "-moved"
+            os.rename(corpus, moved)
+            lib_a.db.update("location", {"id": loc["id"]}, path=moved)
+            b.toggle_feature(BackendFeature.FILES_OVER_P2P, True)
+            port = await b.start_api()
+            loc_b = lib_b.db.find_one("location", pub_id=loc["pub_id"])
+            url = (
+                f"http://127.0.0.1:{port}/spacedrive/file/"
+                f"{lib_a.id}/{loc_b['id']}/doc2.bin"
+            )
+            async with aiohttp.ClientSession() as http:
+                async with http.get(url) as resp:
+                    assert resp.status == 200
+                    assert await resp.read() == blobs["doc2.bin"]
+                # ranged remote fetch streams only the requested span
+                async with http.get(
+                    url, headers={"Range": "bytes=100-299"}
+                ) as resp:
+                    assert resp.status == 206
+                    assert await resp.read() == blobs["doc2.bin"][100:300]
+                    assert resp.headers["Content-Range"].startswith("bytes 100-299/")
         finally:
             await a.shutdown()
             await b.shutdown()
