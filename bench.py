@@ -81,7 +81,7 @@ def main() -> None:
         log(f"cpu ({cores} threads): {cpu_s*1e3:.1f} ms  {cpu_fps:,.0f} files/s  "
             f"{total_bytes/cpu_s/1e9:.2f} GB/s")
         # parity spot-check: device digests == native digests
-        hexes = blake3_jax.words_to_hex(words, 32)
+        hexes = blake3_jax.words_to_hex(words, 64)
         for i in (0, n // 2, n - 1):
             assert hexes[i] == digests[i].hex(), f"digest mismatch at {i}"
         log("parity: device digests match native CPU digests")
