@@ -865,6 +865,31 @@ def _p2p(r: Router) -> None:
         _require_p2p(node).spacedrop.reject(uuid.UUID(arg))
         return None
 
+    @r.mutation("p2p.pairLibrary")
+    async def pair_library(node, arg):
+        """Join a peer's library (joiner side of the pairing flow)."""
+        from ..p2p.identity import RemoteIdentity
+
+        mgr = _require_p2p(node)
+        lib = await mgr.pairing.join(
+            mgr.p2p,
+            RemoteIdentity.from_str(arg["identity"]),
+            uuid.UUID(arg["library_id"]) if arg.get("library_id") else None,
+        )
+        invalidate_query(node, "library.list")
+        return str(lib.id)
+
+    @r.mutation("p2p.acceptPairing")
+    def accept_pairing(node, arg):
+        if not _require_p2p(node).pairing.accept(uuid.UUID(arg)):
+            raise RspcError.not_found("pairing request")
+        return None
+
+    @r.mutation("p2p.rejectPairing")
+    def reject_pairing(node, arg):
+        _require_p2p(node).pairing.reject(uuid.UUID(arg))
+        return None
+
     @r.subscription("p2p.events")
     async def events(node) -> AsyncIterator[Any]:
         if node.p2p is None:

@@ -35,6 +35,9 @@ class P2PManager:
         self.node = node
         self.p2p = P2P("spacedrive", node.config.config.identity)
         self.spacedrop = SpacedropManager(self.p2p, node.event_bus)
+        from .pairing import PairingManager
+
+        self.pairing = PairingManager(node, node.event_bus)
         self.ingest_actors: dict[uuid.UUID, IngestActor] = {}
         self._beacon_addrs = beacon_addrs
         self._bind_host = bind_host
@@ -173,6 +176,8 @@ class P2PManager:
             from .rspc import respond_rspc
 
             await respond_rspc(stream, self.node)
+        elif header.type == HeaderType.PAIRING:
+            await self.pairing.handle_inbound(stream)
         else:
             logger.warning("unhandled header type %s", header.type)
 

@@ -27,6 +27,7 @@ class HeaderType(enum.IntEnum):
     FILE = 4
     HTTP = 5
     RSPC = 6
+    PAIRING = 7  # library join request (ref: the reference's pairing flow)
 
 
 @dataclass

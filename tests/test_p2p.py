@@ -417,6 +417,90 @@ def test_spacedrop_between_nodes(tmp_path):
     asyncio.run(run())
 
 
+def test_library_pairing_over_mesh(tmp_path):
+    """The real join flow: no manual DB copying — beta pairs into
+    alpha's library over the mesh, then sync converges the data."""
+
+    async def run():
+        from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+        from spacedrive_tpu.sync.ingest import backfill_operations
+
+        a = await _make_node(tmp_path, "alpha")
+        b = await _make_node(tmp_path, "beta")
+        try:
+            lib_a = await a.create_library("family-photos")
+            corpus = os.path.join(tmp_path, "corpus")
+            os.makedirs(corpus)
+            for i in range(3):
+                with open(os.path.join(corpus, f"pic{i}.bin"), "wb") as f:
+                    f.write(os.urandom(1500 + i))
+            loc = LocationCreateArgs(path=corpus).create(lib_a)
+            backfill_operations(lib_a.sync)
+            await scan_location(lib_a, loc, a.jobs)
+            await a.jobs.wait_idle()
+
+            await _link(a, b)
+
+            # pairing needs consent: rejected until alpha accepts
+            offers = []
+            a.event_bus.on(
+                lambda ev: offers.append(ev[1])
+                if isinstance(ev, tuple) and ev and ev[0] == "PairingRequest"
+                else None
+            )
+
+            async def auto_accept():
+                for _ in range(100):
+                    if offers:
+                        a.p2p.pairing.accept(offers[0].id)
+                        return
+                    await asyncio.sleep(0.05)
+                pytest.fail("no pairing offer reached alpha's event bus")
+
+            lib_b_id, _ = await asyncio.gather(
+                b.router.exec(
+                    b,
+                    "p2p.pairLibrary",
+                    {
+                        "identity": str(a.p2p.p2p.remote_identity),
+                        "library_id": str(lib_a.id),
+                    },
+                ),
+                auto_accept(),
+            )
+            assert lib_b_id == str(lib_a.id)
+            lib_b = b.libraries.get(lib_a.id)
+            assert lib_b is not None and lib_b.name == "family-photos"
+            # both sides know both instances
+            assert lib_a.db.count("instance") == 2
+            assert lib_b.db.count("instance") == 2
+
+            # the op log streams over the normal sync exchange
+            for _ in range(200):
+                await a.p2p._alert_peers(lib_a.id)
+                if lib_b.db.count("file_path") == lib_a.db.count("file_path"):
+                    break
+                await asyncio.sleep(0.1)
+            assert lib_b.db.count("file_path") == lib_a.db.count("file_path")
+            assert lib_b.db.count("location") == 1
+
+            # a second join attempt of the same library fails cleanly
+            with pytest.raises(Exception):
+                await b.router.exec(
+                    b,
+                    "p2p.pairLibrary",
+                    {
+                        "identity": str(a.p2p.p2p.remote_identity),
+                        "library_id": str(lib_a.id),
+                    },
+                )
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+
+    asyncio.run(run())
+
+
 def test_two_node_sync_convergence_and_file_request(tmp_path):
     async def run():
         from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
