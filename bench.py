@@ -33,6 +33,9 @@ def main() -> None:
 
     import jax
 
+    from spacedrive_tpu.ops import configure_compilation_cache
+
+    configure_compilation_cache()
     n = int(os.environ.get("SD_BENCH_FILES", "4096"))
     iters = int(os.environ.get("SD_BENCH_ITERS", "5"))
     rng = np.random.default_rng(0)

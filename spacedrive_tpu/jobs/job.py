@@ -113,6 +113,10 @@ class StatefulJob(abc.ABC):
         self.next_jobs.append(job)
         return self
 
+    def cleanup(self) -> None:
+        """Release runtime-only resources; called by the runner on every
+        exit path (done/paused/cancelled/failed). Must be idempotent."""
+
     # --- persistence (ref:core/src/job/mod.rs:266-307) ---
 
     def serialize_state(self) -> bytes:
@@ -200,6 +204,14 @@ class JobRunnerTask(Task):
         except Exception as e:  # noqa: BLE001 - surfaced as job failure
             logger.exception("job %s failed", job.NAME)
             raise JobError(str(e)) from e
+        finally:
+            # runs on DONE, pause, cancel, and failure alike — jobs
+            # release runtime-only resources (thread pools, prefetch
+            # buffers) here, never in finalize (which pause skips)
+            try:
+                job.cleanup()
+            except Exception:
+                logger.exception("job %s cleanup failed", job.NAME)
 
 
 def status_for_result(status: "Any", had_errors: bool) -> JobStatus:

@@ -41,6 +41,10 @@ class Node:
         os.makedirs(self.data_dir, exist_ok=True)
         if with_logger:
             init_logger(self.data_dir)
+        if use_device:
+            from ..ops import configure_compilation_cache
+
+            configure_compilation_cache()
 
         self.config = ConfigManager(self.data_dir)
         self.event_bus = EventBus()

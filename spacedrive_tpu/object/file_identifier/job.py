@@ -34,7 +34,9 @@ from ...ops import cas
 logger = logging.getLogger(__name__)
 
 CHUNK_SIZE = 100            # ref:mod.rs:34 (CPU parity constant)
-DEVICE_CHUNK_SIZE = 2048    # device batches amortize dispatch overhead
+DEVICE_CHUNK_SIZE = 1024    # device batches amortize dispatch overhead
+# (windows of 1024 pipeline: the next window's disk reads overlap the
+# current window's device hash — see execute_step's Prefetcher)
 
 
 def orphan_where_clause(sub_path_mat: str | None = None) -> str:
@@ -55,6 +57,7 @@ class FileIdentifierJob(StatefulJob):
 
     NAME = "file_identifier"
     IS_BATCHED = True
+    _prefetcher = None  # runtime-only double buffer (never serialized)
 
     async def init_job(self, ctx: JobContext) -> None:
         library = ctx.library
@@ -93,8 +96,11 @@ class FileIdentifierJob(StatefulJob):
             message=f"identifying {total} orphan paths", phase="identifying",
         )
 
-    async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
-        library = ctx.library
+    def _fetch_window(self, library, cursor: int):
+        """Read+dispatch stage: one cursor window of rows, their sampled
+        bytes, and — on the device path — the hash batch already
+        dispatched (async) so back-to-back windows pipeline transfers.
+        Runs on a worker thread; disk I/O never blocks the loop."""
         d = self.data
         params: list[Any] = [d["location_id"]]
         where = orphan_where_clause(self.init.get("sub_path"))
@@ -103,13 +109,8 @@ class FileIdentifierJob(StatefulJob):
         # cursor pagination by id (ref:file_identifier_job.rs:126-165)
         rows = library.db.query(
             f"SELECT * FROM file_path WHERE {where} AND id > ? ORDER BY id LIMIT ?",
-            tuple(params) + (d["cursor"], d["chunk_size"]),
+            tuple(params) + (cursor, d["chunk_size"]),
         )
-        if not rows:
-            return StepResult()
-        d["cursor"] = rows[-1]["id"]
-
-        t0 = time.perf_counter()
         loc_path = d["location_path"]
         metas: list[dict | None] = []
         messages: list[bytes] = []
@@ -129,8 +130,63 @@ class FileIdentifierJob(StatefulJob):
             messages.append(msg)
             msg_rows.append(row)
             metas.append({"row": row, "cas_id": "pending"})
+        backend = d["backend"]
+        use_device = backend in ("tpu", "device") or (
+            backend == "auto" and cas._device_available()
+        )
+        if use_device and messages:
+            try:
+                fin = cas.cas_ids_begin(messages)  # async dispatch NOW
+            except Exception:
+                fin = None
 
-        cas_ids = cas.cas_ids(messages, d["backend"])
+            def finisher(fin=fin, messages=messages, backend=backend):
+                # JAX dispatch is async — device failures usually surface
+                # at materialization, so the fallback wraps the FINISH
+                # (explicit "tpu" stays strict; "auto" degrades to host)
+                if fin is not None:
+                    try:
+                        return fin()
+                    except Exception:
+                        if backend != "auto":
+                            raise
+                        logger.warning("device hashing failed; host fallback")
+                elif backend != "auto":
+                    raise RuntimeError("device dispatch failed")
+                return cas.cas_ids(messages, "cpu")
+
+        else:
+            finisher = lambda: cas.cas_ids(messages, backend)
+        return rows, metas, messages, msg_rows, finisher
+
+    async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
+        import asyncio
+
+        from ...parallel import Prefetcher
+
+        library = ctx.library
+        d = self.data
+        if self._prefetcher is None:
+            self._prefetcher = Prefetcher()
+
+        t0 = time.perf_counter()
+        cursor = d["cursor"]
+        rows, metas, messages, msg_rows, finisher = await asyncio.to_thread(
+            self._prefetcher.take,
+            cursor,
+            lambda: self._fetch_window(library, cursor),
+        )
+        if not rows:
+            return StepResult()
+        d["cursor"] = rows[-1]["id"]
+        # overlap: the next window's disk reads AND device dispatch run
+        # while this window's hashes complete (SURVEY §7 hard part #2)
+        next_cursor = d["cursor"]
+        self._prefetcher.submit(
+            next_cursor, lambda: self._fetch_window(library, next_cursor)
+        )
+
+        cas_ids = await asyncio.to_thread(finisher)
         hash_time = time.perf_counter() - t0
 
         by_row_id = {r["id"]: c for r, c in zip(msg_rows, cas_ids)}
@@ -231,7 +287,18 @@ class FileIdentifierJob(StatefulJob):
         sync.write_ops(ops, writes)
         return created, linked
 
+    def cleanup(self) -> None:
+        """Every exit path (done/pause/cancel/fail) releases the
+        prefetch pool and keeps its stats."""
+        if self._prefetcher is not None:
+            stats = self._prefetcher.stats
+            self.run_metadata["prefetch_hits"] = stats.prefetch_hits
+            self.run_metadata["prefetch_misses"] = stats.prefetch_misses
+            self._prefetcher.shutdown()
+            self._prefetcher = None
+
     async def finalize(self, ctx: JobContext) -> Any:
+        self.cleanup()
         ctx.progress(message="identification complete", phase="done")
         return dict(self.run_metadata)
 

@@ -1,1 +1,35 @@
 """TPU compute plane: batched content hashing, resizing, perceptual hashing."""
+
+from __future__ import annotations
+
+import os
+
+_CACHE_CONFIGURED = False
+
+
+def configure_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point XLA's persistent compilation cache at a stable directory so
+    the BLAKE3/resize/pHash programs compile once per machine, not once
+    per process (first compile of the 56-chunk BLAKE3 program costs
+    ~10 s on a tunneled chip; a cache hit costs milliseconds). Safe to
+    call repeatedly; first caller wins."""
+    global _CACHE_CONFIGURED
+    if _CACHE_CONFIGURED:
+        return None
+    cache_dir = cache_dir or os.environ.get(
+        "SD_XLA_CACHE_DIR",
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "spacedrive_tpu_xla",
+        ),
+    )
+    try:
+        import jax  # inside the guard: jax-less installs keep working
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _CACHE_CONFIGURED = True
+        return cache_dir
+    except Exception:
+        return None

@@ -19,7 +19,7 @@ from __future__ import annotations
 import os
 import struct
 from dataclasses import dataclass
-from typing import Iterable, Sequence
+from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
 
@@ -86,6 +86,12 @@ def cas_id_from_bytes_cpu(content: bytes) -> str:
     return StreamingBlake3().update(message_from_bytes(content)).hexdigest()[:16]
 
 
+DEVICE_BATCH = 1024  # max rows per dispatch (see cas_ids_begin)
+# the tail ladder: at most 3 compiled programs per bucket, and a
+# 5-file tail pads to 32 rows, not 1024
+BATCH_LADDER = (32, 256, DEVICE_BATCH)
+
+
 def _bucket_for(msg_len: int) -> int:
     chunks = max(1, (msg_len + 1023) // 1024)
     for b in SMALL_BUCKETS:
@@ -101,9 +107,13 @@ class _Bucket:
     messages: list[bytes]
 
 
-def cas_ids_batched(messages: Sequence[bytes]) -> list[str]:
-    """cas_ids for pre-assembled messages, batched per chunk-bucket and
-    hashed on the accelerator. Order-preserving."""
+def cas_ids_begin(messages: Sequence[bytes]) -> Callable[[], list[str]]:
+    """Dispatch device hashing WITHOUT blocking: batches go to the
+    accelerator asynchronously (JAX dispatch) and the returned finisher
+    materializes the hex ids. Splitting dispatch from completion lets a
+    pipeline queue window N+1's transfer while N is still in flight —
+    on a tunneled chip that hides most of the per-call latency
+    (SURVEY §7 hard part #2)."""
     buckets: dict[int, _Bucket] = {}
     for i, msg in enumerate(messages):
         c = LARGE_CHUNKS if len(msg) == LARGE_MSG_LEN else _bucket_for(len(msg))
@@ -111,18 +121,40 @@ def cas_ids_batched(messages: Sequence[bytes]) -> list[str]:
         b.indices.append(i)
         b.messages.append(msg)
 
-    out: list[str | None] = [None] * len(messages)
+    # CANONICAL batch shapes per chunk-bucket: a fresh shape costs
+    # seconds of tracing + executable load (worse on a tunneled chip),
+    # while a warm shape runs in ~40 ms — so oversized batches split at
+    # DEVICE_BATCH and ragged tails round up the small ladder instead
+    # of shipping a full zero-padded 1024 rows for a handful of files.
+    in_flight: list[tuple[_Bucket, int, Any]] = []
     for c, bucket in sorted(buckets.items()):
-        n = len(bucket.messages)
-        arr = np.zeros((n, c * 1024), np.uint8)
-        lens = np.empty((n,), np.int32)
-        for j, msg in enumerate(bucket.messages):
-            arr[j, :len(msg)] = np.frombuffer(msg, np.uint8)
-            lens[j] = len(msg)
-        words = blake3_jax.hash_batch(arr, lens, max_chunks=c)
-        for j, hx in enumerate(blake3_jax.words_to_hex(words, 16)):
-            out[bucket.indices[j]] = hx
-    return out  # type: ignore[return-value]
+        for off in range(0, len(bucket.messages), DEVICE_BATCH):
+            part = bucket.messages[off : off + DEVICE_BATCH]
+            n_pad = next(s for s in BATCH_LADDER if s >= len(part))
+            arr = np.zeros((n_pad, c * 1024), np.uint8)
+            lens = np.ones((n_pad,), np.int32)  # pad rows: 1 junk byte
+            for j, msg in enumerate(part):
+                arr[j, :len(msg)] = np.frombuffer(msg, np.uint8)
+                lens[j] = len(msg)
+            in_flight.append(
+                (bucket, off, blake3_jax.hash_batch(arr, lens, max_chunks=c))
+            )
+
+    def finish() -> list[str]:
+        out: list[str | None] = [None] * len(messages)
+        for bucket, off, words in in_flight:
+            part = bucket.indices[off : off + DEVICE_BATCH]
+            for j, hx in enumerate(blake3_jax.words_to_hex(words, 16)[: len(part)]):
+                out[part[j]] = hx
+        return out  # type: ignore[return-value]
+
+    return finish
+
+
+def cas_ids_batched(messages: Sequence[bytes]) -> list[str]:
+    """cas_ids for pre-assembled messages, batched per chunk-bucket and
+    hashed on the accelerator. Order-preserving."""
+    return cas_ids_begin(messages)()
 
 
 def cas_ids_for_paths(paths: Iterable[tuple[str, int]]) -> list[str]:

@@ -94,7 +94,9 @@ class PairingManager:
                 }
             )
             await w.flush()
-            resp = await r.msgpack()
+            # bounded: the owner's user gets PAIRING_TIMEOUT to decide,
+            # plus slack — a dead owner must not pin this API call open
+            resp = await asyncio.wait_for(r.msgpack(), PAIRING_TIMEOUT + 15)
             if not resp.get("ok"):
                 raise PermissionError(resp.get("error", "pairing rejected"))
             lib_id = uuid.UUID(bytes=resp["library_id"])
@@ -138,6 +140,26 @@ class PairingManager:
         if libraries.get(lib_id) is not None:
             raise FileExistsError(f"library {lib_id} already exists here")
         db = LibraryDb(libraries._db_path(lib_id))
+        try:
+            return self._populate_joined_library(
+                libraries, db, lib_id, config, instances
+            )
+        except BaseException:
+            # never leave a half-written DB: a stale file with instance
+            # rows makes every retry hit UNIQUE(pub_id)
+            db.close()
+            for path in libraries.paths(lib_id):
+                for suffix in ("", "-wal", "-shm"):
+                    if os.path.exists(path + suffix):
+                        os.remove(path + suffix)
+            raise
+
+    def _populate_joined_library(
+        self, libraries, db, lib_id: uuid.UUID, config: dict[str, Any],
+        instances: list[dict],
+    ) -> Any:
+        from ..node.library import Library, LibraryConfig, _platform_int
+        from ..db.database import new_pub_id
         instance_pub = new_pub_id()
         instance_id = db.insert(
             "instance",

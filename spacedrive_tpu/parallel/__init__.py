@@ -1,0 +1,31 @@
+"""Parallel execution plane: meshes, shardings, host→device feeding.
+
+SURVEY §2.4 — the reference's worker-per-core/work-stealing parallelism
+maps to batch-parallel device meshes here; §7 hard part #2 — the
+host-side read pipeline that keeps the device fed.
+"""
+
+from .feeder import PipelineStats, Prefetcher
+from .mesh import (
+    AXES,
+    batch_sharding,
+    factor3,
+    flat_mesh,
+    make_mesh,
+    multihost_init,
+    pad_to_multiple,
+    replicated,
+)
+
+__all__ = [
+    "AXES",
+    "PipelineStats",
+    "Prefetcher",
+    "batch_sharding",
+    "factor3",
+    "flat_mesh",
+    "make_mesh",
+    "multihost_init",
+    "pad_to_multiple",
+    "replicated",
+]

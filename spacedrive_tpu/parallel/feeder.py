@@ -1,0 +1,74 @@
+"""Host→device feeding — overlap disk reads with device compute.
+
+SURVEY §7 hard part #2 ("feeding the beast"): on a 1M-file library the
+sampled reads (~56 KiB/file) dominate wall-clock, so the host must be
+reading batch N+1 while the device hashes batch N. `Prefetcher` is the
+double-buffer: a bounded thread pool runs the read stage for the next
+window while the caller consumes the current one; `PipelineStats`
+records overlap so jobs can report read vs compute time honestly
+(the reference's RunMetadata timing discipline,
+ref:indexer/indexer_job.rs:76-88).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass
+class PipelineStats:
+    prefetch_hits: int = 0
+    prefetch_misses: int = 0
+    read_time: float = 0.0  # time the consumer WAITED on reads
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+
+class Prefetcher(Generic[T]):
+    """One-slot lookahead keyed by an opaque token (a cursor value):
+    `submit(key, fn)` schedules the next window's read stage;
+    `take(key)` returns it — immediately when the device outran the
+    disk, or after the residual wait otherwise."""
+
+    def __init__(self, max_workers: int = 2):
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="sd-prefetch"
+        )
+        self._slot: tuple[Any, concurrent.futures.Future] | None = None
+        self.stats = PipelineStats()
+
+    def submit(self, key: Any, fn: Callable[[], T]) -> None:
+        self.cancel()  # one slot: a superseded prefetch is dropped
+        self._slot = (key, self._pool.submit(fn))
+
+    def take(self, key: Any, fallback: Callable[[], T]) -> T:
+        """The window for `key`, from the prefetch slot when it matches,
+        else computed inline via `fallback` (counted as a miss)."""
+        t0 = time.perf_counter()
+        slot = self._slot
+        if slot is not None and slot[0] == key:
+            self._slot = None
+            result = slot[1].result()
+            with self.stats._lock:
+                self.stats.prefetch_hits += 1
+                self.stats.read_time += time.perf_counter() - t0
+            return result
+        result = fallback()
+        with self.stats._lock:
+            self.stats.prefetch_misses += 1
+            self.stats.read_time += time.perf_counter() - t0
+        return result
+
+    def cancel(self) -> None:
+        if self._slot is not None:
+            self._slot[1].cancel()
+            self._slot = None
+
+    def shutdown(self) -> None:
+        self.cancel()
+        self._pool.shutdown(wait=False, cancel_futures=True)

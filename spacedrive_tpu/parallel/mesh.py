@@ -1,0 +1,109 @@
+"""Device-mesh construction + canonical shardings.
+
+SURVEY §2.4: the reference's parallelism (worker-per-core task system,
+NCCL-free QUIC mesh) maps onto TPU primitives as batch-parallel
+`shard_map`/`pjit` over a `jax.sharding.Mesh`. This module owns the
+canonical axis vocabulary — `dp` (batch), `fsdp` (param shards), `tp`
+(tensor) — and the helpers every call site shares, so meshes are built
+one way everywhere (`__graft_entry__.dryrun_multichip` exercises the
+same factoring on the driver's virtual device count).
+
+Multi-host: `multihost_init()` wraps `jax.distributed.initialize` —
+inside a pod/slice collectives ride ICI; across hosts, DCN. Library
+metadata sync stays on the host-side CRDT/P2P plane (§5), never on
+device collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Sequence
+
+import numpy as np
+
+AXES = ("dp", "fsdp", "tp")
+
+
+def factor3(n: int) -> tuple[int, int, int]:
+    """n devices → (dp, fsdp, tp), preferring tp=2 then fsdp=2 (the
+    same factoring the driver dry-runs)."""
+    tp = 2 if n % 2 == 0 else 1
+    rem = n // tp
+    fsdp = 2 if rem % 2 == 0 else 1
+    return rem // fsdp, fsdp, tp
+
+
+def make_mesh(
+    devices: Sequence[Any] | None = None,
+    shape: tuple[int, int, int] | None = None,
+):
+    """Standard 3-axis mesh over the available devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    dp, fsdp, tp = shape or factor3(len(devices))
+    count = dp * fsdp * tp
+    return Mesh(np.array(devices[:count]).reshape(dp, fsdp, tp), AXES)
+
+
+def flat_mesh(devices: Sequence[Any] | None = None):
+    """One-axis `dp` mesh — batch-parallel work (hashing, pHash rows)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), ("dp",))
+
+
+def batch_sharding(mesh: Any, *, all_axes: bool = False):
+    """NamedSharding splitting dim 0 over dp (or every axis)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(tuple(mesh.axis_names)) if all_axes else P(mesh.axis_names[0])
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Any):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
+    """Pad dim 0 so sharded batches divide evenly; returns (arr, pad)."""
+    pad = (-arr.shape[0]) % multiple
+    if pad:
+        arr = np.concatenate(
+            [arr, np.zeros((pad, *arr.shape[1:]), arr.dtype)]
+        )
+    return arr, pad
+
+
+def multihost_init(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join a multi-host JAX cluster (ref role: the NCCL/MPI backend of
+    a conventional stack). No-ops when the env provides no cluster —
+    single-host keeps working untouched."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "SD_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None and num_processes is None:
+        env = os.environ
+        if not any(k in env for k in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")):
+            return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except Exception:
+        return False

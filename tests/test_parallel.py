@@ -1,0 +1,126 @@
+"""Parallel plane: mesh construction, shardings, prefetch pipeline,
+and pipelined identifier parity.
+
+SURVEY §2.4 (mesh mapping) + §7 hard part #2 (feeding the beast).
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.parallel import (
+    AXES,
+    Prefetcher,
+    batch_sharding,
+    factor3,
+    flat_mesh,
+    make_mesh,
+    pad_to_multiple,
+)
+
+
+def test_factor3_covers_device_counts():
+    for n in (1, 2, 4, 8, 16, 32):
+        dp, fsdp, tp = factor3(n)
+        assert dp * fsdp * tp == n
+    assert factor3(8) == (2, 2, 2)
+    assert factor3(1) == (1, 1, 1)
+
+
+def test_make_mesh_and_sharded_compute():
+    import jax
+    import jax.numpy as jnp
+
+    mesh = make_mesh()  # 8 virtual CPU devices (conftest)
+    assert mesh.axis_names == AXES and mesh.devices.size == 8
+    sharding = batch_sharding(mesh, all_axes=True)
+    arr, pad = pad_to_multiple(np.arange(20, dtype=np.float32)[:, None], 8)
+    assert arr.shape[0] == 24 and pad == 4
+    x = jax.device_put(arr, sharding)
+    out = jax.jit(lambda v: v * 2)(x)
+    assert np.array_equal(np.asarray(out)[:20, 0], np.arange(20) * 2)
+
+    fm = flat_mesh()
+    assert fm.axis_names == ("dp",) and fm.devices.size == 8
+
+
+def test_prefetcher_overlap_and_fallback():
+    pf = Prefetcher()
+    timeline = []
+
+    def slow_read(tag):
+        def run():
+            timeline.append(("start", tag, time.perf_counter()))
+            time.sleep(0.15)
+            timeline.append(("end", tag, time.perf_counter()))
+            return tag
+
+        return run
+
+    # miss: nothing prefetched yet
+    assert pf.take("a", slow_read("a")) == "a"
+    assert pf.stats.prefetch_misses == 1
+
+    # hit: submit "b", burn compute time, take should be ~instant
+    pf.submit("b", slow_read("b"))
+    time.sleep(0.2)  # the "device compute" window
+    t0 = time.perf_counter()
+    assert pf.take("b", slow_read("b-fallback")) == "b"
+    assert time.perf_counter() - t0 < 0.05  # read overlapped with compute
+    assert pf.stats.prefetch_hits == 1
+
+    # stale key falls back (and doesn't hand out the wrong window)
+    pf.submit("c", slow_read("c"))
+    assert pf.take("d", slow_read("d")) == "d"
+    assert pf.stats.prefetch_misses == 2
+    pf.shutdown()
+
+
+def test_identifier_pipelined_matches_oracle(tmp_path):
+    async def run():
+        from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+        from spacedrive_tpu.node import Node
+        from spacedrive_tpu.ops.cas import cas_id_cpu
+
+        corpus = tmp_path / "corpus"
+        corpus.mkdir()
+        blobs = {}
+        for i in range(25):  # several identifier windows at chunk_size=8
+            data = os.urandom(1000 + i * 37)
+            blobs[f"f{i:02d}"] = data
+            (corpus / f"f{i:02d}.bin").write_bytes(data)
+
+        node = Node(str(tmp_path / "node"), use_device=False, with_labeler=False)
+        node.config.config.p2p.enabled = False
+        await node.start()
+        lib = await node.create_library("pipelined")
+        loc = LocationCreateArgs(path=str(corpus)).create(lib)
+        from spacedrive_tpu.jobs.manager import JobBuilder
+        from spacedrive_tpu.location.indexer.job import IndexerJob
+        from spacedrive_tpu.object.file_identifier.job import FileIdentifierJob
+
+        try:
+            await JobBuilder(IndexerJob({"location_id": loc["id"]})).spawn(
+                node.jobs, lib
+            )
+            await node.jobs.wait_idle()
+            job = FileIdentifierJob({"location_id": loc["id"], "chunk_size": 8})
+            await JobBuilder(job).spawn(node.jobs, lib)
+            await node.jobs.wait_idle()
+            # prefetch actually engaged across the 4 windows
+            assert job.run_metadata["prefetch_hits"] >= 2
+            # and every cas_id is bit-correct vs the host oracle
+            for r in lib.db.query(
+                "SELECT name, cas_id FROM file_path WHERE is_dir = 0"
+            ):
+                path = corpus / f"{r['name']}.bin"
+                assert r["cas_id"] == cas_id_cpu(str(path), path.stat().st_size)
+            assert lib.db.count("object") == 25
+        finally:
+            await node.shutdown()
+
+    asyncio.run(run())
