@@ -69,9 +69,10 @@ def _root(r: Router) -> None:
 
     @r.query("nodeState")
     def node_state(node):
-        cfg = node.config.config
-        import jax
+        from ..node.hardware import accelerators, hardware_model
 
+        cfg = node.config.config
+        accels = accelerators()
         return {
             "id": str(cfg.id),
             "name": cfg.name,
@@ -79,7 +80,9 @@ def _root(r: Router) -> None:
             "data_path": node.data_dir,
             "p2p": cfg.p2p.to_dict(),
             "features": [f.value for f in cfg.features],
-            "device_model": jax.devices()[0].device_kind if jax.devices() else "cpu",
+            "hardware_model": hardware_model(),
+            "device_model": accels[0]["kind"] if accels else "cpu",
+            "accelerators": accels,
             "image_labeler_version": cfg.image_labeler_version,
         }
 

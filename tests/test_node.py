@@ -208,6 +208,25 @@ def test_statistics_snapshot(tmp_path):
     assert get_statistics(db)["total_object_count"] == 1
 
 
+# --- hardware ------------------------------------------------------------
+
+
+def test_hardware_probes():
+    from spacedrive_tpu.node.hardware import (
+        accelerators,
+        hardware_model,
+        has_full_disk_access,
+    )
+
+    assert isinstance(hardware_model(), str) and hardware_model()
+    accels = accelerators()
+    assert isinstance(accels, list)
+    if accels:
+        assert {"id", "kind", "platform"} <= set(accels[0])
+    assert has_full_disk_access() in (True, False)
+    assert has_full_disk_access(os.path.dirname(__file__)) is True
+
+
 # --- Node lifecycle ------------------------------------------------------
 
 
