@@ -10,11 +10,13 @@ plain read-permission probe).
 
 from __future__ import annotations
 
+import functools
 import os
 import platform
 from typing import Any
 
 
+@functools.cache
 def hardware_model() -> str:
     """Coarse device model string (ref:hardware.rs `HardwareModel`)."""
     system = platform.system()

@@ -102,9 +102,22 @@ def file_checksums(paths: Sequence[str | os.PathLike], backend: str = "auto") ->
             row_idxs.append(i)
         if not rows:
             continue
-        words = blake3_jax.hash_batch(msgs[: len(rows)], lens[: len(rows)], max_chunks=max_chunks)
-        for j, h in enumerate(blake3_jax.words_to_hex(words, 64)):
-            results[row_idxs[j]] = h
+        # one batch-shape policy for every device hash call site
+        from ...ops.cas import DEVICE_BATCH, pack_canonical_batch
+
+        for off in range(0, len(rows), DEVICE_BATCH):
+            part = row_idxs[off : off + DEVICE_BATCH]
+            n = len(part)
+            batch, blens = pack_canonical_batch(
+                [
+                    msgs[off + j, : lens[off + j]].tobytes()
+                    for j in range(n)
+                ],
+                max_chunks,
+            )
+            words = blake3_jax.hash_batch(batch, blens, max_chunks=max_chunks)
+            for j, h in enumerate(blake3_jax.words_to_hex(words, 64)[:n]):
+                results[part[j]] = h
 
     return [r if r is not None else "" for r in results]
 

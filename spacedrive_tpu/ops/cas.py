@@ -92,6 +92,28 @@ DEVICE_BATCH = 1024  # max rows per dispatch (see cas_ids_begin)
 BATCH_LADDER = (32, 256, DEVICE_BATCH)
 
 
+def pack_canonical_batch(
+    messages: Sequence[bytes], max_chunks: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """The ONE batch-shape policy for device hashing: ≤DEVICE_BATCH
+    messages pack into a `(ladder_size, max_chunks*1024)` uint8 array +
+    int32 lengths. A fresh XLA shape costs seconds of tracing +
+    executable load (worse on a tunneled chip) while a warm shape runs
+    in ~40 ms, so every caller (cas_ids_begin, the validator) MUST pack
+    through here. Pad rows hash 1 junk byte and get sliced off by the
+    caller."""
+    n = len(messages)
+    if n > DEVICE_BATCH:
+        raise ValueError(f"pack at most {DEVICE_BATCH} messages, got {n}")
+    n_pad = next(s for s in BATCH_LADDER if s >= n)
+    arr = np.zeros((n_pad, max_chunks * 1024), np.uint8)
+    lens = np.ones((n_pad,), np.int32)
+    for j, msg in enumerate(messages):
+        arr[j, : len(msg)] = np.frombuffer(msg, np.uint8)
+        lens[j] = len(msg)
+    return arr, lens
+
+
 def _bucket_for(msg_len: int) -> int:
     chunks = max(1, (msg_len + 1023) // 1024)
     for b in SMALL_BUCKETS:
@@ -121,21 +143,11 @@ def cas_ids_begin(messages: Sequence[bytes]) -> Callable[[], list[str]]:
         b.indices.append(i)
         b.messages.append(msg)
 
-    # CANONICAL batch shapes per chunk-bucket: a fresh shape costs
-    # seconds of tracing + executable load (worse on a tunneled chip),
-    # while a warm shape runs in ~40 ms — so oversized batches split at
-    # DEVICE_BATCH and ragged tails round up the small ladder instead
-    # of shipping a full zero-padded 1024 rows for a handful of files.
     in_flight: list[tuple[_Bucket, int, Any]] = []
     for c, bucket in sorted(buckets.items()):
         for off in range(0, len(bucket.messages), DEVICE_BATCH):
             part = bucket.messages[off : off + DEVICE_BATCH]
-            n_pad = next(s for s in BATCH_LADDER if s >= len(part))
-            arr = np.zeros((n_pad, c * 1024), np.uint8)
-            lens = np.ones((n_pad,), np.int32)  # pad rows: 1 junk byte
-            for j, msg in enumerate(part):
-                arr[j, :len(msg)] = np.frombuffer(msg, np.uint8)
-                lens[j] = len(msg)
+            arr, lens = pack_canonical_batch(part, c)
             in_flight.append(
                 (bucket, off, blake3_jax.hash_batch(arr, lens, max_chunks=c))
             )

@@ -50,6 +50,9 @@ class P2PManager:
         cfg = self.node.config.config
         self._loop = asyncio.get_running_loop()
         self.p2p.set_stream_handler(self._handle_stream)
+        # a peer appearing with one of our libraries triggers a pull —
+        # discovery often lands after the peer's alerts were sent
+        self._unsubs.append(self.p2p.events.on(self._on_p2p_event))
         self.port = await self.p2p.listen(cfg.p2p.port, host=self._bind_host)
         self._advertise()
         if cfg.p2p.discovery != P2PDiscoveryState.DISABLED:
@@ -106,7 +109,11 @@ class P2PManager:
         lib.ingest = actor
 
         def on_event(event, lib_id=lib.id):
-            if event == ("SyncMessage", "Created"):
+            # Created: local write. Ingested: ops arrived from a peer —
+            # re-alerting turns any connected subgraph into a relay
+            # (hub topologies converge transitively; alerts are
+            # idempotent nudges, peers pull by watermark)
+            if event in (("SyncMessage", "Created"), ("SyncMessage", "Ingested")):
                 loop = getattr(self, "_loop", None)
                 if loop is not None and loop.is_running():
                     loop.call_soon_threadsafe(
@@ -119,6 +126,21 @@ class P2PManager:
             pass  # set at start(); registration before start is fine
         self._unsubs.append(lib.event_bus.on(on_event))
         self._advertise()
+
+    def _on_p2p_event(self, event: Any) -> None:
+        if not (
+            isinstance(event, tuple)
+            and event
+            and event[0] in ("PeerDiscovered", "PeerMetadataChanged")
+        ):
+            return
+        peer = self.p2p.peers.get(event[1])
+        if peer is None:
+            return
+        advertised = set(peer.metadata.get("libraries", "").split(","))
+        for lib_id, actor in self.ingest_actors.items():
+            if str(lib_id) in advertised:
+                actor.notify()
 
     async def _alert_peers(self, library_id: uuid.UUID) -> None:
         for peer in self.peers_for_library(library_id):

@@ -105,11 +105,15 @@ class P2P:
             return
         peer = self.touch_peer(identity)
         fresh = not peer.is_discovered
+        changed = any(peer.metadata.get(k) != v for k, v in metadata.items())
         peer.addrs |= addrs
         peer.metadata.update(metadata)
         peer.discovered_by.add(source)
         if fresh:
             self.events.emit(("PeerDiscovered", identity))
+        elif changed:
+            # e.g. the peer joined a new library since its last beacon
+            self.events.emit(("PeerMetadataChanged", identity))
 
     def expired(self, source: str, identity: RemoteIdentity) -> None:
         peer = self.peers.get(identity)

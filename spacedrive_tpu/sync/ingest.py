@@ -119,10 +119,15 @@ class IngestActor:
         sync: SyncManager,
         request_ops: RequestOps,
         ops_per_request: int = OPS_PER_REQUEST,
+        poll_interval: float | None = 30.0,
     ):
         self.sync = sync
         self.request_ops = request_ops
         self.ops_per_request = ops_per_request
+        # anti-entropy: tick even without a notification so a lost alert
+        # (peer discovered late, dropped datagram) only delays, never
+        # strands, convergence; None disables (tests with loopback queues)
+        self.poll_interval = poll_interval
         self.state = State.WAITING_FOR_NOTIFICATION
         self.applied = 0
         self.rejected = 0
@@ -165,14 +170,19 @@ class IngestActor:
 
     # --- state machine (ref:ingest.rs:49-93) ---
     async def _run(self) -> None:
+        waited = 0.0
         while not self._stopped:
             self.state = State.WAITING_FOR_NOTIFICATION
             try:
                 await asyncio.wait_for(self._notify.wait(), timeout=1.0)
             except asyncio.TimeoutError:
-                continue
+                waited += 1.0
+                if self.poll_interval is None or waited < self.poll_interval:
+                    continue
+                # anti-entropy tick: pull despite no notification
             if self._stopped:
                 break
+            waited = 0.0
             self._notify.clear()
             self._idle.clear()
             try:

@@ -501,6 +501,101 @@ def test_library_pairing_over_mesh(tmp_path):
     asyncio.run(run())
 
 
+def test_three_node_transitive_sync_via_hub(tmp_path):
+    """A ↔ hub ↔ B with NO direct A–B link: A's ops must reach B through
+    the hub's relay (alert-on-ingest + third-party op serving)."""
+
+    async def run():
+        from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+        from spacedrive_tpu.sync.ingest import backfill_operations
+
+        a = await _make_node(tmp_path, "alpha")
+        hub = await _make_node(tmp_path, "hub")
+        b = await _make_node(tmp_path, "beta")
+        try:
+            lib_a = await a.create_library("mesh-lib")
+            corpus = os.path.join(tmp_path, "corpus")
+            os.makedirs(corpus)
+            for i in range(3):
+                with open(os.path.join(corpus, f"m{i}.bin"), "wb") as f:
+                    f.write(os.urandom(900 + i))
+            loc = LocationCreateArgs(path=corpus).create(lib_a)
+            backfill_operations(lib_a.sync)
+            await scan_location(lib_a, loc, a.jobs)
+            await a.jobs.wait_idle()
+
+            # topology: a–hub and hub–b beacons only
+            for n in (a, hub, b):
+                n.p2p._beacon_addrs = [("127.0.0.1", 1)]
+            await a.p2p.start()
+            await hub.p2p.start()
+            await b.p2p.start()
+            da = a.p2p.p2p._discovery[0]
+            dh = hub.p2p.p2p._discovery[0]
+            db_ = b.p2p.p2p._discovery[0]
+            da.beacon_addrs = [("127.0.0.1", dh.bind_port)]
+            dh.beacon_addrs = [("127.0.0.1", da.bind_port), ("127.0.0.1", db_.bind_port)]
+            db_.beacon_addrs = [("127.0.0.1", dh.bind_port)]
+            for d in (da, dh, db_):
+                d.interval = 0.05
+            for _ in range(200):
+                if (
+                    hub.p2p.p2p.discovered_peers()
+                    and a.p2p.p2p.discovered_peers()
+                    and b.p2p.p2p.discovered_peers()
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert not any(
+                p.identity == b.p2p.p2p.remote_identity
+                for p in a.p2p.p2p.discovered_peers()
+            ), "topology broken: A discovered B directly"
+
+            # hub pairs into A's library, then B pairs via the hub
+            a.p2p.pairing.auto_accept = True
+            hub.p2p.pairing.auto_accept = True
+            await hub.router.exec(
+                hub,
+                "p2p.pairLibrary",
+                {"identity": str(a.p2p.p2p.remote_identity), "library_id": str(lib_a.id)},
+            )
+            await b.router.exec(
+                b,
+                "p2p.pairLibrary",
+                {"identity": str(hub.p2p.p2p.remote_identity), "library_id": str(lib_a.id)},
+            )
+            lib_b = b.libraries.get(lib_a.id)
+            lib_h = hub.libraries.get(lib_a.id)
+
+            for _ in range(300):
+                await a.p2p._alert_peers(lib_a.id)
+                if lib_b.db.count("file_path") == lib_a.db.count("file_path"):
+                    break
+                await asyncio.sleep(0.1)
+            assert lib_h.db.count("file_path") == lib_a.db.count("file_path")
+            assert lib_b.db.count("file_path") == lib_a.db.count("file_path")
+            # B's rows carry A's instance ops verbatim (same cas ids)
+            a_cas = {
+                r["name"]: r["cas_id"]
+                for r in lib_a.db.query(
+                    "SELECT name, cas_id FROM file_path WHERE is_dir = 0"
+                )
+            }
+            b_cas = {
+                r["name"]: r["cas_id"]
+                for r in lib_b.db.query(
+                    "SELECT name, cas_id FROM file_path WHERE is_dir = 0"
+                )
+            }
+            assert a_cas == b_cas and len(a_cas) == 3
+        finally:
+            await a.shutdown()
+            await hub.shutdown()
+            await b.shutdown()
+
+    asyncio.run(run())
+
+
 def test_two_node_sync_convergence_and_file_request(tmp_path):
     async def run():
         from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
