@@ -32,6 +32,9 @@ from .thumbnail.process import IMAGE_EXTENSIONS, VIDEO_EXTENSIONS
 
 THUMBNAILABLE_EXTENSIONS = tuple(IMAGE_EXTENSIONS) + tuple(VIDEO_EXTENSIONS)
 EXIF_EXTENSIONS = ("jpg", "jpeg", "png", "tiff", "webp")
+# media_data rows extract for EXIF-bearing images AND videos
+# (ref:media_data_extractor.rs images; video facts via the decoder)
+MEDIA_DATA_EXTENSIONS = EXIF_EXTENSIONS + tuple(VIDEO_EXTENSIONS)
 
 
 @register_job
@@ -80,7 +83,7 @@ class MediaProcessorJob(StatefulJob):
         self.data["thumbs_dispatched"] = dispatched
 
         exif_rows = [
-            r for r in rows if (r["extension"] or "").lower() in EXIF_EXTENSIONS
+            r for r in rows if (r["extension"] or "").lower() in MEDIA_DATA_EXTENSIONS
         ]
         for i in range(0, len(exif_rows), BATCH_SIZE):
             chunk = exif_rows[i:i + BATCH_SIZE]
@@ -141,7 +144,14 @@ class MediaProcessorJob(StatefulJob):
             if row is None or object_id is None:
                 skipped += 1
                 continue
-            meta = ImageMetadata.from_path(_full_path(loc_path, row))
+            full = _full_path(loc_path, row)
+            ext = (row["extension"] or "").lower()
+            if ext in VIDEO_EXTENSIONS:
+                from .media_data import VideoMetadata
+
+                meta = VideoMetadata.from_path(full)
+            else:
+                meta = ImageMetadata.from_path(full)
             if meta is None:
                 skipped += 1
                 continue

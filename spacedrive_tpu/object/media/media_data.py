@@ -136,6 +136,69 @@ class ImageMetadata:
         }
 
 
+@dataclass
+class VideoMetadata:
+    """ref:crates/media-metadata/src/video.rs (the reference ships a
+    stub; this extracts real stream facts via the cv2/ffmpeg decoder)."""
+
+    resolution: tuple[int, int] = (0, 0)
+    duration_seconds: float | None = None
+    fps: float | None = None
+    frame_count: int | None = None
+    codec: str | None = None
+
+    @classmethod
+    def from_path(cls, path: str | os.PathLike) -> "VideoMetadata | None":
+        try:
+            import cv2
+        except Exception:
+            return None
+        cap = cv2.VideoCapture(os.fspath(path))
+        try:
+            if not cap.isOpened():
+                return None
+            w = int(cap.get(cv2.CAP_PROP_FRAME_WIDTH) or 0)
+            h = int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT) or 0)
+            fps = float(cap.get(cv2.CAP_PROP_FPS) or 0) or None
+            frames = int(cap.get(cv2.CAP_PROP_FRAME_COUNT) or 0) or None
+            fourcc = int(cap.get(cv2.CAP_PROP_FOURCC) or 0)
+            codec = (
+                "".join(chr((fourcc >> (8 * i)) & 0xFF) for i in range(4)).strip()
+                or None
+                if fourcc
+                else None
+            )
+            duration = (frames / fps) if frames and fps else None
+            if not (w and h):
+                return None
+            return cls(
+                resolution=(w, h),
+                duration_seconds=duration,
+                fps=fps,
+                frame_count=frames,
+                codec=codec,
+            )
+        finally:
+            cap.release()
+
+    def to_row(self, object_id: int) -> dict[str, Any]:
+        """media_data row (resolution blob shared with images; the
+        video facts ride the camera_data blob slot as a typed dict)."""
+        return {
+            "resolution": msgpack.packb(list(self.resolution)),
+            "camera_data": msgpack.packb(
+                {
+                    "video": True,
+                    "duration_seconds": self.duration_seconds,
+                    "fps": self.fps,
+                    "frame_count": self.frame_count,
+                    "codec": self.codec,
+                }
+            ),
+            "object_id": object_id,
+        }
+
+
 def _s(v: Any) -> str | None:
     return str(v).strip("\x00 ").strip() if v is not None else None
 

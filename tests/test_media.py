@@ -70,6 +70,21 @@ def test_video_thumbnail_via_cv2(tmp_path):
     webp = process.generate_one_cpu(path, "mp4")
     assert webp[:4] == b"RIFF" and webp[8:12] == b"WEBP"
 
+    # stream facts (media-metadata video parity, via the same decoder)
+    from spacedrive_tpu.object.media.media_data import VideoMetadata
+
+    meta = VideoMetadata.from_path(path)
+    assert meta is not None
+    assert meta.resolution == (w, h)
+    assert meta.fps and abs(meta.fps - 10) < 0.5
+    assert meta.frame_count == 30
+    assert meta.duration_seconds and abs(meta.duration_seconds - 3.0) < 0.3
+    row = meta.to_row(object_id=1)
+    import msgpack
+
+    facts = msgpack.unpackb(row["camera_data"])
+    assert facts["video"] is True and facts["codec"]
+
 
 # --- labeler actor --------------------------------------------------------
 
