@@ -164,6 +164,101 @@ async def cmd_duplicates(args: argparse.Namespace) -> int:
         await node.shutdown()
 
 
+import contextlib
+
+
+@contextlib.asynccontextmanager
+async def _mesh_node(args: argparse.Namespace):
+    """Started node with p2p up and discovery settled, or SystemExit(1)."""
+    node = _make_node(args, with_labeler=False)
+    await node.start()
+    try:
+        if node.p2p is None:
+            print("p2p is disabled in the node config", file=sys.stderr)
+            raise SystemExit(1)
+        await asyncio.sleep(args.wait)  # let discovery settle
+        yield node
+    finally:
+        await node.shutdown()
+
+
+async def cmd_peers(args: argparse.Namespace) -> int:
+    """Discover mesh peers for a few seconds and list them."""
+    async with _mesh_node(args) as node:
+        peers = node.p2p.p2p.discovered_peers()
+        for p in peers:
+            print(
+                json.dumps(
+                    {
+                        "identity": str(p.identity),
+                        "name": p.metadata.get("name"),
+                        "os": p.metadata.get("operating_system"),
+                        "libraries": [
+                            x for x in p.metadata.get("libraries", "").split(",") if x
+                        ],
+                        "addrs": sorted(f"{h}:{pt}" for h, pt in p.addrs),
+                    }
+                )
+            )
+        if not peers:
+            print("no peers discovered", file=sys.stderr)
+        return 0
+
+
+async def cmd_pair(args: argparse.Namespace) -> int:
+    """Join a peer's library over the mesh (consent happens on the peer)."""
+    import uuid
+
+    from .p2p.identity import RemoteIdentity
+
+    async with _mesh_node(args) as node:
+        try:
+            lib = await node.p2p.pairing.join(
+                node.p2p.p2p,
+                RemoteIdentity.from_str(args.identity),
+                uuid.UUID(args.library) if args.library else None,
+            )
+        except PermissionError as e:
+            print(f"rejected: {e}", file=sys.stderr)
+            return 1
+        except asyncio.TimeoutError:
+            print("peer did not respond (offline, or consent timed out)",
+                  file=sys.stderr)
+            return 1
+        except FileExistsError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        except (ValueError, ConnectionError) as e:
+            print(f"pairing failed: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps({"library": str(lib.id), "name": lib.name}))
+        # give the first sync pull a moment before tearing down
+        await asyncio.sleep(2)
+        return 0
+
+
+async def cmd_spacedrop(args: argparse.Namespace) -> int:
+    """Send files to a peer (they accept/reject on their end)."""
+    from .p2p.identity import RemoteIdentity
+
+    async with _mesh_node(args) as node:
+        try:
+            drop_id = await node.p2p.spacedrop.send(
+                RemoteIdentity.from_str(args.identity), list(args.files)
+            )
+        except PermissionError as e:
+            print(f"rejected: {e}", file=sys.stderr)
+            return 1
+        except asyncio.TimeoutError:
+            print("peer did not respond", file=sys.stderr)
+            return 1
+        except (ValueError, ConnectionError, OSError) as e:
+            print(f"spacedrop failed: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps({"drop_id": str(drop_id), "sent": len(args.files)}))
+        return 0
+
+
 def cmd_crypto(args: argparse.Namespace) -> int:
     from .crypto import FileHeader, decrypt_file, encrypt_file
 
@@ -249,6 +344,19 @@ def build_parser() -> argparse.ArgumentParser:
     du.add_argument("--threshold", type=int, default=8)
     du.add_argument("--no-p2p", action="store_true", default=True)
 
+    pe = sub.add_parser("peers", help="discover and list mesh peers")
+    pe.add_argument("--wait", type=float, default=3.0)
+
+    pa = sub.add_parser("pair", help="join a peer's library")
+    pa.add_argument("identity", help="the peer's identity string (sdx peers)")
+    pa.add_argument("--library", help="library uuid (default: peer's first)")
+    pa.add_argument("--wait", type=float, default=3.0)
+
+    sd = sub.add_parser("spacedrop", help="send files to a peer")
+    sd.add_argument("identity")
+    sd.add_argument("files", nargs="+")
+    sd.add_argument("--wait", type=float, default=3.0)
+
     cr = sub.add_parser("crypto", help="encrypted-file tools")
     crs = cr.add_subparsers(dest="crypto_cmd", required=True)
     for name in ("inspect", "encrypt", "decrypt"):
@@ -273,6 +381,12 @@ def main(argv: list[str] | None = None) -> int:
         return asyncio.run(cmd_browse(args))
     if args.cmd == "duplicates":
         return asyncio.run(cmd_duplicates(args))
+    if args.cmd == "peers":
+        return asyncio.run(cmd_peers(args))
+    if args.cmd == "pair":
+        return asyncio.run(cmd_pair(args))
+    if args.cmd == "spacedrop":
+        return asyncio.run(cmd_spacedrop(args))
     if args.cmd == "crypto":
         return cmd_crypto(args)
     if args.cmd == "bench":

@@ -77,6 +77,7 @@ class Decoded:
     array: np.ndarray  # HxWx4 uint8 RGBA
     target: tuple[int, int]  # (th, tw) scaled dims
     orientation: int = 1
+    is_video: bool = False  # film-strip overlay on finish
 
 
 def can_generate(extension: str | None) -> bool:
@@ -157,7 +158,7 @@ def decode_video_frame(path: str) -> Decoded:
     h, w = rgb.shape[:2]
     arr = np.dstack([rgb, np.full((h, w, 1), 255, np.uint8)])
     tw, th = tj.video_dimensions(w, h)
-    return Decoded(array=np.ascontiguousarray(arr), target=(th, tw))
+    return Decoded(array=np.ascontiguousarray(arr), target=(th, tw), is_video=True)
 
 
 def decode_heif_image(path: str, extension: str) -> Decoded:
@@ -187,9 +188,28 @@ def encode_webp(arr: np.ndarray, quality: int = WEBP_QUALITY) -> bytes:
     return buf.getvalue()
 
 
+def apply_film_strip(arr: np.ndarray) -> np.ndarray:
+    """Sprocket-hole side strips marking video thumbs
+    (ref:crates/ffmpeg/src/film_strip.rs draws the same overlay)."""
+    arr = arr.copy()
+    h, w = arr.shape[:2]
+    strip = max(4, min(w // 10, 20))
+    hole_h = max(2, strip // 2)
+    hole_w = max(2, strip // 2)
+    pitch = hole_h * 3
+    for x0, x1 in ((0, strip), (w - strip, w)):
+        arr[:, x0:x1, :3] = (arr[:, x0:x1, :3] * 0.2).astype(np.uint8)
+        cx0 = x0 + (strip - hole_w) // 2
+        for y in range((pitch - hole_h) // 2, h - hole_h, pitch):
+            arr[y : y + hole_h, cx0 : cx0 + hole_w, :3] = 235
+    return arr
+
+
 def finish(decoded: Decoded, resized: np.ndarray) -> bytes:
-    """Orientation-correct the device output and encode."""
+    """Orientation-correct the device output, overlay, and encode."""
     arr = tj.apply_orientation(resized, decoded.orientation)
+    if decoded.is_video:
+        arr = apply_film_strip(arr)
     return encode_webp(np.ascontiguousarray(arr))
 
 
@@ -206,6 +226,8 @@ def resize_cpu(d: Decoded) -> bytes:
     th, tw = d.target
     img = Image.fromarray(d.array, "RGBA").resize((tw, th), Image.BILINEAR)
     arr = tj.apply_orientation(np.asarray(img), d.orientation)
+    if d.is_video:
+        arr = apply_film_strip(arr)
     return encode_webp(np.ascontiguousarray(arr))
 
 

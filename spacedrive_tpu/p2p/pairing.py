@@ -224,6 +224,14 @@ class PairingManager:
             w.msgpack({"ok": False, "error": "library not found on this node"})
             await w.flush()
             return
+        # backfill pre-sync rows NOW, overlapping the user's decision —
+        # never inside the reply window (a big library would blow the
+        # joiner's read deadline)
+        from ..sync.ingest import backfill_operations
+
+        backfill_task = asyncio.ensure_future(
+            asyncio.to_thread(backfill_operations, target.sync)
+        )
         req = PairingRequest(
             id=uuid.uuid4(),
             peer=stream.remote_identity,
@@ -246,9 +254,12 @@ class PairingManager:
 
         lib = target if accepted else None
         if lib is None:
+            backfill_task.cancel()
             w.msgpack({"ok": False, "error": "pairing rejected"})
             await w.flush()
             return
+        # rows that predate sync must have ops before the joiner pulls
+        await backfill_task
         instances = [
             {
                 "pub_id": row["pub_id"],

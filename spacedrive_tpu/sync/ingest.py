@@ -223,14 +223,20 @@ def backfill_operations(sync: SyncManager) -> int:
     for model in SYNC_MODELS.values():
         if model.kind is SyncKind.LOCAL:
             continue
+        # one query per model, not one per row — backfill runs on every
+        # pairing accept, so the no-op case must stay O(models)
+        covered = {
+            r["record_id"]
+            for r in sync.db.query(
+                "SELECT DISTINCT record_id FROM crdt_operation WHERE model = ?",
+                (model.name,),
+            )
+        }
         for row in sync.db.query(f"SELECT * FROM {model.name}"):
             record_id = _row_sync_id(sync, model, row)
             if record_id is None:
                 continue
-            if sync.db.query_one(
-                "SELECT 1 FROM crdt_operation WHERE model = ? AND record_id = ?",
-                (model.name, _record_id_blob(record_id)),
-            ):
+            if _record_id_blob(record_id) in covered:
                 continue
             values = _row_sync_values(sync, model, row)
             if model.kind is SyncKind.SHARED:

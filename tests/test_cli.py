@@ -16,7 +16,16 @@ def test_parser_covers_commands():
     assert args.cmd == "index" and args.backend == "cpu"
     args = p.parse_args(["crypto", "inspect", "/y"])
     assert args.crypto_cmd == "inspect"
-    for cmd in (["serve"], ["status"], ["browse", "/x"], ["duplicates"], ["bench"]):
+    for cmd in (
+        ["serve"],
+        ["status"],
+        ["browse", "/x"],
+        ["duplicates"],
+        ["bench"],
+        ["peers"],
+        ["pair", "someidentity"],
+        ["spacedrop", "someidentity", "/tmp/f"],
+    ):
         assert p.parse_args(cmd).cmd == cmd[0]
 
 

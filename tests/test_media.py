@@ -62,13 +62,24 @@ def test_video_thumbnail_via_cv2(tmp_path):
     vw = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 10, (w, h))
     assert vw.isOpened()
     for i in range(30):
-        frame = np.full((h, w, 3), (i * 8) % 255, np.uint8)
+        # bright frames so the film-strip darkening is measurable
+        frame = np.full((h, w, 3), 180 + (i % 40), np.uint8)
         vw.write(frame)
     vw.release()
     d = process.decode_video_frame(path)
     assert d.array.shape[2] == 4 and d.array.shape[0] > 0
     webp = process.generate_one_cpu(path, "mp4")
     assert webp[:4] == b"RIFF" and webp[8:12] == b"WEBP"
+
+    # film-strip overlay marks video thumbs (crates/ffmpeg film_strip.rs)
+    import io as _io
+
+    from PIL import Image
+
+    frame = np.asarray(Image.open(_io.BytesIO(webp)).convert("RGB"))
+    fh, fw = frame.shape[:2]
+    strip = max(4, min(fw // 10, 20))
+    assert frame[:, :strip].mean() < frame[:, strip:-strip].mean() * 0.75
 
     # stream facts (media-metadata video parity, via the same decoder)
     from spacedrive_tpu.object.media.media_data import VideoMetadata
