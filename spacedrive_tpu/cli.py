@@ -94,6 +94,20 @@ async def cmd_serve(args: argparse.Namespace) -> int:
     print(f"sdx serving on http://{args.host}:{port}  (rspc: /rspc/<key>)")
     if node.p2p is not None:
         print(f"p2p on port {node.p2p.port}, identity {node.p2p.p2p.remote_identity}")
+        if args.auto_accept_pairing:
+            node.p2p.pairing.auto_accept = True
+            print("pairing: auto-accept enabled")
+    if args.cloud:
+        # persist the origin even with zero libraries yet — libraries
+        # created later enable against it via cloud.sync.enable
+        node.config.config.preferences["cloud_api_origin"] = args.cloud
+        node.config.save()
+        for lib in list(node.libraries.libraries.values()):
+            await node.enable_cloud_sync(lib)
+        print(
+            f"cloud sync: {args.cloud} "
+            f"({len(node.libraries.libraries)} libraries enabled)"
+        )
     try:
         while True:
             await asyncio.sleep(3600)
@@ -331,6 +345,10 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--host", default="127.0.0.1")
     sv.add_argument("--port", type=int, default=8080)
     sv.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
+    sv.add_argument("--auto-accept-pairing", action="store_true",
+                    help="headless nodes: accept library joins without a prompt")
+    sv.add_argument("--cloud", metavar="ORIGIN",
+                    help="enable cloud sync for all libraries against this relay")
 
     st = sub.add_parser("status", help="node + library status")
     st.add_argument("--no-p2p", action="store_true", default=True)
