@@ -421,6 +421,54 @@ def _ephemeral(r: Router) -> None:
             walk_dir, node, arg["path"], with_hidden=bool(arg.get("with_hidden", False))
         )
 
+    # mutations on non-indexed paths (ref:core/src/api/ephemeral_files.rs)
+    @r.mutation("ephemeralFiles.createFolder")
+    def create_folder(node, arg):
+        name = arg["name"]
+        if os.sep in name or "/" in name:
+            raise RspcError.bad_request("folder name must not contain separators")
+        path = os.path.join(os.path.abspath(arg["path"]), name)
+        try:
+            os.mkdir(path)  # exactly one level, races surface as EEXIST
+        except OSError as e:
+            raise RspcError.bad_request(f"create {path}: {e}")
+        return path
+
+    @r.mutation("ephemeralFiles.renameFile")
+    def rename_file(node, arg):
+        src = os.path.abspath(arg["path"])
+        dst = os.path.join(os.path.dirname(src), arg["new_name"])
+        # lexists: a dangling symlink is still an entry to rename/protect
+        if not os.path.lexists(src):
+            raise RspcError.not_found("path")
+        if os.path.lexists(dst):
+            raise RspcError.bad_request("target name already exists")
+        try:
+            os.rename(src, dst)
+        except OSError as e:
+            raise RspcError.bad_request(f"rename: {e}")
+        return dst
+
+    @r.mutation("ephemeralFiles.deleteFiles")
+    def delete_files(node, arg):
+        import shutil
+
+        deleted = 0
+        errors: list[str] = []
+        for p in arg["paths"]:
+            p = os.path.abspath(p)
+            try:
+                if os.path.islink(p) or os.path.isfile(p):
+                    os.remove(p)
+                elif os.path.isdir(p):
+                    shutil.rmtree(p)
+                else:
+                    continue
+                deleted += 1
+            except OSError as e:
+                errors.append(f"delete {p}: {e}")  # keep going (job parity)
+        return {"deleted": deleted, "errors": errors}
+
 
 # --- jobs ----------------------------------------------------------------
 

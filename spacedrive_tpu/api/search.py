@@ -94,6 +94,22 @@ def search_paths(library: Any, arg: dict[str, Any] | None) -> dict[str, Any]:
     if (fav := f.get("favorite")) is not None:
         conds.append("COALESCE(o.favorite, 0) = ?")
         params.append(int(bool(fav)))
+    if (md := f.get("mediaDate")):
+        # EXIF capture-time range over media_data.epoch_time
+        # (ref:api/search object filters joining media_data)
+        if not isinstance(md, dict):
+            raise RspcError.bad_request("mediaDate must be {from?, to?}")
+        sub = ["md.epoch_time IS NOT NULL"]
+        if md.get("from") is not None:
+            sub.append("md.epoch_time >= ?")
+            params.append(int(md["from"]))
+        if md.get("to") is not None:
+            sub.append("md.epoch_time <= ?")
+            params.append(int(md["to"]))
+        conds.append(
+            "fp.object_id IN (SELECT md.object_id FROM media_data md "
+            f"WHERE {' AND '.join(sub)})"
+        )
 
     order_field, direction = _ordering(arg, _FILE_PATH_ORDER, default="name")
     _apply_cursor(arg.get("cursor"), order_field, direction, "fp.id", conds, params)

@@ -97,17 +97,22 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         if args.auto_accept_pairing:
             node.p2p.pairing.auto_accept = True
             print("pairing: auto-accept enabled")
+    elif args.auto_accept_pairing:
+        print("warning: --auto-accept-pairing ignored (p2p disabled)",
+              file=sys.stderr)
     if args.cloud:
         # persist the origin even with zero libraries yet — libraries
         # created later enable against it via cloud.sync.enable
         node.config.config.preferences["cloud_api_origin"] = args.cloud
         node.config.save()
+        enabled = 0
         for lib in list(node.libraries.libraries.values()):
-            await node.enable_cloud_sync(lib)
-        print(
-            f"cloud sync: {args.cloud} "
-            f"({len(node.libraries.libraries)} libraries enabled)"
-        )
+            try:
+                await node.enable_cloud_sync(lib)
+                enabled += 1
+            except Exception as e:
+                print(f"cloud sync for {lib.name!r} failed: {e}", file=sys.stderr)
+        print(f"cloud sync: {args.cloud} ({enabled} libraries enabled)")
     try:
         while True:
             await asyncio.sleep(3600)

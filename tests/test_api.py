@@ -254,6 +254,44 @@ def test_api_full_flow(tmp_path, corpus):
             eph = await r.exec(node, "ephemeralFiles.list", {"path": corpus})
             assert any(e["name"] == "nested" and e["is_dir"] for e in eph["entries"])
 
+            # ephemeral mutations (ref:api/ephemeral_files.rs)
+            scratch = os.path.join(str(corpus), "..", "scratch")
+            os.makedirs(scratch, exist_ok=True)
+            folder = await r.exec(
+                node, "ephemeralFiles.createFolder",
+                {"path": scratch, "name": "made-here"},
+            )
+            assert os.path.isdir(folder)
+            open(os.path.join(scratch, "loose.txt"), "w").write("x")
+            renamed = await r.exec(
+                node, "ephemeralFiles.renameFile",
+                {"path": os.path.join(scratch, "loose.txt"), "new_name": "kept.txt"},
+            )
+            assert os.path.exists(renamed)
+            out = await r.exec(
+                node, "ephemeralFiles.deleteFiles",
+                {"paths": [renamed, folder, "/nonexistent/zzz"]},
+            )
+            assert out["deleted"] == 2 and out["errors"] == []
+            assert not os.path.exists(folder)
+
+            # mediaDate range filter rides media_data.epoch_time
+            lib.db.upsert(
+                "media_data", {"object_id": fp["object_id"]}, epoch_time=1_700_000_000
+            )
+            hits = await r.exec(
+                node, "search.paths",
+                {"filter": {"mediaDate": {"from": 1_600_000_000, "to": 1_800_000_000}}},
+                library_id=lid,
+            )
+            assert [n_["__id"] for n_ in hits["items"]] == [fp["id"]]
+            none = await r.exec(
+                node, "search.paths",
+                {"filter": {"mediaDate": {"from": 1_900_000_000}}},
+                library_id=lid,
+            )
+            assert none["items"] == []
+
             # backups roundtrip: backup, mutate, restore, verify rollback
             backup_id = await r.exec(node, "backups.backup", library_id=lid)
             await r.exec(node, "tags.create", {"name": "doomed"}, library_id=lid)

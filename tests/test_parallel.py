@@ -48,6 +48,13 @@ def test_make_mesh_and_sharded_compute():
     assert fm.axis_names == ("dp",) and fm.devices.size == 8
 
 
+def test_multihost_init_noop_without_cluster():
+    from spacedrive_tpu.parallel import multihost_init
+
+    # no coordinator env: must be a clean no-op, never an exception
+    assert multihost_init() is False
+
+
 def test_prefetcher_overlap_and_fallback():
     pf = Prefetcher()
     timeline = []
