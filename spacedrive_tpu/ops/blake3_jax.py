@@ -146,6 +146,23 @@ def _chunk_cvs(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> tuple[ja
     )
 
     t_lo = chunk_idx.astype(_U)
+
+    mode = _pallas_mode_static.get("mode")
+    if mode is not None:
+        # Pallas kernel for the hot stage (ops/blake3_pallas.py)
+        from . import blake3_pallas
+
+        h_fin8 = blake3_pallas.chunk_cvs(
+            words,
+            block_len.astype(_U),
+            flags,
+            active.astype(_U),
+            t_lo[None, :],
+            interpret=(mode == "interpret"),
+        )  # [8, N]
+        cvs = h_fin8.T.reshape(b_dim, c_dim, 8)
+        return cvs, n_chunks
+
     h0 = [_U(IV[i]) + jnp.zeros((n,), _U) for i in range(8)]
 
     def step(h, xs):
@@ -220,15 +237,62 @@ def _hash_batch_impl(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> ja
     return _tree_reduce(cvs, n_chunks)
 
 
+# `_chunk_cvs` reads the chunk-stage backend from here at TRACE time;
+# one jitted wrapper per mode keeps the jit cache from pinning a failed
+# Pallas program onto the fallback path
+_pallas_mode_static: dict = {"mode": None}
+
+
+def _make_mode_impl(mode: str | None):
+    @functools.partial(jax.jit, static_argnames=("max_chunks",))
+    def impl(msgs, lengths, max_chunks):
+        _pallas_mode_static["mode"] = mode  # runs at trace time
+        try:
+            return _hash_batch_impl(msgs, lengths, max_chunks)
+        finally:
+            _pallas_mode_static["mode"] = None
+
+    return impl
+
+
+_hash_batch_impl_modes = {
+    mode: _make_mode_impl(mode) for mode in (None, "tpu", "interpret")
+}
+
+_pallas_disabled = [False]
+
+
+def _resolve_pallas_mode() -> str | None:
+    from . import blake3_pallas
+
+    if _pallas_disabled[0]:
+        return None
+    return blake3_pallas.pallas_mode()
+
+
 def hash_batch(msgs, lengths, max_chunks: int | None = None) -> jax.Array:
     """Hash B messages. msgs: uint8[B, C*1024] (zero-padded), lengths:
     int32[B] actual byte counts. Returns uint32[B, 8] — the first 32
     digest bytes as LE words (all the framework ever needs: cas_id is 8
-    bytes, validator checksum 32)."""
+    bytes, validator checksum 32). The chunk stage runs as a Pallas
+    kernel on real TPUs (ops/blake3_pallas.py), XLA otherwise; any
+    Pallas failure permanently falls back to the XLA path."""
     msgs = jnp.asarray(msgs, jnp.uint8)
     if max_chunks is None:
         max_chunks = msgs.shape[1] // CHUNK_LEN
-    return _hash_batch_impl(msgs, jnp.asarray(lengths, jnp.int32), max_chunks)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    mode = _resolve_pallas_mode()
+    if mode is not None:
+        try:
+            return _hash_batch_impl_modes[mode](msgs, lengths, max_chunks=max_chunks)
+        except Exception:  # Mosaic/compile/runtime failure → XLA path
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "pallas blake3 failed; falling back to XLA permanently"
+            )
+            _pallas_disabled[0] = True
+    return _hash_batch_impl_modes[None](msgs, lengths, max_chunks=max_chunks)
 
 
 def words_to_digests(words, out_len: int = 32) -> list[bytes]:

@@ -1,0 +1,170 @@
+"""BLAKE3 chunk compression as a Pallas TPU kernel.
+
+The chunk stage is ~94% of the hash FLOPs (16 blocks × 7 rounds of the
+compression permutation per 1 KiB chunk; the tree merge above it is
+O(log C)). This kernel runs that stage as one Pallas program over lane
+tiles: every buffer lives in VMEM laid out `[..., LANES]` so the VPU's
+8×128 registers vectorize across chunk lanes, the 16-block walk is a
+`fori_loop` carrying the 8-word state `[8, LANES]`, and the 7 rounds
+unroll with HOST-precomputed message schedules (perm^r applied to
+static indices — no in-kernel gathers).
+
+Bit-exactness contract is identical to ops/blake3_jax.py (golden-tested
+against the reference vectors); `ops/blake3_jax.hash_batch` calls this
+kernel when the backend is a real TPU (`SD_BLAKE3_PALLAS=0` opts out,
+`=1` forces interpret mode elsewhere) and falls back to its XLA path on
+any Pallas failure. Guide: /opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+from .blake3_ref import IV, MSG_PERMUTATION
+
+LANES = 512  # lane tile: [16,16,512] words ≈ 512 KiB in VMEM, 4× the f32 tile
+_ROUNDS = 7
+
+
+@functools.lru_cache(maxsize=1)
+def _schedules() -> tuple[tuple[int, ...], ...]:
+    """schedule[r][k] = original word index feeding slot k in round r
+    (the permutation applied r times), so rounds unroll with static
+    indices instead of in-kernel gathers."""
+    perm = list(range(16))
+    out = []
+    for _ in range(_ROUNDS):
+        out.append(tuple(perm))
+        perm = [perm[i] for i in MSG_PERMUTATION]
+    return tuple(out)
+
+
+def _build_kernel():
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    U = jnp.uint32
+    schedules = _schedules()
+    iv = [np.uint32(IV[i]) for i in range(8)]
+
+    def rotr(x, r):
+        return (x >> np.uint32(r)) | (x << np.uint32(32 - r))
+
+    def kernel(words_ref, block_len_ref, flags_ref, active_ref, t_ref, out_ref):
+        lanes = out_ref.shape[1]
+        zeros = jnp.zeros((lanes,), U)
+        h0 = jnp.stack([iv[i] + zeros for i in range(8)])  # [8, L]
+        t_lo = t_ref[0, :]
+
+        def block_step(b, h):
+            md = words_ref[b]  # [16, L]
+            m = [md[j] for j in range(16)]
+            blen = block_len_ref[b, :]
+            flg = flags_ref[b, :]
+            act = active_ref[b, :] != np.uint32(0)
+            v = [h[i] for i in range(8)] + [
+                iv[0] + zeros, iv[1] + zeros, iv[2] + zeros, iv[3] + zeros,
+                t_lo, zeros, blen, flg,
+            ]
+
+            def g(a, bb, c, d, mx, my):
+                v[a] = v[a] + v[bb] + mx
+                v[d] = rotr(v[d] ^ v[a], 16)
+                v[c] = v[c] + v[d]
+                v[bb] = rotr(v[bb] ^ v[c], 12)
+                v[a] = v[a] + v[bb] + my
+                v[d] = rotr(v[d] ^ v[a], 8)
+                v[c] = v[c] + v[d]
+                v[bb] = rotr(v[bb] ^ v[c], 7)
+
+            for r in range(_ROUNDS):
+                s = schedules[r]
+                g(0, 4, 8, 12, m[s[0]], m[s[1]])
+                g(1, 5, 9, 13, m[s[2]], m[s[3]])
+                g(2, 6, 10, 14, m[s[4]], m[s[5]])
+                g(3, 7, 11, 15, m[s[6]], m[s[7]])
+                g(0, 5, 10, 15, m[s[8]], m[s[9]])
+                g(1, 6, 11, 12, m[s[10]], m[s[11]])
+                g(2, 7, 8, 13, m[s[12]], m[s[13]])
+                g(3, 4, 9, 14, m[s[14]], m[s[15]])
+
+            h_new = jnp.stack([v[i] ^ v[i + 8] for i in range(8)])
+            return jnp.where(act[None, :], h_new, h)
+
+        out_ref[:, :] = lax.fori_loop(0, 16, block_step, h0)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=2)
+def _chunk_cvs_call(interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = _build_kernel()
+    mem = {} if interpret else {"memory_space": pltpu.VMEM}
+
+    @functools.partial(jax.jit, static_argnames=())
+    def run(words, block_len, flags, active, t_lo):
+        """words [16,16,N], block_len/flags/active [16,N], t_lo [1,N]
+        (N a multiple of LANES) -> cvs [8, N] uint32."""
+        n = words.shape[2]
+        grid = (n // LANES,)
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, n), jnp.uint32),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((16, 16, LANES), lambda i: (0, 0, i), **mem),
+                pl.BlockSpec((16, LANES), lambda i: (0, i), **mem),
+                pl.BlockSpec((16, LANES), lambda i: (0, i), **mem),
+                pl.BlockSpec((16, LANES), lambda i: (0, i), **mem),
+                pl.BlockSpec((1, LANES), lambda i: (0, i), **mem),
+            ],
+            out_specs=pl.BlockSpec((8, LANES), lambda i: (0, i), **mem),
+            interpret=interpret,
+        )(words, block_len, flags, active, t_lo)
+
+    return run
+
+
+def pallas_mode() -> str | None:
+    """'tpu' (real kernel), 'interpret', or None (disabled).
+
+    Default: real kernel on TPU backends only. SD_BLAKE3_PALLAS=1
+    forces interpret mode elsewhere (tests); =0 disables entirely.
+    """
+    env = os.environ.get("SD_BLAKE3_PALLAS")
+    if env == "0":
+        return None
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        return None
+    if platform == "tpu":
+        return "tpu"
+    return "interpret" if env == "1" else None
+
+
+def chunk_cvs(words, block_len, flags, active, t_lo, *, interpret: bool):
+    """Pad the lane dim to LANES and run the kernel; returns [8, N]."""
+    import jax.numpy as jnp
+
+    n = words.shape[2]
+    pad = (-n) % LANES
+    if pad:
+        words = jnp.pad(words, ((0, 0), (0, 0), (0, pad)))
+        block_len = jnp.pad(block_len, ((0, 0), (0, pad)))
+        flags = jnp.pad(flags, ((0, 0), (0, pad)))
+        active = jnp.pad(active, ((0, 0), (0, pad)))
+        t_lo = jnp.pad(t_lo, ((0, 0), (0, pad)))
+    out = _chunk_cvs_call(interpret)(words, block_len, flags, active, t_lo)
+    return out[:, :n]

@@ -28,6 +28,8 @@ try:
     try:
         import optax  # noqa: F401
         import flax  # noqa: F401
+        from jax.experimental import pallas  # noqa: F401
+        from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
     except Exception:
         pass
 

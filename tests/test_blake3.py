@@ -65,3 +65,27 @@ def test_jax_matches_reference_tree_shapes():
     hexes = bj.words_to_hex(bj.hash_batch(msgs, np.array(lens, np.int32), max_chunks=bucket))
     for i, n in enumerate(lens):
         assert hexes[i] == ref.blake3_hex(DATA[:n]), f"len={n}"
+
+
+def test_pallas_chunk_kernel_parity(monkeypatch):
+    """The Pallas chunk-stage kernel (interpret mode on the CPU mesh)
+    must be bit-identical to the XLA path and the reference."""
+    from spacedrive_tpu.ops import blake3_pallas
+
+    monkeypatch.setenv("SD_BLAKE3_PALLAS", "1")
+    assert blake3_pallas.pallas_mode() == "interpret"
+    bucket = 16
+    lens = [0, 5, 1024, 1025, 4096, 16 * 1024, 9 * 1024 + 321]
+    msgs = np.zeros((len(lens), bucket * 1024), np.uint8)
+    for i, n in enumerate(lens):
+        msgs[i, :n] = np.frombuffer(DATA[:n], np.uint8)
+    arr_lens = np.array(lens, np.int32)
+    via_pallas = bj.words_to_hex(
+        bj._hash_batch_impl_modes["interpret"](msgs, arr_lens, max_chunks=bucket)
+    )
+    via_xla = bj.words_to_hex(
+        bj._hash_batch_impl_modes[None](msgs, arr_lens, max_chunks=bucket)
+    )
+    assert via_pallas == via_xla
+    for i, n in enumerate(lens):
+        assert via_pallas[i] == ref.blake3_hex(DATA[:n]), f"len={n}"
