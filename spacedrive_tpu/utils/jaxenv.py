@@ -1,0 +1,57 @@
+"""Force a virtual n-device CPU JAX platform in this process.
+
+Shared by tests/conftest.py and __graft_entry__'s multichip dryrun so
+the version-sensitive scrub of private jax internals lives in exactly
+one place. The scrub exists because a sitecustomize hook may register a
+TPU-tunnel PJRT plugin (platform "axon") whose device query can block
+even under JAX_PLATFORMS=cpu, and because the hook imports jax early —
+before env vars set here would be read — so the config must also be
+forced directly.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int) -> None:
+    """Make jax.devices() return n virtual CPU devices, nothing else.
+
+    Safe to call whether or not jax was already imported; must run
+    before the first device query (backend instantiation) to take
+    effect.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    try:
+        import jax
+        import jax._src.xla_bridge as _xb
+
+        # chex (via optax/flax) registers TPU lowering rules at import
+        # time, which needs "tpu" still present in known_platforms —
+        # import them BEFORE deregistering the accelerator backends.
+        try:
+            import optax  # noqa: F401
+            import flax  # noqa: F401
+            from jax.experimental import pallas  # noqa: F401
+            from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
+        except Exception:
+            pass
+
+        for _name in list(getattr(_xb, "_backend_factories", {})):
+            if _name not in ("cpu", "interpreter"):
+                _xb._backend_factories.pop(_name, None)
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", n)
+        except Exception:
+            pass  # older jax: the XLA_FLAGS path above applies
+    except Exception:
+        pass

@@ -8,43 +8,11 @@ N in-process nodes + loopback transports for distributed tests.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-# If a TPU-tunnel PJRT plugin (e.g. "axon") was registered by a
-# sitecustomize hook, deregister it: its device query can block even
-# when JAX_PLATFORMS=cpu, and the test suite must never touch real
-# accelerator hardware. The hook also imports jax early, so the env
-# vars above were read already — force the config directly too.
-try:
-    import jax
-    import jax._src.xla_bridge as _xb
-
-    # chex (via optax/flax) registers TPU lowering rules at import time,
-    # which needs "tpu" still present in known_platforms — import them
-    # BEFORE deregistering the accelerator backends below
-    try:
-        import optax  # noqa: F401
-        import flax  # noqa: F401
-        from jax.experimental import pallas  # noqa: F401
-        from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
-    except Exception:
-        pass
-
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name not in ("cpu", "interpreter"):
-            _xb._backend_factories.pop(_name, None)
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_num_cpu_devices", 8)
-    except Exception:
-        pass  # older jax: XLA_FLAGS path above applies
-except Exception:
-    pass
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spacedrive_tpu.utils.jaxenv import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
 
 # Minimal async-test support (pytest-asyncio isn't in the image):
 # coroutine test functions run under asyncio.run with a fresh loop.
