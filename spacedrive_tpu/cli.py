@@ -324,6 +324,80 @@ def cmd_crypto(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_labeler(args: argparse.Namespace) -> int:
+    """Provision/inspect the image-labeler model artifact.
+
+    The reference downloads pretrained YOLOv8 before labeling can run
+    (ref:crates/ai/src/image_labeler/model/yolov8.rs:45-88); offline
+    deployments instead train a checkpoint here (`sdx labeler train`)
+    or drop any `.onnx` classifier at <data-dir>/image_labeler/model.onnx.
+    """
+    labeler_dir = os.path.join(args.data_dir, "image_labeler")
+    if args.labeler_cmd == "status":
+        from .models.labeler_actor import ImageLabeler
+
+        actor = ImageLabeler(labeler_dir)
+        artifact = actor.resolve_artifact()
+        info = {"artifact": None, "enabled": False}
+        if artifact is not None:
+            info = {"artifact": {"kind": artifact[0], "path": artifact[1]},
+                    "enabled": True}
+            if artifact[0] == "checkpoint":
+                from .models import checkpoint
+
+                _params, meta = checkpoint.load(artifact[1])
+                info["classes"] = len(meta["classes"])
+                info["image_size"] = meta["image_size"]
+                info["metrics"] = meta.get("metrics", {})
+        print(json.dumps(info, indent=2))
+        return 0
+    if args.labeler_cmd == "train":
+        from .models.train import TrainConfig, train_folder
+
+        cfg = TrainConfig(
+            image_size=args.image_size, batch_size=args.batch_size,
+            steps=args.steps, learning_rate=args.lr,
+            use_device=args.backend != "cpu",
+        )
+        out = args.out or os.path.join(labeler_dir, "weights.npz")
+        metrics = train_folder(
+            args.dataset, out, cfg,
+            progress=lambda step, loss: print(
+                f"step {step}/{cfg.steps}  loss {loss:.4f}", flush=True
+            ),
+        )
+        print(json.dumps({"checkpoint": out, "metrics": metrics}, indent=2))
+        return 0
+    if args.labeler_cmd == "train-demo":
+        import numpy as np
+
+        from .models import checkpoint as ckpt_mod
+        from .models.train import (
+            TrainConfig, array_batches, digits_demo_dataset, train,
+        )
+
+        cfg = TrainConfig(
+            image_size=32, widths=(8, 16, 32, 32, 32), depths=(1, 1, 1, 1),
+            batch_size=64, steps=args.steps,
+            use_device=args.backend != "cpu",
+        )
+        (tr_x, tr_y), (ev_x, ev_y), classes = digits_demo_dataset(cfg.image_size)
+        params, _model, metrics = train(
+            array_batches(tr_x, tr_y, cfg.batch_size), classes, cfg,
+            eval_set=(ev_x, ev_y),
+            progress=lambda step, loss: print(
+                f"step {step}/{cfg.steps}  loss {loss:.4f}", flush=True
+            ),
+        )
+        out = args.out or os.path.join(labeler_dir, "weights.npz")
+        ckpt_mod.save(out, params, classes=classes, image_size=cfg.image_size,
+                      widths=cfg.widths, depths=cfg.depths,
+                      extra={"metrics": metrics, "trained_on": "sklearn-digits"})
+        print(json.dumps({"checkpoint": out, "metrics": metrics}, indent=2))
+        return 0
+    return 2
+
+
 def cmd_bench(_args: argparse.Namespace) -> int:
     import runpy
 
@@ -388,6 +462,22 @@ def build_parser() -> argparse.ArgumentParser:
         if name != "inspect":
             c.add_argument("--password")
 
+    lb = sub.add_parser("labeler", help="image-labeler model artifacts")
+    lbs = lb.add_subparsers(dest="labeler_cmd", required=True)
+    lbs.add_parser("status", help="show the provisioned model artifact")
+    lt = lbs.add_parser("train", help="train a checkpoint on a folder-per-class dataset")
+    lt.add_argument("dataset", help="root dir: <root>/<class_name>/*.jpg")
+    lt.add_argument("--out", help="checkpoint path (default: <data-dir>/image_labeler/weights.npz)")
+    lt.add_argument("--image-size", type=int, default=96)
+    lt.add_argument("--batch-size", type=int, default=32)
+    lt.add_argument("--steps", type=int, default=600)
+    lt.add_argument("--lr", type=float, default=1e-3)
+    lt.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
+    ld = lbs.add_parser("train-demo", help="self-contained demo: train on bundled digit scans")
+    ld.add_argument("--out")
+    ld.add_argument("--steps", type=int, default=300)
+    ld.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
+
     sub.add_parser("bench", help="run the headline benchmark")
     return p
 
@@ -412,6 +502,8 @@ def main(argv: list[str] | None = None) -> int:
         return asyncio.run(cmd_spacedrop(args))
     if args.cmd == "crypto":
         return cmd_crypto(args)
+    if args.cmd == "labeler":
+        return cmd_labeler(args)
     if args.cmd == "bench":
         return cmd_bench(args)
     return 2

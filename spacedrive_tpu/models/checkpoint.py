@@ -1,0 +1,81 @@
+"""Labeler checkpoint artifacts — save/load trained LabelerNet weights.
+
+The reference gates labeling on a provisioned model artifact: it
+downloads a versioned YOLOv8 `.onnx` into the node data dir before the
+labeler can run (ref:crates/ai/src/image_labeler/model/yolov8.rs:45-88,
+ref:core/src/node/config.rs `image_labeler_version`). This module is
+the same contract for the TPU-native model: a single `.npz` file
+holding flattened params plus a JSON header recording the architecture
+(widths/depths/image_size) and the class vocabulary, so inference can
+reconstruct the exact network. Inference NEVER runs from randomly
+initialized weights — no artifact, no labels.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+_META_KEY = "__meta__"
+
+
+def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: dict[str, np.ndarray]) -> dict[str, Any]:
+    tree: dict[str, Any] = {}
+    for path, arr in flat.items():
+        parts = path.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree
+
+
+def save(path: str | os.PathLike, params: Any, *, classes: list[str],
+         image_size: int, widths: list[int] | tuple[int, ...],
+         depths: list[int] | tuple[int, ...],
+         extra: dict[str, Any] | None = None) -> None:
+    """Write params + architecture metadata as one .npz artifact."""
+    path = os.fspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    flat = _flatten(params)
+    meta = {
+        "format": "spacedrive-labeler-v1",
+        "classes": list(classes),
+        "image_size": int(image_size),
+        "widths": [int(w) for w in widths],
+        "depths": [int(d) for d in depths],
+        **(extra or {}),
+    }
+    flat[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), np.uint8
+    ).copy()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, path)
+
+
+def load(path: str | os.PathLike) -> tuple[dict[str, Any], dict[str, Any]]:
+    """Read a checkpoint → (params pytree, meta dict)."""
+    with np.load(os.fspath(path)) as z:
+        flat = {k: z[k] for k in z.files}
+    raw = flat.pop(_META_KEY, None)
+    if raw is None:
+        raise ValueError(f"{path}: not a labeler checkpoint (missing meta)")
+    meta = json.loads(bytes(raw.tobytes()).decode("utf-8"))
+    if meta.get("format") != "spacedrive-labeler-v1":
+        raise ValueError(f"{path}: unknown checkpoint format {meta.get('format')}")
+    return _unflatten(flat), meta

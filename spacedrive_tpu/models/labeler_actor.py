@@ -73,9 +73,12 @@ class ImageLabeler:
         self._stopped = False
         self.labeled = 0
         self.errors = 0
+        self.skipped = 0  # entries completed with labeling disabled
+        self.classes: list[str] = list(labeler_model.LABEL_CLASSES)
         self._params = None
         self._model = None
         self._infer = None
+        self._disabled = False
         self._inflight: Batch | None = None
         # crash recovery (ref:actor.rs:73-99): batches persisted at
         # shutdown re-queue, keyed to libraries that re-register; the
@@ -92,30 +95,131 @@ class ImageLabeler:
                 os.remove(path)
 
     # --- model ----------------------------------------------------------
+    #
+    # The reference only labels once a model artifact is provisioned
+    # (it downloads versioned YOLOv8 .onnx before the actor can run,
+    # ref:crates/ai/src/image_labeler/model/yolov8.rs:45-88). Same
+    # contract here: weights.npz (trained LabelerNet checkpoint,
+    # models/checkpoint.py) or model.onnx (any ONNX classifier/YOLO
+    # head, models/onnx_runtime.py) in the actor data dir. Without an
+    # artifact the actor completes batches WITHOUT writing rows —
+    # random-weight inference would write noise labels.
 
-    def _ensure_model(self) -> None:
+    def resolve_artifact(self) -> tuple[str, str] | None:
+        """(kind, path) of the provisioned model artifact, or None."""
+        onnx_path = os.environ.get("SD_LABELER_ONNX") or os.path.join(
+            self.data_dir, "model.onnx"
+        )
+        if os.path.exists(onnx_path):
+            return ("onnx", onnx_path)
+        ckpt_path = os.environ.get("SD_LABELER_CKPT") or os.path.join(
+            self.data_dir, "weights.npz"
+        )
+        if os.path.exists(ckpt_path):
+            return ("checkpoint", ckpt_path)
+        return None
+
+    def _ensure_model(self) -> bool:
+        """Load the provisioned artifact; False = labeling disabled.
+
+        Re-resolves on every call so an artifact provisioned while the
+        node is running (e.g. `sdx labeler train` against a live
+        `sdx serve` data dir) enables labeling without a restart.
+        """
         if self._infer is not None:
-            return
+            return True
+        artifact = self.resolve_artifact()
+        if artifact is None:
+            if not self._disabled:  # warn once per disabled episode
+                logger.warning(
+                    "image labeler disabled: no model artifact (weights.npz "
+                    "checkpoint or model.onnx) in %s — batches will complete "
+                    "without writing labels", self.data_dir,
+                )
+            self._disabled = True
+            return False
+        self._disabled = False
+        kind, path = artifact
+        if kind == "onnx":
+            self._load_onnx(path)
+        else:
+            self._load_checkpoint(path)
+        logger.info(
+            "image labeler: loaded %s artifact %s (%d classes, %d px)",
+            kind, path, len(self.classes), self.image_size,
+        )
+        return True
+
+    def _load_checkpoint(self, path: str) -> None:
         import jax
 
-        self._model = labeler_model.LabelerNet()
-        # init on host CPU: flax init traced over a tunneled TPU pays a
-        # ~100 s round-trip-heavy compile for what is just param setup;
-        # one eager device_put below replaces all that traffic
-        with jax.default_device(jax.devices("cpu")[0]):
-            self._params = labeler_model.init_params(
-                jax.random.key(0), image_size=self.image_size, model=self._model
-            )
-        if self.use_device:
-            self._params = jax.device_put(self._params, jax.devices()[0])
+        from . import checkpoint
+
+        params, meta = checkpoint.load(path)
+        self.classes = list(meta["classes"])
+        self.image_size = int(meta["image_size"])
+        self._model = labeler_model.LabelerNet(
+            num_classes=len(self.classes),
+            widths=tuple(meta["widths"]),
+            depths=tuple(meta["depths"]),
+        )
+        device = jax.devices()[0] if self.use_device else jax.devices("cpu")[0]
+        self._params = jax.device_put(params, device)
         model = self._model
 
         @jax.jit
         def infer(params, images):
-            probs = jax.nn.sigmoid(model.apply({"params": params}, images))
-            return probs
+            return jax.nn.sigmoid(model.apply({"params": params}, images))
 
-        self._infer = infer
+        params_ref = self._params
+        self._infer = lambda images: infer(params_ref, images)
+
+    def _load_onnx(self, path: str) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from . import onnx_runtime
+
+        model = onnx_runtime.load(path)
+        shapes = model.input_shapes()
+        in_shape = shapes.get(model.inputs[0]) if model.inputs else None
+        if in_shape and len(in_shape) == 4:
+            if in_shape[2] and in_shape[2] > 0:
+                self.image_size = int(in_shape[2])
+            if in_shape[0] and in_shape[0] > 0:
+                self.batch_size = int(in_shape[0])
+        self.classes = list(labeler_model.LABEL_CLASSES)
+
+        def run(images):
+            """float[B, H, W, 3] in [0,1] → probs float[B, C]."""
+            x = jnp.transpose(images, (0, 3, 1, 2))  # ONNX vision = NCHW
+            out = model(x)[0]
+            if out.ndim == 3:
+                # YOLO-family head. Channel dim is far smaller than the
+                # anchor dim (e.g. 84 vs 8400); detect the layout from
+                # static shapes rather than assuming one export style.
+                d1, d2 = int(out.shape[1]), int(out.shape[2])
+                if d1 < d2:
+                    # v8 export [B, 4+C, anchors]: class scores are
+                    # post-sigmoid; a label's confidence is its best
+                    # anchor (the reference keeps any class clearing
+                    # the threshold, actor.rs:291)
+                    return jnp.max(out[:, 4:, :], axis=-1)
+                # v5-style export [B, anchors, 5+C]: obj conf at 4,
+                # class probs from 5; score = obj * cls, best anchor
+                obj = out[:, :, 4:5]
+                return jnp.max(obj * out[:, :, 5:], axis=1)
+            return jax.nn.sigmoid(out)  # rank-2 classifier logits
+
+        jitted = jax.jit(run)
+        self._infer = jitted
+        # YOLO class count may differ from the default vocabulary
+        probe = np.zeros(
+            (self.batch_size, self.image_size, self.image_size, 3), np.float32
+        )
+        n_classes = int(jax.eval_shape(run, probe).shape[1])
+        if n_classes != len(self.classes):
+            self.classes = [f"class {i}" for i in range(n_classes)]
 
     # --- API (ref:actor.rs new_batch / resume) --------------------------
 
@@ -230,6 +334,12 @@ class ImageLabeler:
         if library is None:
             logger.warning("labeler: unknown library %s", batch.library_id)
             return
+        if not await asyncio.to_thread(self._ensure_model):
+            # no provisioned model artifact: complete the batch without
+            # writing rows (never infer from random weights)
+            self.skipped += len(batch.entries)
+            self._batch_pending[batch.id] = 0
+            return
         for off in range(0, len(batch.entries), self.batch_size):
             chunk = batch.entries[off : off + self.batch_size]
             decoded = await asyncio.to_thread(self._decode_chunk, chunk)
@@ -265,7 +375,6 @@ class ImageLabeler:
         return out
 
     def _infer_chunk(self, images: np.ndarray) -> np.ndarray:
-        self._ensure_model()
         import jax
 
         n = images.shape[0]
@@ -277,9 +386,9 @@ class ImageLabeler:
             images = np.concatenate([images, pad])
         if not self.use_device:
             with jax.default_device(jax.devices("cpu")[0]):
-                probs = self._infer(self._params, images)
+                probs = self._infer(images)
         else:
-            probs = self._infer(self._params, images)
+            probs = self._infer(images)
         return np.asarray(probs)[:n]
 
     def _write_labels(
@@ -289,7 +398,7 @@ class ImageLabeler:
         db = library.db
         for entry, row_probs in zip(entries, probs):
             names = [
-                labeler_model.LABEL_CLASSES[i]
+                self.classes[i]
                 for i in np.nonzero(row_probs >= self.threshold)[0]
             ]
             for name in names:

@@ -1,0 +1,664 @@
+"""Execute ONNX graphs with JAX — the TPU-native ONNX Runtime stand-in.
+
+The reference hands its YOLOv8 `.onnx` to the `ort` C++ runtime with
+per-platform execution providers (ref:crates/ai/src/lib.rs:22-77).
+Here the execution provider IS XLA: `OnnxModel.__call__` is a pure
+function of its inputs, so `jax.jit` compiles the whole graph into one
+TPU program (MXU convs, fused elementwise). Static shapes only — the
+vision models this serves (YOLO heads, CNN classifiers) are static.
+
+Supported op set: what YOLO-family detectors and common CNN/MLP
+classifiers use. Unsupported ops raise with the op name so gaps are
+explicit, never silent.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Callable
+
+import numpy as np
+
+from . import onnx_proto as proto
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _np_static(x: Any, what: str) -> np.ndarray:
+    """Concretize a value that must be static (shape/index operands)."""
+    try:
+        return np.asarray(x)
+    except Exception as exc:  # jax tracer: data-dependent shape
+        raise ValueError(
+            f"ONNX graph uses a data-dependent {what}; static shapes only"
+        ) from exc
+
+
+class _Env(dict):
+    def fetch(self, names: list[str]) -> list[Any]:
+        return [None if n == "" else self[n] for n in names]
+
+
+def _is_host(v: Any) -> bool:
+    return v is None or isinstance(v, (np.ndarray, np.generic, int, float, bool))
+
+
+# Ops whose implementations call into jax.lax/jax.nn directly; everything
+# else is written against the jnp/numpy-compatible API surface and runs
+# in PLAIN NUMPY when all its inputs are host values. That keeps shape
+# subgraphs (Shape→Gather→Concat→Reshape…) concrete under jax.jit —
+# inside a trace, jnp ops stage even on constants, which would turn a
+# Reshape target into a tracer.
+_DEVICE_ONLY = frozenset({
+    "Conv", "ConvTranspose", "MaxPool", "AveragePool", "GlobalAveragePool",
+    "GlobalMaxPool", "Resize", "Upsample", "Softmax", "Erf", "MatMul",
+    "Gemm",
+})
+
+
+_OPS: dict[str, Callable] = {}
+
+
+def op(name: str):
+    def deco(fn):
+        _OPS[name] = fn
+        return fn
+    return deco
+
+
+def _attr_value(a: dict[str, Any]) -> Any:
+    t = a.get("type", 0)
+    if t == 1:
+        return a["f"]
+    if t == 2:
+        return a["i"]
+    if t == 3:
+        return a["s"].decode()
+    if t == 4:
+        return proto.tensor_to_array(a["t"])
+    if t == 6:
+        return list(a.get("floats", []))
+    if t == 7:
+        return list(a.get("ints", []))
+    if t == 8:
+        return [s.decode() for s in a.get("strings", [])]
+    raise ValueError(f"unsupported attribute type {t} ({a.get('name')})")
+
+
+def _attrs(node: dict[str, Any]) -> dict[str, Any]:
+    return {a["name"]: _attr_value(a) for a in node.get("attribute", [])}
+
+
+# --- elementwise / activation ---------------------------------------------
+
+def _ew(fn):
+    return lambda jnp, attrs, *xs: fn(jnp, *xs)
+
+
+op("Add")(_ew(lambda jnp, a, b: a + b))
+op("Sub")(_ew(lambda jnp, a, b: a - b))
+op("Mul")(_ew(lambda jnp, a, b: a * b))
+op("Div")(_ew(lambda jnp, a, b: a / b))
+op("Pow")(_ew(lambda jnp, a, b: a ** b))
+op("Sqrt")(_ew(lambda jnp, a: jnp.sqrt(a)))
+op("Exp")(_ew(lambda jnp, a: jnp.exp(a)))
+op("Log")(_ew(lambda jnp, a: jnp.log(a)))
+op("Neg")(_ew(lambda jnp, a: -a))
+op("Abs")(_ew(lambda jnp, a: jnp.abs(a)))
+op("Relu")(_ew(lambda jnp, a: jnp.maximum(a, 0)))
+op("Sigmoid")(_ew(lambda jnp, a: 1.0 / (1.0 + jnp.exp(-a))))
+op("Tanh")(_ew(lambda jnp, a: jnp.tanh(a)))
+op("Erf")(_ew(lambda jnp, a: _jax().scipy.special.erf(a)))
+op("Identity")(_ew(lambda jnp, a: a))
+op("Floor")(_ew(lambda jnp, a: jnp.floor(a)))
+op("Ceil")(_ew(lambda jnp, a: jnp.ceil(a)))
+op("Min")(_ew(lambda jnp, *xs: functools.reduce(jnp.minimum, xs)))
+op("Max")(_ew(lambda jnp, *xs: functools.reduce(jnp.maximum, xs)))
+
+
+@op("LeakyRelu")
+def _leaky_relu(jnp, attrs, x):
+    alpha = attrs.get("alpha", 0.01)
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@op("HardSigmoid")
+def _hard_sigmoid(jnp, attrs, x):
+    alpha = attrs.get("alpha", 0.2)
+    beta = attrs.get("beta", 0.5)
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@op("HardSwish")
+def _hard_swish(jnp, attrs, x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@op("Clip")
+def _clip(jnp, attrs, x, lo=None, hi=None):
+    lo = attrs.get("min", lo)
+    hi = attrs.get("max", hi)
+    if lo is not None:
+        x = jnp.maximum(x, lo)
+    if hi is not None:
+        x = jnp.minimum(x, hi)
+    return x
+
+
+@op("Softmax")
+def _softmax(jnp, attrs, x):
+    import jax
+
+    return jax.nn.softmax(x, axis=attrs.get("axis", -1))
+
+
+# --- tensor shuffling ------------------------------------------------------
+
+@op("Concat")
+def _concat(jnp, attrs, *xs):
+    return jnp.concatenate(xs, axis=attrs["axis"])
+
+
+@op("Reshape")
+def _reshape(jnp, attrs, x, shape=None):
+    target = [int(v) for v in _np_static(shape, "Reshape target").tolist()]
+    # ONNX: 0 copies the input dim (unless allowzero), -1 infers
+    out = [x.shape[i] if v == 0 and not attrs.get("allowzero") else v
+           for i, v in enumerate(target)]
+    return jnp.reshape(x, out)
+
+
+@op("Flatten")
+def _flatten(jnp, attrs, x):
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis], dtype=np.int64)) if axis else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@op("Transpose")
+def _transpose(jnp, attrs, x):
+    perm = attrs.get("perm") or list(range(x.ndim))[::-1]
+    return jnp.transpose(x, perm)
+
+
+@op("Unsqueeze")
+def _unsqueeze(jnp, attrs, x, axes=None):
+    ax = attrs.get("axes")
+    if ax is None:
+        ax = _np_static(axes, "Unsqueeze axes").tolist()
+    out = x
+    for a in sorted(int(v) for v in ax):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@op("Squeeze")
+def _squeeze(jnp, attrs, x, axes=None):
+    ax = attrs.get("axes")
+    if ax is None and axes is not None:
+        ax = _np_static(axes, "Squeeze axes").tolist()
+    return jnp.squeeze(x, axis=tuple(int(v) for v in ax) if ax else None)
+
+
+@op("Shape")
+def _shape(jnp, attrs, x):
+    return np.asarray(x.shape, np.int64)  # static under jit by design
+
+
+@op("Gather")
+def _gather(jnp, attrs, x, idx):
+    axis = attrs.get("axis", 0)
+    if isinstance(x, np.ndarray):
+        return np.take(x, _np_static(idx, "Gather indices"), axis=axis)
+    return jnp.take(x, jnp.asarray(idx), axis=axis)
+
+
+@op("Slice")
+def _slice(jnp, attrs, x, starts=None, ends=None, axes=None, steps=None):
+    if starts is None:  # opset < 10: attributes
+        starts = attrs["starts"]
+        ends = attrs["ends"]
+        axes = attrs.get("axes")
+        steps = None
+    starts = _np_static(starts, "Slice starts").tolist()
+    ends = _np_static(ends, "Slice ends").tolist()
+    axes = (_np_static(axes, "Slice axes").tolist()
+            if axes is not None else list(range(len(starts))))
+    steps = (_np_static(steps, "Slice steps").tolist()
+             if steps is not None else [1] * len(starts))
+    idx = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        ax = int(ax) % x.ndim
+        idx[ax] = slice(int(st), int(en), int(sp))
+    return x[tuple(idx)]
+
+
+@op("Split")
+def _split(jnp, attrs, x, split=None):
+    axis = attrs.get("axis", 0)
+    sizes = attrs.get("split")
+    if sizes is None and split is not None:
+        sizes = _np_static(split, "Split sizes").tolist()
+    if sizes is None:
+        n = attrs["num_outputs"]
+        base = x.shape[axis] // n
+        rem = x.shape[axis] - base * n
+        sizes = [base + (1 if i < rem else 0) for i in range(n)]
+    bounds = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, bounds, axis=axis))
+
+
+@op("Cast")
+def _cast(jnp, attrs, x):
+    return x.astype(proto._DTYPES[attrs["to"]])
+
+
+@op("Constant")
+def _constant(jnp, attrs):
+    if "value" in attrs:
+        return attrs["value"]
+    for k in ("value_float", "value_int"):
+        if k in attrs:
+            return np.asarray(attrs[k])
+    if "value_floats" in attrs:
+        return np.asarray(attrs["value_floats"], np.float32)
+    if "value_ints" in attrs:
+        return np.asarray(attrs["value_ints"], np.int64)
+    raise ValueError("Constant node without value")
+
+
+@op("ConstantOfShape")
+def _constant_of_shape(jnp, attrs, shape):
+    dims = _np_static(shape, "ConstantOfShape dims").tolist()
+    fill = attrs.get("value")
+    if fill is None:
+        return np.zeros(dims, np.float32)
+    return np.full(dims, fill.reshape(-1)[0], fill.dtype)
+
+
+@op("Range")
+def _range(jnp, attrs, start, limit, delta):
+    return np.arange(
+        _np_static(start, "Range").item(),
+        _np_static(limit, "Range").item(),
+        _np_static(delta, "Range").item(),
+    )
+
+
+@op("Expand")
+def _expand(jnp, attrs, x, shape):
+    dims = [int(v) for v in _np_static(shape, "Expand shape").tolist()]
+    # ONNX Expand broadcasts; dim of 1 in target keeps input dim
+    out_shape = list(np.broadcast_shapes(tuple(x.shape), tuple(dims)))
+    return jnp.broadcast_to(x, out_shape)
+
+
+@op("Tile")
+def _tile(jnp, attrs, x, reps):
+    return jnp.tile(x, [int(v) for v in _np_static(reps, "Tile reps").tolist()])
+
+
+@op("Pad")
+def _pad(jnp, attrs, x, pads=None, value=None):
+    mode = attrs.get("mode", "constant")
+    p = attrs.get("pads")
+    if p is None:
+        p = _np_static(pads, "Pad pads").tolist()
+    n = x.ndim
+    pairs = [(int(p[i]), int(p[i + n])) for i in range(n)]
+    cval = 0.0
+    if value is not None:
+        cval = float(_np_static(value, "Pad value").reshape(-1)[0])
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=cval)
+    return jnp.pad(x, pairs, mode={"reflect": "reflect", "edge": "edge"}[mode])
+
+
+# --- reductions ------------------------------------------------------------
+
+def _reduce(jnp_fn_name):
+    def fn(jnp, attrs, x, axes_in=None):
+        axes = attrs.get("axes")
+        if axes is None and axes_in is not None:
+            axes = _np_static(axes_in, "Reduce axes").tolist()
+        axes = tuple(int(a) for a in axes) if axes else None
+        keep = bool(attrs.get("keepdims", 1))
+        return getattr(jnp, jnp_fn_name)(x, axis=axes, keepdims=keep)
+    return fn
+
+
+op("ReduceMean")(_reduce("mean"))
+op("ReduceSum")(_reduce("sum"))
+op("ReduceMax")(_reduce("max"))
+op("ReduceMin")(_reduce("min"))
+
+
+@op("ArgMax")
+def _argmax(jnp, attrs, x):
+    axis = attrs.get("axis", 0)
+    out = jnp.argmax(x, axis=axis)
+    if attrs.get("keepdims", 1):
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+# --- linear algebra --------------------------------------------------------
+
+@op("MatMul")
+def _matmul(jnp, attrs, a, b):
+    return jnp.matmul(a, b, precision=_jax().lax.Precision.HIGHEST)
+
+
+@op("Gemm")
+def _gemm(jnp, attrs, a, b, c=None):
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    if attrs.get("transA"):
+        a = a.T
+    if attrs.get("transB"):
+        b = b.T
+    out = alpha * jnp.matmul(a, b, precision=_jax().lax.Precision.HIGHEST)
+    if c is not None:
+        out = out + beta * c
+    return out
+
+
+# --- convolution / pooling -------------------------------------------------
+
+def _conv_pads(attrs, x_shape, k_shape, strides, dilations):
+    """Resolve ONNX pads/auto_pad to lax ((lo, hi), ...) per spatial dim."""
+    spatial = len(k_shape)
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("NOTSET", ""):
+        p = attrs.get("pads", [0] * (2 * spatial))
+        return [(int(p[i]), int(p[i + spatial])) for i in range(spatial)]
+    if auto == "VALID":
+        return [(0, 0)] * spatial
+    pairs = []
+    for i in range(spatial):
+        in_dim = x_shape[2 + i]
+        eff_k = (k_shape[i] - 1) * dilations[i] + 1
+        out_dim = math.ceil(in_dim / strides[i])
+        total = max(0, (out_dim - 1) * strides[i] + eff_k - in_dim)
+        lo = total // 2
+        hi = total - lo
+        if auto == "SAME_UPPER":
+            pairs.append((lo, hi))
+        else:  # SAME_LOWER
+            pairs.append((hi, lo))
+    return pairs
+
+
+@op("Conv")
+def _conv(jnp, attrs, x, w, b=None):
+    import jax
+
+    spatial = w.ndim - 2
+    strides = attrs.get("strides", [1] * spatial)
+    dilations = attrs.get("dilations", [1] * spatial)
+    groups = attrs.get("group", 1)
+    pads = _conv_pads(attrs, x.shape, w.shape[2:], strides, dilations)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCW", "OIW", "NCW"),
+    )
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups, precision=jax.lax.Precision.HIGHEST,
+    )
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+@op("ConvTranspose")
+def _conv_transpose(jnp, attrs, x, w, b=None):
+    import jax
+
+    spatial = w.ndim - 2
+    strides = attrs.get("strides", [1] * spatial)
+    pads = attrs.get("pads", [0] * (2 * spatial))
+    pairs = [(int(pads[i]), int(pads[i + spatial])) for i in range(spatial)]
+    # ONNX ConvTranspose weight is (C_in, C_out/groups, kH, kW)
+    out = jax.lax.conv_transpose(
+        x, jnp.transpose(w, (1, 0) + tuple(range(2, w.ndim))),
+        strides=strides, precision=jax.lax.Precision.HIGHEST,
+        padding=[(k - 1 - lo, k - 1 - hi)
+                 for (lo, hi), k in zip(pairs, w.shape[2:])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW") if spatial == 2 else None,
+        transpose_kernel=True,
+    )
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+def _pool(jnp, attrs, x, reducer, init, is_avg=False):
+    import jax
+
+    kernel = attrs["kernel_shape"]
+    spatial = len(kernel)
+    strides = attrs.get("strides", [1] * spatial)
+    dilations = attrs.get("dilations", [1] * spatial)
+    pads = _conv_pads(attrs, x.shape, kernel, strides, dilations)
+    if attrs.get("ceil_mode"):
+        # grow the high pad so the last partial window is included
+        new_pads = []
+        for i in range(spatial):
+            in_dim = x.shape[2 + i] + pads[i][0] + pads[i][1]
+            eff_k = (kernel[i] - 1) * dilations[i] + 1
+            rem = (in_dim - eff_k) % strides[i]
+            extra = (strides[i] - rem) % strides[i] if rem else 0
+            new_pads.append((pads[i][0], pads[i][1] + extra))
+        pads = new_pads
+    window = (1, 1) + tuple(kernel)
+    win_strides = (1, 1) + tuple(strides)
+    win_dil = (1, 1) + tuple(dilations)
+    full_pads = [(0, 0), (0, 0)] + pads
+    out = jax.lax.reduce_window(
+        x, init, reducer, window, win_strides, full_pads,
+        window_dilation=win_dil,
+    )
+    if is_avg:
+        if attrs.get("count_include_pad"):
+            out = out / float(np.prod(kernel))
+        else:
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, window, win_strides, full_pads,
+                window_dilation=win_dil,
+            )
+            out = out / counts
+    return out
+
+
+@op("MaxPool")
+def _max_pool(jnp, attrs, x):
+    import jax
+
+    return _pool(jnp, attrs, x, jax.lax.max, -jnp.inf)
+
+
+@op("AveragePool")
+def _avg_pool(jnp, attrs, x):
+    import jax
+
+    return _pool(jnp, attrs, x, jax.lax.add, 0.0, is_avg=True)
+
+
+@op("GlobalAveragePool")
+def _global_avg_pool(jnp, attrs, x):
+    return jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@op("GlobalMaxPool")
+def _global_max_pool(jnp, attrs, x):
+    return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@op("BatchNormalization")
+def _batch_norm(jnp, attrs, x, scale, bias, mean, var):
+    eps = attrs.get("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = 1.0 / jnp.sqrt(var + eps)
+    return (x - mean.reshape(shape)) * (scale * inv).reshape(shape) + \
+        bias.reshape(shape)
+
+
+@op("InstanceNormalization")
+def _instance_norm(jnp, attrs, x, scale, bias):
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean) / jnp.sqrt(var + eps) * scale.reshape(shape) + \
+        bias.reshape(shape)
+
+
+@op("LayerNormalization")
+def _layer_norm(jnp, attrs, x, scale, bias=None):
+    eps = attrs.get("epsilon", 1e-5)
+    axis = attrs.get("axis", -1)
+    axes = tuple(range(axis % x.ndim, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + eps) * scale
+    return out + bias if bias is not None else out
+
+
+@op("Resize")
+def _resize(jnp, attrs, x, roi=None, scales=None, sizes=None):
+    import jax
+
+    mode = attrs.get("mode", "nearest")
+    if sizes is not None:
+        out_spatial = [int(v) for v in
+                       _np_static(sizes, "Resize sizes").tolist()][2:]
+    else:
+        sc = _np_static(scales, "Resize scales").tolist()
+        out_spatial = [int(round(x.shape[2 + i] * sc[2 + i]))
+                       for i in range(x.ndim - 2)]
+    out_shape = tuple(x.shape[:2]) + tuple(out_spatial)
+    method = {"nearest": "nearest", "linear": "bilinear",
+              "cubic": "bicubic"}[mode]
+    return jax.image.resize(x, out_shape, method=method)
+
+
+@op("Upsample")
+def _upsample(jnp, attrs, x, scales=None):
+    sc = attrs.get("scales") or _np_static(scales, "Upsample scales").tolist()
+    fake_attrs = {"mode": attrs.get("mode", "nearest")}
+    return _resize(jnp, fake_attrs, x, None, np.asarray(sc, np.float32), None)
+
+
+@op("Where")
+def _where(jnp, attrs, cond, a, b):
+    return jnp.where(cond, a, b)
+
+
+@op("Equal")
+def _equal(jnp, attrs, a, b):
+    return a == b
+
+
+@op("Greater")
+def _greater(jnp, attrs, a, b):
+    return a > b
+
+
+@op("Less")
+def _less(jnp, attrs, a, b):
+    return a < b
+
+
+@op("Dropout")
+def _dropout(jnp, attrs, x, *rest):
+    return x  # inference mode
+
+
+# --- the model object ------------------------------------------------------
+
+
+class OnnxModel:
+    """A decoded ONNX graph, executable as a pure JAX function.
+
+    `inputs`/`outputs` are the graph's I/O names (initializers
+    excluded); `__call__` takes arrays in input order and returns the
+    list of outputs. Wrap in `jax.jit` for compiled execution.
+    """
+
+    def __init__(self, model: dict[str, Any]):
+        self.model = model
+        graph = model["graph"]
+        self.graph = graph
+        self.initializers = {
+            t["name"]: proto.tensor_to_array(t)
+            for t in graph.get("initializer", [])
+        }
+        self.inputs = [
+            vi["name"] for vi in graph.get("input", [])
+            if vi["name"] not in self.initializers
+        ]
+        self.outputs = [vi["name"] for vi in graph.get("output", [])]
+        self.nodes = graph.get("node", [])
+        unsupported = sorted({
+            n["op_type"] for n in self.nodes if n["op_type"] not in _OPS
+        })
+        if unsupported:
+            raise NotImplementedError(
+                f"unsupported ONNX ops: {', '.join(unsupported)}"
+            )
+
+    def input_shapes(self) -> dict[str, tuple[int, ...]]:
+        shapes = {}
+        for vi in self.graph.get("input", []):
+            if vi["name"] in self.initializers:
+                continue
+            dims = vi.get("type", {}).get("tensor_type", {}) \
+                .get("shape", {}).get("dim", [])
+            shapes[vi["name"]] = tuple(
+                int(d.get("dim_value", -1)) if "dim_value" in d else -1
+                for d in dims
+            )
+        return shapes
+
+    def __call__(self, *args: Any) -> list[Any]:
+        import jax.numpy as jnp
+
+        if len(args) != len(self.inputs):
+            raise ValueError(
+                f"expected {len(self.inputs)} inputs {self.inputs}, "
+                f"got {len(args)}"
+            )
+        env = _Env(self.initializers)
+        env.update(zip(self.inputs, args))
+        for node in self.nodes:
+            op_type = node["op_type"]
+            fn = _OPS[op_type]
+            ins = env.fetch(node["input"])
+            host = op_type not in _DEVICE_ONLY and all(_is_host(i) for i in ins)
+            outs = fn(np if host else jnp, _attrs(node), *ins)
+            out_names = node["output"]
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for name, val in zip(out_names, outs):
+                if name:
+                    env[name] = val
+        return [env[n] for n in self.outputs]
+
+
+def load(path_or_bytes: str | bytes) -> OnnxModel:
+    """Load an `.onnx` file (or raw bytes) into an executable OnnxModel."""
+    if isinstance(path_or_bytes, bytes):
+        data = path_or_bytes
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    return OnnxModel(proto.decode_model(data))

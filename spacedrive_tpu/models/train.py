@@ -1,0 +1,256 @@
+"""Labeler training — produce a real checkpoint for the labeler actor.
+
+The reference ships inference-only (it downloads pretrained YOLOv8,
+ref:crates/ai/src/image_labeler/model/yolov8.rs:37-41); in an offline
+deployment that download never happens and labeling stays off. This
+module is the TPU-native framework's way to make the capability real
+without a download: train (or fine-tune) LabelerNet on a labeled image
+folder and save a checkpoint the actor loads.
+
+Dataset layout: `root/<class_name>/*.jpg|png|…` — one folder per class
+(multi-label rows can repeat an image under several folders; dedup by
+cas would be overkill here). `sdx labeler train <root>` wires this up.
+
+The training step itself is `labeler.train_step`, jit/pjit-able over a
+device mesh (dp batch sharding + fsdp/tp param sharding, see
+`labeler.param_shardings`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+from . import checkpoint
+from . import labeler as labeler_model
+
+logger = logging.getLogger(__name__)
+
+IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".webp", ".bmp", ".gif", ".tif", ".tiff")
+
+
+@dataclass
+class TrainConfig:
+    image_size: int = 96
+    widths: tuple[int, ...] = (16, 32, 64, 128, 128)
+    depths: tuple[int, ...] = (1, 1, 1, 1)
+    batch_size: int = 32
+    steps: int = 600
+    learning_rate: float = 1e-3
+    seed: int = 0
+    eval_fraction: float = 0.1
+    use_device: bool = True
+
+
+def scan_folder_dataset(root: str | os.PathLike) -> tuple[list[tuple[str, int]], list[str]]:
+    """folder-per-class layout → ([(path, class_idx)], class_names)."""
+    root = os.fspath(root)
+    classes = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d)) and not d.startswith(".")
+    )
+    if not classes:
+        raise ValueError(f"{root}: no class folders found")
+    samples: list[tuple[str, int]] = []
+    for idx, name in enumerate(classes):
+        cdir = os.path.join(root, name)
+        for fn in sorted(os.listdir(cdir)):
+            if fn.lower().endswith(IMAGE_EXTS):
+                samples.append((os.path.join(cdir, fn), idx))
+    if not samples:
+        raise ValueError(f"{root}: class folders contain no images")
+    return samples, classes
+
+
+def _decode(path: str, image_size: int) -> np.ndarray | None:
+    from PIL import Image
+
+    try:
+        with Image.open(path) as img:
+            img = img.convert("RGB").resize((image_size, image_size))
+            return np.asarray(img, np.float32) / 255.0
+    except Exception:
+        logger.warning("train: failed to decode %s", path)
+        return None
+
+
+def _folder_batches(
+    samples: list[tuple[str, int]], n_classes: int, cfg: TrainConfig,
+    rng: np.random.Generator,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Infinite shuffled FIXED-SHAPE batch stream from disk.
+
+    Every yielded batch has exactly `bs` rows (failed decodes are
+    backfilled by repeating rows) so the jitted train step compiles
+    once — ragged batches would recompile per distinct shape, which on
+    a tunneled TPU costs more than the step itself. Decoded images are
+    cached as uint8 under a ~512 MB budget; beyond that, re-decode.
+    """
+    bs = min(cfg.batch_size, len(samples))
+    cache: dict[str, np.ndarray | None] = {}
+    cache_cap = max(1, (512 << 20) // (cfg.image_size * cfg.image_size * 3))
+
+    def fetch(path: str) -> np.ndarray | None:
+        if path in cache:
+            hit = cache[path]
+            return None if hit is None else hit.astype(np.float32) / 255.0
+        arr = _decode(path, cfg.image_size)
+        if len(cache) < cache_cap:
+            cache[path] = None if arr is None else (
+                (arr * 255.0).astype(np.uint8)
+            )
+        return arr
+
+    while True:
+        order = rng.permutation(len(samples))
+        for off in range(0, max(1, len(order) - bs + 1), bs):
+            idxs = order[off:off + bs]
+            images, labels = [], []
+            for i in idxs:
+                path, cls = samples[i]
+                arr = fetch(path)
+                if arr is None:
+                    continue
+                images.append(arr)
+                row = np.zeros(n_classes, np.float32)
+                row[cls] = 1.0
+                labels.append(row)
+            if not images:
+                continue
+            while len(images) < bs:  # backfill to a fixed shape
+                j = len(images) % len(labels)
+                images.append(images[j])
+                labels.append(labels[j])
+            yield np.stack(images), np.stack(labels)
+
+
+def train(
+    batches: Iterator[tuple[np.ndarray, np.ndarray]],
+    classes: Sequence[str],
+    cfg: TrainConfig,
+    *,
+    eval_set: tuple[np.ndarray, np.ndarray] | None = None,
+    progress: Callable[[int, float], None] | None = None,
+) -> tuple[Any, labeler_model.LabelerNet, dict[str, float]]:
+    """Run `cfg.steps` optimizer steps; returns (params, model, metrics)."""
+    import jax
+
+    model = labeler_model.LabelerNet(
+        num_classes=len(classes), widths=cfg.widths, depths=cfg.depths
+    )
+    device = None
+    if not cfg.use_device:
+        device = jax.devices("cpu")[0]
+    with jax.default_device(device) if device else _nullcontext():
+        params, opt_state, tx = labeler_model.create_train_state(
+            jax.random.key(cfg.seed), image_size=cfg.image_size,
+            learning_rate=cfg.learning_rate, model=model,
+        )
+        step_fn = jax.jit(
+            lambda p, o, x, y: labeler_model.train_step(model, tx, p, o, x, y)
+        )
+        loss = float("nan")
+        for step in range(cfg.steps):
+            images, labels = next(batches)
+            params, opt_state, loss = step_fn(params, opt_state, images, labels)
+            if progress and (step % 20 == 0 or step == cfg.steps - 1):
+                progress(step, float(loss))
+        metrics: dict[str, float] = {"final_loss": float(loss)}
+        if eval_set is not None:
+            images, labels = eval_set
+            probs = np.asarray(
+                jax.nn.sigmoid(model.apply({"params": params}, images))
+            )
+            top1 = (probs.argmax(1) == labels.argmax(1)).mean()
+            metrics["eval_top1"] = float(top1)
+    return params, model, metrics
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def train_folder(
+    root: str | os.PathLike, out_path: str | os.PathLike,
+    cfg: TrainConfig | None = None,
+    progress: Callable[[int, float], None] | None = None,
+) -> dict[str, float]:
+    """Train on a folder-per-class dataset and save the checkpoint."""
+    cfg = cfg or TrainConfig()
+    samples, classes = scan_folder_dataset(root)
+    rng = np.random.default_rng(cfg.seed)
+    order = rng.permutation(len(samples))
+    n_eval = max(1, int(len(samples) * cfg.eval_fraction))
+    eval_samples = [samples[i] for i in order[:n_eval]]
+    train_samples = [samples[i] for i in order[n_eval:]]
+    if not train_samples:
+        raise ValueError("dataset too small to split")
+    eval_imgs, eval_rows = [], []
+    for path, cls in eval_samples:
+        arr = _decode(path, cfg.image_size)
+        if arr is None:
+            continue
+        eval_imgs.append(arr)
+        row = np.zeros(len(classes), np.float32)
+        row[cls] = 1.0
+        eval_rows.append(row)
+    eval_set = (
+        (np.stack(eval_imgs), np.stack(eval_rows)) if eval_imgs else None
+    )
+    batches = _folder_batches(train_samples, len(classes), cfg, rng)
+    params, _model, metrics = train(
+        batches, classes, cfg, eval_set=eval_set, progress=progress
+    )
+    checkpoint.save(
+        out_path, params, classes=list(classes), image_size=cfg.image_size,
+        widths=cfg.widths, depths=cfg.depths,
+        extra={"metrics": metrics, "trained_on": os.fspath(root)},
+    )
+    return metrics
+
+
+def digits_demo_dataset(image_size: int = 32) -> tuple[
+    tuple[np.ndarray, np.ndarray], tuple[np.ndarray, np.ndarray], list[str]
+]:
+    """Bundled real dataset (sklearn digits, 1,797 8×8 scans) for the
+    self-contained train demo + tests: returns (train, eval, classes)."""
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    imgs = digits.images.astype(np.float32) / 16.0  # [N, 8, 8] in [0,1]
+    n = imgs.shape[0]
+    # upscale 8→image_size (nearest) and tile to 3 channels
+    reps = image_size // 8
+    big = np.repeat(np.repeat(imgs, reps, axis=1), reps, axis=2)
+    rgb = np.repeat(big[..., None], 3, axis=-1)
+    labels = np.zeros((n, 10), np.float32)
+    labels[np.arange(n), digits.target] = 1.0
+    rng = np.random.default_rng(0)
+    order = rng.permutation(n)
+    split = int(n * 0.9)
+    tr, ev = order[:split], order[split:]
+    classes = [f"digit {d}" for d in range(10)]
+    return (rgb[tr], labels[tr]), (rgb[ev], labels[ev]), classes
+
+
+def array_batches(
+    images: np.ndarray, labels: np.ndarray, batch_size: int, seed: int = 0
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    rng = np.random.default_rng(seed)
+    n = images.shape[0]
+    if n == 0:
+        raise ValueError("empty dataset")
+    batch_size = min(batch_size, n)
+    while True:
+        order = rng.permutation(n)
+        for off in range(0, n - batch_size + 1, batch_size):
+            idx = order[off:off + batch_size]
+            yield images[idx], labels[idx]

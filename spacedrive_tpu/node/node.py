@@ -72,8 +72,13 @@ class Node:
                 os.path.join(self.data_dir, "image_labeler"),
                 use_device=use_device,
             )
-            if self.config.config.image_labeler_version != "labeler-net-v1":
-                self.config.update(image_labeler_version="labeler-net-v1")
+            # version string tracks the provisioned artifact, mirroring
+            # the reference's image_labeler_version (node/config.rs) —
+            # "none" means no weights yet, labeling is off
+            artifact = self.image_labeler.resolve_artifact()
+            version = f"{artifact[0]}:{os.path.basename(artifact[1])}" if artifact else "none"
+            if self.config.config.image_labeler_version != version:
+                self.config.update(image_labeler_version=version)
         self.p2p: Any = None  # P2PManager, attached by start() when enabled
         self.http: Any = None  # ApiServer handle from start_api()
         from ..api.namespaces import mount

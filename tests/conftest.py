@@ -34,3 +34,7 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test (built-in runner)")
+    config.addinivalue_line(
+        "markers", "slow: long-running (training / full device-shape matrix); "
+        "deselected by default, run with -m slow"
+    )

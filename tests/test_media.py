@@ -100,6 +100,30 @@ def test_video_thumbnail_via_cv2(tmp_path):
 # --- labeler actor --------------------------------------------------------
 
 
+def _provision_ckpt(labeler_dir, image_size=64):
+    """Write a small (untrained but provisioned) checkpoint artifact:
+    the actor's gate is artifact presence, matching the reference's
+    downloaded-model gate (ref:crates/ai yolov8.rs:45-88). Pipeline
+    tests run with threshold=0.0 so emitted labels don't depend on
+    the weights being meaningful."""
+    import jax
+
+    from spacedrive_tpu.models import checkpoint
+    from spacedrive_tpu.models import labeler as labeler_model
+
+    widths, depths = (8, 8, 8, 8, 8), (1, 1, 1, 1)
+    model = labeler_model.LabelerNet(num_classes=4, widths=widths, depths=depths)
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = labeler_model.init_params(
+            jax.random.key(0), image_size=image_size, model=model
+        )
+    checkpoint.save(
+        os.path.join(labeler_dir, "weights.npz"), params,
+        classes=["cat", "dog", "car", "tree"],
+        image_size=image_size, widths=widths, depths=depths,
+    )
+
+
 def test_labeler_actor_writes_labels(tmp_path):
     async def run():
         from spacedrive_tpu.db.database import LibraryDb
@@ -113,9 +137,10 @@ def test_labeler_actor_writes_labels(tmp_path):
         oid = lib.db.insert("object", pub_id=os.urandom(16), kind=5)
         img = tmp_path / "cat.jpg"
         _jpeg(img, size=(64, 64))
+        _provision_ckpt(str(tmp_path / "labeler"))
         labeler = ImageLabeler(
             str(tmp_path / "labeler"), use_device=False, image_size=64,
-            threshold=0.0,  # untrained net: accept everything → labels exist
+            threshold=0.0,  # accept everything → labels exist
         )
         batch_id = labeler.new_batch(
             lib, [{"file_path_id": 1, "object_id": oid, "path": str(img)}]
@@ -144,6 +169,7 @@ def test_labeler_resume_file(tmp_path):
         img = tmp_path / "dog.jpg"
         _jpeg(img, size=(64, 64))
         data_dir = str(tmp_path / "labeler")
+        _provision_ckpt(data_dir)
 
         # queue a batch but never start an event loop worker for it:
         # shutdown persists it to to_resume_batches.bin
@@ -185,7 +211,8 @@ def test_media_job_labels_end_to_end(tmp_path):
             _jpeg(corpus / f"photo{i}.jpg", size=(100, 80), color=(i * 50, 90, 120))
         node = Node(str(tmp_path / "node"), use_device=False)
         node.config.config.p2p.enabled = False
-        node.image_labeler.threshold = 0.0  # untrained net emits all classes
+        _provision_ckpt(node.image_labeler.data_dir)
+        node.image_labeler.threshold = 0.0  # emit all classes
         node.image_labeler.image_size = 64
         await node.start()
         lib = await node.create_library("pics")
