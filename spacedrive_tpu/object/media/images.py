@@ -24,7 +24,7 @@ SVG_RENDER_SIZE = 512  # ref:consts.rs:33 (SVG render cap 512²)
 PDF_RENDER_WIDTH = 1024  # ref:consts.rs:39
 
 HEIF_EXTENSIONS = {"heif", "heifs", "heic", "heics", "avif", "avci", "avcs"}
-SVG_EXTENSIONS = {"svg"}
+SVG_EXTENSIONS = {"svg", "svgz"}
 PDF_EXTENSIONS = {"pdf"}
 
 
@@ -161,20 +161,60 @@ def decode_generic(path: str) -> np.ndarray:
         return np.asarray(im.convert("RGBA"))
 
 
+def decode_svg(path: str) -> np.ndarray:
+    """SVG/SVGZ via librsvg (ref:handler.rs SVG → resvg). Gzip payloads
+    are expanded under the same size cap as the on-disk file."""
+    from . import svg as svg_mod
+
+    if not svg_mod.svg_available():
+        raise UnsupportedImage(
+            "no SVG rasterizer (librsvg unavailable; reference: resvg)"
+        )
+    with open(path, "rb") as f:
+        data = f.read(MAXIMUM_FILE_SIZE + 1)
+    if len(data) > MAXIMUM_FILE_SIZE:
+        raise ImageHandlerError(f"file over {MAXIMUM_FILE_SIZE} bytes")
+    if data[:2] == b"\x1f\x8b":  # svgz
+        import gzip
+        import io as _io
+
+        try:
+            with gzip.GzipFile(fileobj=_io.BytesIO(data)) as gz:
+                data = gz.read(MAXIMUM_FILE_SIZE + 1)
+        except Exception as exc:
+            raise ImageHandlerError(f"svgz decompress failed: {exc}") from exc
+        if len(data) > MAXIMUM_FILE_SIZE:
+            raise ImageHandlerError("svgz expands past the size cap")
+    try:
+        return svg_mod.render_svg(data)
+    except ImageHandlerError:
+        raise
+    except Exception as exc:
+        raise ImageHandlerError(f"svg render failed: {exc}") from exc
+
+
+def decode_pdf(path: str) -> np.ndarray:
+    """PDF first page (ref:handler.rs PDF → pdfium) via ../pdf.py."""
+    from . import pdf as pdf_mod
+
+    try:
+        return pdf_mod.render_pdf(path)
+    except ImageHandlerError:
+        raise
+    except Exception as exc:
+        raise ImageHandlerError(f"pdf render failed: {exc}") from exc
+
+
 def format_image(path: str, extension: str | None = None) -> np.ndarray:
-    """Decode any supported still image to RGBA uint8
-    (ref:handler.rs:18-60 `format_image`)."""
+    """Decode any supported still image/document to RGBA uint8
+    (ref:handler.rs:18-60 `format_image` — the single dispatch)."""
     if os.path.getsize(path) > MAXIMUM_FILE_SIZE:
         raise ImageHandlerError(f"file over {MAXIMUM_FILE_SIZE} bytes")
     ext = (extension or os.path.splitext(path)[1].lstrip(".")).lower()
     if ext in HEIF_EXTENSIONS:
         return decode_heif(path)
     if ext in SVG_EXTENSIONS:
-        raise UnsupportedImage(
-            "no SVG rasterizer in this image (reference: resvg)"
-        )
+        return decode_svg(path)
     if ext in PDF_EXTENSIONS:
-        raise UnsupportedImage(
-            "no PDF renderer in this image (reference: pdfium)"
-        )
+        return decode_pdf(path)
     return decode_generic(path)

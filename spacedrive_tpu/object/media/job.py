@@ -28,9 +28,15 @@ BATCH_SIZE = 10  # ref:media_processor/job.rs:50
 # extensions we can thumbnail / extract exif from (decodable subset of
 # the reference's FILTERED_{IMAGE,VIDEO}_EXTENSIONS; videos get a
 # keyframe thumb, ref:media_processor/job.rs + thumbnail/process.rs:463)
-from .thumbnail.process import IMAGE_EXTENSIONS, VIDEO_EXTENSIONS
+from .thumbnail.process import (
+    DOC_EXTENSIONS,
+    IMAGE_EXTENSIONS,
+    VIDEO_EXTENSIONS,
+)
 
-THUMBNAILABLE_EXTENSIONS = tuple(IMAGE_EXTENSIONS) + tuple(VIDEO_EXTENSIONS)
+THUMBNAILABLE_EXTENSIONS = (
+    tuple(IMAGE_EXTENSIONS) + tuple(VIDEO_EXTENSIONS) + tuple(DOC_EXTENSIONS)
+)
 EXIF_EXTENSIONS = ("jpg", "jpeg", "png", "tiff", "webp")
 # media_data rows extract for EXIF-bearing images AND videos
 # (ref:media_data_extractor.rs images; video facts via the decoder)

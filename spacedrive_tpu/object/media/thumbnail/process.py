@@ -56,6 +56,7 @@ _CV2_DECODABLE = {
     "wmv", "flv", "3gp", "ogv", "mts", "m2ts", "m2v", "ts", "vob", "qt",
 }
 from ..images import HEIF_EXTENSIONS, format_image, heif_available
+from ..svg import svg_available
 
 IMAGE_EXTENSIONS = tuple(
     e for e in _all_extensions("Image") if e in _PIL_DECODABLE
@@ -64,6 +65,16 @@ IMAGE_EXTENSIONS = tuple(
 VIDEO_EXTENSIONS = tuple(
     e for e in _all_extensions("Video") if e in _CV2_DECODABLE
 )
+# Document/vector formats (ref:crates/images/src/handler.rs:18-60 fans
+# out to resvg + pdfium; here: librsvg via ctypes + the bundled PDF
+# reader in ../pdf.py). The extension sets live in ..images — the
+# single dispatch — gated here by renderer availability.
+from ..images import PDF_EXTENSIONS as _PDF_EXTS
+from ..images import SVG_EXTENSIONS as _SVG_EXTS
+
+SVG_EXTENSIONS = tuple(sorted(_SVG_EXTS)) if svg_available() else ()
+PDF_EXTENSIONS = tuple(sorted(_PDF_EXTS))
+DOC_EXTENSIONS = PDF_EXTENSIONS + SVG_EXTENSIONS
 VIDEO_SEEK_FRACTION = 0.1  # ref:movie_decoder.rs seeks ~10% in
 
 
@@ -82,7 +93,8 @@ class Decoded:
 
 def can_generate(extension: str | None) -> bool:
     e = (extension or "").lower()
-    return e in IMAGE_EXTENSIONS or e in VIDEO_EXTENSIONS
+    return e in IMAGE_EXTENSIONS or e in VIDEO_EXTENSIONS or \
+        e in DOC_EXTENSIONS
 
 
 def is_video(extension: str | None) -> bool:
@@ -170,11 +182,29 @@ def decode_heif_image(path: str, extension: str) -> Decoded:
     return Decoded(array=arr, target=(th, tw))
 
 
+def decode_document(path: str, extension: str) -> Decoded:
+    """SVG (ref:svg.rs:14-21, render cap 512²) and PDF first page
+    (ref:pdf.rs:82-83) through the format_image dispatch; every
+    failure becomes ThumbError so one bad document never aborts the
+    surrounding batch."""
+    try:
+        arr = format_image(path, extension)
+    except Exception as exc:
+        raise ThumbError(f"document decode failed ({path}): {exc}")
+    arr = shrink_to_max_dim(arr)
+    h, w = arr.shape[:2]
+    tw, th = tj.scale_dimensions(w, h)
+    return Decoded(array=arr, target=(th, tw))
+
+
 def decode(path: str, extension: str | None) -> Decoded:
+    ext = (extension or "").lower()
     if is_video(extension):
         return decode_video_frame(path)
-    if (extension or "").lower() in HEIF_EXTENSIONS:
+    if ext in HEIF_EXTENSIONS:
         return decode_heif_image(path, extension)
+    if ext in SVG_EXTENSIONS or ext in PDF_EXTENSIONS:
+        return decode_document(path, ext)
     return decode_image(path)
 
 

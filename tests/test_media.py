@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from spacedrive_tpu.object.media.images import (
-    UnsupportedImage,
+
     format_image,
     heif_available,
 )
@@ -36,12 +36,22 @@ def test_format_image_generic(tmp_path):
     assert arr[0, 0, 0] > 150  # red-ish
 
 
-def test_format_image_svg_pdf_gated(tmp_path):
-    (tmp_path / "x.svg").write_text("<svg/>")
-    with pytest.raises(UnsupportedImage):
-        format_image(str(tmp_path / "x.svg"))
-    (tmp_path / "x.pdf").write_bytes(b"%PDF-1.4")
-    with pytest.raises(UnsupportedImage):
+def test_format_image_dispatches_svg_pdf(tmp_path):
+    """SVG/PDF route through the single format_image dispatch (no
+    longer gated out; ref:handler.rs:18-60). Undecodable payloads fail
+    with the handler error, not an arbitrary exception."""
+    from spacedrive_tpu.object.media.images import ImageHandlerError
+    from spacedrive_tpu.object.media.svg import svg_available
+
+    if svg_available():
+        (tmp_path / "x.svg").write_text(
+            '<svg xmlns="http://www.w3.org/2000/svg" width="10" height="10">'
+            '<rect width="10" height="10" fill="blue"/></svg>'
+        )
+        arr = format_image(str(tmp_path / "x.svg"))
+        assert arr.shape[-1] == 4 and arr.shape[0] > 0
+    (tmp_path / "x.pdf").write_bytes(b"%PDF-1.4")  # no page tree
+    with pytest.raises(ImageHandlerError):
         format_image(str(tmp_path / "x.pdf"))
 
 
