@@ -22,17 +22,35 @@ _LIB: ctypes.CDLL | None = None
 _LOAD_FAILED = False
 
 
-def _build(src: str, out: str) -> bool:
+def _build(src: str, out: str, extra_args: tuple[str, ...] = ()) -> bool:
+    """Compile one source into a shared object, caching failure in a
+    sentinel file so fresh processes don't retry a known-bad build."""
+    sentinel = out + ".build_failed"
+    try:
+        src_mtime = os.path.getmtime(src)
+        if os.path.exists(sentinel) and \
+                os.path.getmtime(sentinel) >= src_mtime:
+            return False
+    except OSError:
+        return False
     for cc in ("cc", "gcc", "g++", "clang"):
         try:
             r = subprocess.run(
-                [cc, "-O3", "-fPIC", "-shared", "-pthread", src, "-o", out],
+                [cc, "-O3", "-fPIC", "-shared", "-pthread", src, "-o", out]
+                + list(extra_args),
                 capture_output=True, timeout=120,
             )
             if r.returncode == 0:
+                if os.path.exists(sentinel):
+                    os.remove(sentinel)
                 return True
         except (OSError, subprocess.TimeoutExpired):
             continue
+    try:
+        with open(sentinel, "w") as f:
+            f.write("build failed; delete this file to retry\n")
+    except OSError:
+        pass
     return False
 
 
@@ -130,3 +148,116 @@ def blake3_many(messages: list[bytes], nthreads: int | None = None) -> list[byte
     )
     raw = out.tobytes()
     return [raw[i * 32:(i + 1) * 32] for i in range(n)]
+
+
+# --- video decode frontend (FFmpeg FFI, ref:crates/ffmpeg) ----------------
+
+_VIDEO_LIB: ctypes.CDLL | None = None
+_VIDEO_FAILED = False
+_AV_LIBS = ("-lavformat", "-lavcodec", "-lavutil", "-lswscale", "-lm")
+
+
+def load_video() -> ctypes.CDLL | None:
+    """The native FFmpeg frontend (movie_decoder.c), building on first
+    use; None when libav headers/libraries are absent (callers fall
+    back to cv2)."""
+    global _VIDEO_LIB, _VIDEO_FAILED
+    if _VIDEO_LIB is not None or _VIDEO_FAILED:
+        return _VIDEO_LIB
+    with _LOCK:
+        if _VIDEO_LIB is not None or _VIDEO_FAILED:
+            return _VIDEO_LIB
+        so = os.path.join(_DIR, "_sdvideo.so")
+        src = os.path.join(_DIR, "movie_decoder.c")
+        try:
+            if not os.path.exists(so) or \
+                    os.path.getmtime(so) < os.path.getmtime(src):
+                if not _build(src, so, _AV_LIBS):
+                    _VIDEO_FAILED = True
+                    return None
+            lib = ctypes.CDLL(so)
+            lib.sd_video_frame.restype = ctypes.c_int
+            lib.sd_video_frame.argtypes = [
+                ctypes.c_char_p, ctypes.c_double,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.sd_video_meta.restype = ctypes.c_int
+            lib.sd_video_meta.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.sd_video_free.argtypes = [ctypes.c_void_p]
+            _VIDEO_LIB = lib
+        except OSError:
+            _VIDEO_FAILED = True
+    return _VIDEO_LIB
+
+
+def video_available() -> bool:
+    return load_video() is not None
+
+
+def video_frame(path: str, seek_fraction: float = 0.1):
+    """(rgba HxWx4 uint8, rotation_degrees, is_cover) or None.
+
+    Preferred-stream selection with embedded-cover preference, ~10%
+    seek, display-matrix rotation (ref:movie_decoder.rs:32-629, cover
+    check :352)."""
+    lib = load_video()
+    if lib is None:
+        return None
+    buf = ctypes.c_void_p()
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    rot = ctypes.c_int()
+    cover = ctypes.c_int()
+    err = ctypes.create_string_buffer(256)
+    rc = lib.sd_video_frame(
+        os.fsencode(path), seek_fraction, ctypes.byref(buf),
+        ctypes.byref(w), ctypes.byref(h), ctypes.byref(rot),
+        ctypes.byref(cover), err, len(err),
+    )
+    if rc != 0:
+        raise ValueError(
+            f"video decode failed: {err.value.decode(errors='replace')}"
+        )
+    try:
+        n = w.value * h.value * 4
+        arr = np.frombuffer(
+            ctypes.string_at(buf.value, n), np.uint8
+        ).reshape(h.value, w.value, 4).copy()
+    finally:
+        lib.sd_video_free(buf)
+    return arr, rot.value, bool(cover.value)
+
+
+def video_meta(path: str):
+    """{duration_seconds, fps, width, height, frame_count, codec} or
+    None when the native frontend is unavailable; raises on bad files."""
+    lib = load_video()
+    if lib is None:
+        return None
+    dur = ctypes.c_double()
+    fps = ctypes.c_double()
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    frames = ctypes.c_int64()
+    codec = ctypes.create_string_buffer(64)
+    rc = lib.sd_video_meta(
+        os.fsencode(path), ctypes.byref(dur), ctypes.byref(fps),
+        ctypes.byref(w), ctypes.byref(h), ctypes.byref(frames),
+        codec, len(codec),
+    )
+    if rc != 0:
+        raise ValueError(f"video probe failed: {path}")
+    return {
+        "duration_seconds": dur.value, "fps": fps.value,
+        "width": w.value, "height": h.value,
+        "frame_count": int(frames.value),
+        "codec": codec.value.decode(errors="replace"),
+    }

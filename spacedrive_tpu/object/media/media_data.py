@@ -149,6 +149,22 @@ class VideoMetadata:
 
     @classmethod
     def from_path(cls, path: str | os.PathLike) -> "VideoMetadata | None":
+        # native FFmpeg probe first (real codec names + container
+        # duration, ref:crates/ffmpeg); cv2 as fallback
+        try:
+            from ...native import video_meta
+
+            meta = video_meta(os.fspath(path))
+        except Exception:
+            meta = None
+        if meta is not None and meta["width"] and meta["height"]:
+            return cls(
+                resolution=(meta["width"], meta["height"]),
+                duration_seconds=meta["duration_seconds"] or None,
+                fps=meta["fps"] or None,
+                frame_count=meta["frame_count"] or None,
+                codec=meta["codec"] or None,
+            )
         try:
             import cv2
         except Exception:

@@ -62,9 +62,10 @@ IMAGE_EXTENSIONS = tuple(
     e for e in _all_extensions("Image") if e in _PIL_DECODABLE
 ) + (tuple(e for e in _all_extensions("Image") if e in HEIF_EXTENSIONS)
      if heif_available() else ())
-VIDEO_EXTENSIONS = tuple(
-    e for e in _all_extensions("Video") if e in _CV2_DECODABLE
-)
+# The native libav frontend (preferred, probed lazily at first decode
+# so imports never trigger a compile) handles the full video taxonomy;
+# exotic containers degrade to a per-file error on cv2-only hosts.
+VIDEO_EXTENSIONS = tuple(_all_extensions("Video"))
 # Document/vector formats (ref:crates/images/src/handler.rs:18-60 fans
 # out to resvg + pdfium; here: librsvg via ctypes + the bundled PDF
 # reader in ../pdf.py). The extension sets live in ..images — the
@@ -142,10 +143,32 @@ def needs_cpu_fallback(d: Decoded) -> bool:
 
 
 def decode_video_frame(path: str) -> Decoded:
-    """Grab one frame ~10% into the video (ref:movie_decoder.rs:32-629:
-    open → preferred stream → seek 10% → decode; rotation handled by
-    the decoder). Target dims bound the max dimension to 256
-    (ref:process.rs:470)."""
+    """Grab one frame ~10% into the video through the native FFmpeg
+    frontend (native/movie_decoder.c — preferred stream with
+    embedded-cover preference, ~10% seek, display-matrix rotation;
+    ref:movie_decoder.rs:32-629, cover check :352), with cv2 as the
+    fallback when libav isn't present. Target dims bound the max
+    dimension to 256 (ref:process.rs:470)."""
+    from ....native import video_available, video_frame
+
+    if video_available():
+        try:
+            arr, rotation, is_cover = video_frame(
+                path, seek_fraction=VIDEO_SEEK_FRACTION
+            )
+        except ValueError as exc:
+            raise ThumbError(str(exc))
+        if rotation % 360 and rotation % 90 == 0:
+            # display matrix says rotate clockwise by `rotation`; only
+            # right-angle rotations are meaningful for a raster thumb
+            arr = np.ascontiguousarray(
+                np.rot90(arr, k=(-rotation // 90) % 4)
+            )
+        arr = shrink_to_max_dim(arr)
+        h, w = arr.shape[:2]
+        tw, th = tj.video_dimensions(w, h)
+        # embedded cover art is album art, not footage: no film strip
+        return Decoded(array=arr, target=(th, tw), is_video=not is_cover)
     try:
         import cv2
     except Exception as e:  # pragma: no cover

@@ -41,7 +41,11 @@ def test_streaming_matches_oneshot():
         assert s.hexdigest() == ref.blake3_hex(d), n
 
 
-@pytest.mark.parametrize("bucket", [1, 4, 8])
+@pytest.mark.parametrize(
+    "bucket",
+    [1, pytest.param(4, marks=pytest.mark.slow),
+     pytest.param(8, marks=pytest.mark.slow)],
+)
 def test_jax_matches_reference_small_buckets(bucket):
     cap = bucket * 1024
     lens = sorted({0, 1, 63, 64, 65, cap // 2, cap - 1, cap, max(0, cap - 1024), 1023, 1024, 1025})
@@ -54,6 +58,7 @@ def test_jax_matches_reference_small_buckets(bucket):
         assert hexes[i] == ref.blake3_hex(DATA[:n]), f"len={n}"
 
 
+@pytest.mark.slow
 def test_jax_matches_reference_tree_shapes():
     # Chunk counts crossing every tree-shape regime in a 16-chunk bucket:
     # 1, po2, po2±1, odd spines.
@@ -67,6 +72,7 @@ def test_jax_matches_reference_tree_shapes():
         assert hexes[i] == ref.blake3_hex(DATA[:n]), f"len={n}"
 
 
+@pytest.mark.slow
 def test_pallas_chunk_kernel_parity(monkeypatch):
     """The Pallas chunk-stage kernel (interpret mode on the CPU mesh)
     must be bit-identical to the XLA path and the reference."""

@@ -65,8 +65,13 @@ def test_heif_binding_loads():
         decode_heif("/dev/null")
 
 
-def test_video_thumbnail_via_cv2(tmp_path):
+def test_video_thumbnail_via_cv2(tmp_path, monkeypatch):
     cv2 = pytest.importorskip("cv2")
+    # pin to the cv2 fallback: with libav present decode_video_frame
+    # would short-circuit into the native frontend
+    import spacedrive_tpu.native as native
+
+    monkeypatch.setattr(native, "video_available", lambda: False)
     path = str(tmp_path / "clip.mp4")
     w, h = 128, 96
     vw = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 10, (w, h))
@@ -105,6 +110,120 @@ def test_video_thumbnail_via_cv2(tmp_path):
 
     facts = msgpack.unpackb(row["camera_data"])
     assert facts["video"] is True and facts["codec"]
+
+
+# --- native FFmpeg frontend parity (crates/ffmpeg movie_decoder.rs) -------
+
+
+def _write_clip(path, w=128, h=96, frames=30, fps=10, asym=False):
+    cv2 = pytest.importorskip("cv2")
+    vw = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h))
+    assert vw.isOpened()
+    for i in range(frames):
+        frame = np.zeros((h, w, 3), np.uint8)
+        if asym:
+            frame[: h // 4, :, 2] = 240  # bright-red top band (BGR)
+            frame[h // 4:, :, 1] = 60
+        else:
+            frame[:, :, 2] = 10 + i * 8
+        vw.write(frame)
+    vw.release()
+
+
+def _patch_tkhd_rotation(data: bytes, deg: int) -> bytes:
+    """Rewrite the mp4 tkhd display matrix (how real phones mark
+    portrait video)."""
+    import struct
+
+    i = data.find(b"tkhd")
+    assert i > 4
+    version = data[i + 4]
+    moff = i + 4 + (40 if version == 0 else 52)
+    fixed = lambda v: struct.pack(">i", int(v * 65536))  # noqa: E731
+    f30 = lambda v: struct.pack(">i", int(v * (1 << 30)))  # noqa: E731
+    assert deg == 90
+    matrix = (fixed(0) + fixed(1) + f30(0) + fixed(-1) + fixed(0) + f30(0)
+              + fixed(0) + fixed(0) + f30(1))
+    return data[:moff] + matrix + data[moff + 36:]
+
+
+@pytest.mark.skipif(
+    not __import__("spacedrive_tpu.native", fromlist=["x"]).video_available(),
+    reason="libav unavailable",
+)
+def test_native_video_rotation_applied(tmp_path):
+    """A 90°-rotated clip (tkhd display matrix) decodes with swapped
+    dimensions and the content rotated (ref:movie_decoder.rs rotation-
+    aware filter graph)."""
+    src = tmp_path / "plain.mp4"
+    _write_clip(src, asym=True)
+    rotated = tmp_path / "rot90.mp4"
+    rotated.write_bytes(_patch_tkhd_rotation(src.read_bytes(), 90))
+
+    d_plain = process.decode_video_frame(str(src))
+    assert d_plain.array.shape[:2] == (96, 128)
+    # red band at the top of the unrotated frame
+    assert d_plain.array[:10, :, 0].mean() > 150
+
+    d_rot = process.decode_video_frame(str(rotated))
+    assert d_rot.array.shape[:2] == (128, 96)  # portrait now
+    # after clockwise rotation the top band lands on the right edge
+    assert d_rot.array[:, -10:, 0].mean() > 150
+    assert d_rot.array[:, :10, 0].mean() < 100
+
+
+@pytest.mark.skipif(
+    not __import__("spacedrive_tpu.native", fromlist=["x"]).video_available(),
+    reason="libav unavailable",
+)
+def test_native_embedded_cover_preference(tmp_path):
+    """A media file with attached cover art thumbnails from the cover,
+    not a decoded frame (ref:movie_decoder.rs:352)."""
+    import io
+    import struct
+
+    from PIL import Image
+
+    from spacedrive_tpu import native
+
+    jpg = io.BytesIO()
+    Image.new("RGB", (64, 48), (250, 200, 10)).save(jpg, "JPEG")
+    jpeg = jpg.getvalue()
+    apic = b"\x00" + b"image/jpeg\x00" + b"\x03" + b"cover\x00" + jpeg
+
+    def synchsafe(n):
+        return bytes([(n >> 21) & 0x7F, (n >> 14) & 0x7F,
+                      (n >> 7) & 0x7F, n & 0x7F])
+
+    tag_body = b"APIC" + struct.pack(">I", len(apic)) + b"\x00\x00" + apic
+    id3 = b"ID3\x03\x00\x00" + synchsafe(len(tag_body)) + tag_body
+    mp3_frame = b"\xff\xfb\x90\x00" + b"\x00" * 413  # MPEG1 L3 128k/44.1k
+    p = tmp_path / "song.mp3"
+    p.write_bytes(id3 + mp3_frame * 30)
+
+    arr, rotation, is_cover = native.video_frame(str(p))
+    assert is_cover and rotation == 0
+    assert arr.shape[:2] == (48, 64)
+    assert arr[10, 10, 0] > 200 and arr[10, 10, 2] < 80  # the yellow art
+
+
+@pytest.mark.skipif(
+    not __import__("spacedrive_tpu.native", fromlist=["x"]).video_available(),
+    reason="libav unavailable",
+)
+def test_native_video_meta(tmp_path):
+    src = tmp_path / "m.mp4"
+    _write_clip(src)
+    from spacedrive_tpu import native
+
+    meta = native.video_meta(str(src))
+    assert meta["width"] == 128 and meta["height"] == 96
+    assert abs(meta["fps"] - 10) < 0.5
+    assert meta["frame_count"] == 30
+    assert meta["codec"] == "mpeg4"
+    assert abs(meta["duration_seconds"] - 3.0) < 0.3
+    with pytest.raises(ValueError):
+        native.video_meta("/dev/null")
 
 
 # --- labeler actor --------------------------------------------------------

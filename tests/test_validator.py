@@ -27,6 +27,7 @@ def test_file_checksum_matches_reference_impl(tmp_path):
         assert file_checksum(p) == blake3_hex(data, 32), size
 
 
+@pytest.mark.slow
 def test_batched_checksums_device_parity(tmp_path):
     rng = np.random.default_rng(4)
     paths, want = [], []
