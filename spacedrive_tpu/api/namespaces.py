@@ -541,7 +541,12 @@ def _jobs(r: Router) -> None:
                 isinstance(event, tuple)
                 and event[0] == CoreEventKind.JOB_PROGRESS
             ):
-                yield event[1]
+                ev = event[1]
+                # the node bus carries every library's jobs; scope to
+                # the subscribed library (LibraryArgs semantics)
+                ev_lib = getattr(ev, "library_id", None)
+                if ev_lib is None or str(ev_lib) == str(library.id):
+                    yield ev
 
 
 # --- search --------------------------------------------------------------
@@ -943,12 +948,57 @@ def _p2p(r: Router) -> None:
 
     @r.subscription("p2p.events")
     async def events(node) -> AsyncIterator[Any]:
+        """Peer lifecycle (P2P-internal bus) merged with spacedrop
+        offers/progress (node event bus — SpacedropManager emits
+        there, p2p/manager.py:37); ref:spacedrop.rs:203."""
         if node.p2p is None:
             return
-        async for event in _bus_events_for(node.p2p.p2p.events):
-            kind = event[0] if isinstance(event, tuple) else None
-            if kind in ("PeerDiscovered", "PeerExpired", "PeerConnected", "PeerDisconnected"):
-                yield {"kind": kind, "identity": str(event[1])}
+        queue: asyncio.Queue = asyncio.Queue()
+        _SENTINEL = object()
+
+        async def pump(gen):
+            try:
+                async for ev in gen:
+                    await queue.put(ev)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # close the subscription, don't
+                await queue.put((_SENTINEL, exc))  # half-starve it
+            else:
+                await queue.put((_SENTINEL, None))
+
+        pumps = [
+            asyncio.create_task(pump(_bus_events_for(node.p2p.p2p.events))),
+            asyncio.create_task(pump(_bus_events(node))),
+        ]
+        try:
+            while True:
+                event = await queue.get()
+                if isinstance(event, tuple) and event and event[0] is _SENTINEL:
+                    if event[1] is not None:
+                        raise event[1]
+                    return  # a source ended cleanly (p2p torn down)
+                kind = event[0] if isinstance(event, tuple) and event else None
+                if kind in ("PeerDiscovered", "PeerExpired",
+                            "PeerConnected", "PeerDisconnected"):
+                    yield {"kind": kind, "identity": str(event[1])}
+                elif kind == "SpacedropRequest":
+                    req = event[1]  # inbound offer → accept/reject dialog
+                    yield {
+                        "kind": kind,
+                        "id": str(req.id),
+                        "peer": str(req.peer),
+                        "files": list(req.files),
+                        "total_size": req.total_size,
+                    }
+                elif kind == "SpacedropProgress":
+                    yield {
+                        "kind": kind, "id": str(event[1]),
+                        "percent": event[2],
+                    }
+        finally:
+            for t in pumps:
+                t.cancel()
 
 
 # --- nodes / volumes / preferences / notifications -----------------------

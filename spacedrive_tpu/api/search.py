@@ -117,6 +117,7 @@ def search_paths(library: Any, arg: dict[str, Any] | None) -> dict[str, Any]:
     where = ("WHERE " + " AND ".join(conds)) if conds else ""
     rows = library.db.query(
         f"SELECT fp.*, o.kind AS object_kind, o.favorite AS object_favorite, "
+        f"o.note AS object_note, "
         f"{order_field} AS __order "
         "FROM file_path fp LEFT JOIN object o ON o.id = fp.object_id "
         f"{where} ORDER BY {order_field} {direction}, fp.id ASC LIMIT ?",

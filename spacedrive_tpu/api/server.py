@@ -55,6 +55,8 @@ class ApiServer:
         self.app.add_routes(
             [
                 web.get("/", self._index),
+                web.get("/rspc/client.js", self._client_js),
+                web.get("/rspc/manifest", self._manifest),
                 web.post("/rspc/{key}", self._rspc_http),
                 web.get("/rspc/ws", self._rspc_ws),
                 web.get("/spacedrive/thumbnail/{ns}/{shard}/{name}", self._thumbnail),
@@ -65,6 +67,7 @@ class ApiServer:
             ]
         )
         self._runner: web.AppRunner | None = None
+        self._client_js_text: str | None = None
         self.port: int | None = None
 
     # --- lifecycle -----------------------------------------------------
@@ -88,6 +91,22 @@ class ApiServer:
             os.path.join(os.path.dirname(__file__), "static", "explorer.html"),
             headers={"Content-Type": "text/html; charset=utf-8"},
         )
+
+    async def _client_js(self, _request: web.Request) -> web.Response:
+        """The generated JS client (ref:packages/client/src/core.ts is
+        the same artifact, generated from the Rust router). The router
+        is fixed after mount, so generate once and cache."""
+        if self._client_js_text is None:
+            from .client_gen import generate_js
+
+            self._client_js_text = generate_js(self.router.manifest())
+        return web.Response(
+            text=self._client_js_text,
+            content_type="application/javascript",
+        )
+
+    async def _manifest(self, _request: web.Request) -> web.Response:
+        return web.json_response(self.router.manifest())
 
     # --- rspc ----------------------------------------------------------
 

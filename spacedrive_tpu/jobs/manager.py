@@ -106,8 +106,24 @@ class JobManager:
 
         # chain: spawn queued next jobs on success (ref:mod.rs:213-231)
         if report.status in (JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS):
+            self._invalidate_on_complete(job, library)
             for next_job in job.next_jobs:
                 await self.ingest(next_job, library, parent=report)
+
+    @staticmethod
+    def _invalidate_on_complete(job: StatefulJob, library: Any) -> None:
+        """Completed jobs invalidate the queries they changed so live
+        frontends refetch (the reference's jobs call invalidate_query!
+        in finalize, e.g. ref:indexer/indexer_job.rs); keys come from
+        the job class's INVALIDATES tuple."""
+        keys = getattr(job, "INVALIDATES", ())
+        node = getattr(library, "node", None)
+        if node is None or getattr(node, "event_bus", None) is None or not keys:
+            return
+        from ..api.invalidate import invalidate_query
+
+        for key in keys:
+            invalidate_query(node, key, library)
 
     # --- control (ref:manager.rs:222-267) ---
 
@@ -207,10 +223,16 @@ class JobManager:
 
     def _emit_progress(self, ctx: JobContext) -> None:
         library = ctx.library
+        event = ctx.report.progress_event(getattr(library, "id", None))
         bus = getattr(library, "event_bus", None)
         if bus is not None:
-            event = ctx.report.progress_event(getattr(library, "id", None))
             bus.emit(("JobProgress", event))
+        # the jobs.progress subscription listens on the NODE bus
+        # (CoreEvent::JobProgress, ref:api/mod.rs:54-58); each library
+        # has its own private bus, so emit there too
+        node_bus = getattr(getattr(library, "node", None), "event_bus", None)
+        if node_bus is not None and node_bus is not bus:
+            node_bus.emit(("JobProgress", event))
 
     @staticmethod
     def _action_string(job: StatefulJob) -> str:

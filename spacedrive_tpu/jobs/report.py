@@ -42,6 +42,7 @@ class JobProgressEvent:
 
     id: uuid.UUID
     library_id: uuid.UUID | None
+    name: str
     task_count: int
     completed_task_count: int
     phase: str
@@ -129,6 +130,7 @@ class JobReport:
         return JobProgressEvent(
             id=self.id,
             library_id=library_id,
+            name=self.name,
             task_count=self.task_count,
             completed_task_count=self.completed_task_count,
             phase=self.phase,

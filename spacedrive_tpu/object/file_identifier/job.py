@@ -56,6 +56,7 @@ class FileIdentifierJob(StatefulJob):
     """init: {location_id, sub_path?, backend?, chunk_size?}"""
 
     NAME = "file_identifier"
+    INVALIDATES = ("search.paths", "search.objects")
     IS_BATCHED = True
     _prefetcher = None  # runtime-only double buffer (never serialized)
 

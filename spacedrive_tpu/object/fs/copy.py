@@ -32,6 +32,7 @@ class FileCopierJob(StatefulJob):
     sources_file_path_ids, target_relative_path}"""
 
     NAME = "file_copier"
+    INVALIDATES = ("search.paths",)
 
     async def init_job(self, ctx: JobContext) -> None:
         db = ctx.library.db

@@ -28,6 +28,7 @@ class FileCutterJob(StatefulJob):
     sources_file_path_ids, target_relative_path}"""
 
     NAME = "file_cutter"
+    INVALIDATES = ("search.paths",)
 
     async def init_job(self, ctx: JobContext) -> None:
         db = ctx.library.db

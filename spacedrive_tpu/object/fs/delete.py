@@ -24,6 +24,7 @@ class FileDeleterJob(StatefulJob):
     """init: {location_id, file_path_ids}"""
 
     NAME = "file_deleter"
+    INVALIDATES = ("search.paths",)
 
     async def init_job(self, ctx: JobContext) -> None:
         db = ctx.library.db

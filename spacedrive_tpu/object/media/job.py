@@ -48,6 +48,7 @@ class MediaProcessorJob(StatefulJob):
     """init: {location_id, sub_path?, backend?}"""
 
     NAME = "media_processor"
+    INVALIDATES = ("search.paths", "labels.list")
     IS_BATCHED = True
 
     async def init_job(self, ctx: JobContext) -> None:

@@ -325,7 +325,13 @@ def test_http_server_and_custom_uri(tmp_path, corpus):
                     assert resp.status == 200
                     page = await resp.text()
                     assert "spacedrive-tpu explorer" in page
-                    assert "/rspc/ws" in page  # live-update wiring present
+                    # live updates + API calls ride the generated client
+                    assert "/rspc/client.js" in page
+                async with http.get(f"{base}/rspc/client.js") as resp:
+                    assert resp.status == 200
+                    js = await resp.text()
+                    assert "SdSocket" in js and "/rspc/ws" in js
+                    assert '"paths"' in js  # search namespace emitted
 
                 # rspc over HTTP
                 async with http.post(f"{base}/rspc/buildInfo", json={}) as resp:
@@ -381,6 +387,38 @@ def test_http_server_and_custom_uri(tmp_path, corpus):
                     )
                     msg = json.loads((await ws.receive()).data)
                     assert msg["id"] == "2" and msg["event"]["key"] == "tags.list"
+        finally:
+            await node.shutdown()
+
+    asyncio.run(run())
+
+
+def test_job_progress_and_invalidation_reach_node_bus(tmp_path, corpus):
+    """Live-UI contract: job progress events surface on the NODE bus
+    (jobs.progress subscription) and completed scan jobs invalidate
+    their queries (the reference's invalidate_query! in job finalize)
+    — a fresh scan must produce both without any explicit mutation."""
+
+    async def run():
+        node, lib, loc = await _scanned_node(tmp_path, corpus)
+        try:
+            sub = node.event_bus.subscribe()
+            open(os.path.join(corpus, "fresh.txt"), "w").write("new content")
+            await node.router.exec(
+                node, "locations.fullRescan",
+                {"location_id": loc["id"]}, library_id=str(lib.id),
+            )
+            await node.jobs.wait_idle()
+            progress, invalidated = [], []
+            for ev in sub.poll():
+                if isinstance(ev, tuple) and ev[0] == "JobProgress":
+                    progress.append(ev[1])
+                if isinstance(ev, tuple) and ev[0] == CoreEventKind.INVALIDATE_OPERATION:
+                    invalidated.append(ev[1].key)
+            assert progress, "no JobProgress on the node bus"
+            assert progress[0].name  # event carries the job name
+            assert "search.paths" in invalidated
+            assert "locations.list" in invalidated
         finally:
             await node.shutdown()
 
