@@ -403,7 +403,20 @@ def _object_update(node: Any, library: Any, file_path_id: int, **fields: Any) ->
     row = library.db.find_one("file_path", id=file_path_id)
     if row is None or not row["object_id"]:
         raise RspcError.not_found("object for file_path")
-    library.db.update("object", {"id": row["object_id"]}, **fields)
+    pub = _object_pub(library, row["object_id"])
+    cols = ", ".join(f"{k} = ?" for k in fields)
+
+    def writes(conn):
+        conn.execute(
+            f"UPDATE object SET {cols} WHERE id = ?",
+            (*fields.values(), row["object_id"]),
+        )
+
+    library.sync.write_ops(
+        [library.sync.shared_update("object", pub, k, v)
+         for k, v in fields.items()] if pub else [],
+        db_writes=writes,
+    )
     invalidate_query(node, "search.objects", library)
 
 
@@ -667,6 +680,16 @@ def _cloud(r: Router) -> None:
 # --- tags ----------------------------------------------------------------
 
 
+def _tag_pub(library, tag_id: int) -> str | None:
+    row = library.db.find_one("tag", id=int(tag_id))
+    return row["pub_id"].hex() if row else None
+
+
+def _object_pub(library, object_id: int) -> str | None:
+    row = library.db.find_one("object", id=int(object_id))
+    return row["pub_id"].hex() if row else None
+
+
 def _tags(r: Router) -> None:
     @r.query("tags.list", library=True)
     def list_tags(node, library):
@@ -683,43 +706,121 @@ def _tags(r: Router) -> None:
 
     @r.mutation("tags.create", library=True)
     def create(node, library, arg):
-        tid = library.db.insert(
-            "tag",
-            pub_id=new_pub_id(),
-            name=arg["name"],
-            color=arg.get("color"),
-            date_created=now_iso(),
-            date_modified=now_iso(),
+        # every shared-model write rides sync.write_ops so the domain
+        # row and its CRDT ops land in ONE transaction and paired
+        # devices converge (ref:manager.rs:70-93; sync.mdx)
+        pub = new_pub_id()
+        now = now_iso()
+        values = [("name", arg["name"]), ("color", arg.get("color")),
+                  ("date_created", now), ("date_modified", now)]
+        box = {}
+
+        def writes(conn):
+            box["id"] = conn.execute(
+                "INSERT INTO tag (pub_id, name, color, date_created, "
+                "date_modified) VALUES (?, ?, ?, ?, ?)",
+                (pub, arg["name"], arg.get("color"), now, now),
+            ).lastrowid
+
+        library.sync.write_ops(
+            library.sync.shared_create(
+                "tag", pub.hex(), [(k, v) for k, v in values if v is not None]
+            ),
+            db_writes=writes,
         )
         invalidate_query(node, "tags.list", library)
-        return tid
+        return box["id"]
 
     @r.mutation("tags.update", library=True)
     def update(node, library, arg):
         fields = {k: arg[k] for k in ("name", "color") if k in arg}
-        library.db.update("tag", {"id": int(arg["id"])}, **fields)
+        pub = _tag_pub(library, arg["id"])
+        if not fields:
+            return None
+        cols = ", ".join(f"{k} = ?" for k in fields)
+
+        def writes(conn):
+            conn.execute(
+                f"UPDATE tag SET {cols} WHERE id = ?",
+                (*fields.values(), int(arg["id"])),
+            )
+
+        library.sync.write_ops(
+            [library.sync.shared_update("tag", pub, k, v)
+             for k, v in fields.items()] if pub else [],
+            db_writes=writes,
+        )
         invalidate_query(node, "tags.list", library)
         return None
 
     @r.mutation("tags.delete", library=True)
     def delete(node, library, arg):
-        library.db.delete("tag_on_object", tag_id=int(arg))
-        library.db.delete("tag", id=int(arg))
+        tag_id = int(arg)
+        pub = _tag_pub(library, tag_id)
+        # link removals must sync too, or peers keep dangling
+        # tag_on_object rows that resurrect the tag as a ghost via
+        # FK placeholder creation on later relation ops
+        links = library.db.query(
+            "SELECT o.pub_id AS opub FROM tag_on_object t "
+            "JOIN object o ON o.id = t.object_id WHERE t.tag_id = ?",
+            (tag_id,),
+        )
+        ops = []
+        if pub:
+            ops = [
+                library.sync.relation_delete(
+                    "tag_on_object", {"item": l["opub"].hex(), "group": pub}
+                )
+                for l in links
+            ] + [library.sync.shared_delete("tag", pub)]
+
+        def writes(conn):
+            conn.execute("DELETE FROM tag_on_object WHERE tag_id = ?", (tag_id,))
+            conn.execute("DELETE FROM tag WHERE id = ?", (tag_id,))
+
+        library.sync.write_ops(ops, db_writes=writes)
         invalidate_query(node, "tags.list", library)
         return None
 
     @r.mutation("tags.assign", library=True)
     def assign(node, library, arg):
         tag_id = int(arg["tag_id"])
-        for oid in arg["object_ids"]:
-            if arg.get("unassign"):
-                library.db.delete("tag_on_object", tag_id=tag_id, object_id=int(oid))
-            else:
-                library.db.upsert(
-                    "tag_on_object",
-                    {"tag_id": tag_id, "object_id": int(oid)},
-                    date_created=now_iso(),
-                )
+        tag_pub = _tag_pub(library, tag_id)
+        oids = [int(o) for o in arg["object_ids"]]
+        qmarks = ",".join("?" * len(oids)) or "NULL"
+        pub_by_id = {
+            row["id"]: row["pub_id"].hex()
+            for row in library.db.query(
+                f"SELECT id, pub_id FROM object WHERE id IN ({qmarks})", oids
+            )
+        }
+        unassign = bool(arg.get("unassign"))
+        now = now_iso()
+        ops = []
+        for oid in oids:
+            obj_pub = pub_by_id.get(oid)
+            if tag_pub and obj_pub:
+                rid = {"item": obj_pub, "group": tag_pub}
+                if unassign:
+                    ops.append(library.sync.relation_delete("tag_on_object", rid))
+                else:
+                    ops.extend(library.sync.relation_create("tag_on_object", rid))
+
+        def writes(conn):
+            for oid in oids:
+                if unassign:
+                    conn.execute(
+                        "DELETE FROM tag_on_object WHERE tag_id = ? AND object_id = ?",
+                        (tag_id, oid),
+                    )
+                else:
+                    conn.execute(
+                        "INSERT INTO tag_on_object (tag_id, object_id, date_created) "
+                        "VALUES (?, ?, ?) ON CONFLICT (tag_id, object_id) DO NOTHING",
+                        (tag_id, oid, now),
+                    )
+
+        library.sync.write_ops(ops, db_writes=writes)
         invalidate_query(node, "tags.getForObject", library)
         return None
 

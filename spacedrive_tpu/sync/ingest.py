@@ -73,6 +73,25 @@ def receive_crdt_operation(sync: SyncManager, op: CRDTOperation) -> bool:
         iid = _ensure_instance(sync, op.instance)
         with sync.db.transaction() as conn:
             apply_op(conn, op)
+            if op.data.kind == DELETE:
+                # Determinism under delete/update races: the row must be
+                # a pure function of the op SET, not arrival order. A
+                # delete may arrive after updates that are HLC-newer
+                # than it (which is_operation_old can't reject — kinds
+                # differ); re-applying the stored newer ops rebuilds
+                # exactly the state the other arrival order produces.
+                # (The reference resurrects-by-upsert and genuinely
+                # diverges here; found by tests/test_sync_properties.)
+                newer = conn.execute(
+                    "SELECT data FROM crdt_operation WHERE model = ? "
+                    "AND record_id = ? AND timestamp > ? "
+                    "ORDER BY timestamp ASC",
+                    (op.model, _record_id_blob(op.record_id),
+                     int(op.timestamp)),
+                ).fetchall()
+                for row in newer:
+                    raw = row["data"] if isinstance(row, dict) else row[0]
+                    apply_op(conn, CRDTOperation.unpack(raw))
             conn.execute(
                 "INSERT OR REPLACE INTO crdt_operation "
                 "(id, timestamp, model, record_id, kind, data, instance_id) "

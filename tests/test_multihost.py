@@ -1,0 +1,106 @@
+"""Multi-host distributed backend: 2-process jax.distributed over DCN.
+
+Proves `parallel/mesh.py::multihost_init` is a working path, not dead
+code: two OS processes (the unit of a "host" in jax.distributed) join
+one cluster over a loopback coordinator, build a GLOBAL mesh spanning
+both processes' virtual CPU devices, and run the framework's hot
+workload — a sharded cas_id BLAKE3 batch — with every digest verified
+against the host reference oracle. This is the CPU-mesh stand-in for
+the reference's NCCL/MPI-class comm backend (SURVEY §2.4) scaled past
+one process.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import sys
+sys.path.insert(0, "@REPO@")
+from spacedrive_tpu.utils.jaxenv import force_cpu_devices
+force_cpu_devices(2)  # 2 local devices per process -> 4 global
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spacedrive_tpu.parallel.mesh import multihost_init
+
+pid = int(sys.argv[1])
+ok = multihost_init("@COORD@", num_processes=2, process_id=pid)
+assert ok, "multihost_init returned False"
+assert jax.process_count() == 2, jax.process_count()
+devices = jax.devices()
+assert len(devices) == 4, devices  # global view spans both processes
+
+from spacedrive_tpu.ops import blake3_jax
+from spacedrive_tpu.ops.blake3_ref import blake3_hex
+
+B, CAP = 8, 2 * 1024
+rng = np.random.default_rng(0)  # identical on both hosts
+msgs = rng.integers(0, 256, size=(B, CAP), dtype=np.uint8)
+lens = np.full((B,), 1500, np.int32)
+msgs[:, 1500:] = 0  # zero-pad beyond message length
+
+mesh = Mesh(np.array(devices), ("dp",))
+sharding = NamedSharding(mesh, P("dp"))
+garr = jax.make_array_from_callback(
+    (B, CAP), sharding, lambda idx: msgs[idx]
+)
+glens = jax.make_array_from_callback(
+    (B,), NamedSharding(mesh, P("dp")), lambda idx: lens[idx]
+)
+words = blake3_jax.hash_batch(garr, glens, max_chunks=2)
+
+from jax.experimental import multihost_utils
+
+gathered = np.asarray(multihost_utils.process_allgather(words, tiled=True))
+assert gathered.shape[0] == B, gathered.shape
+hexes = blake3_jax.words_to_hex(gathered, 32)
+for i in range(B):
+    want = blake3_hex(bytes(msgs[i, :lens[i]]), 16)
+    assert hexes[i] == want, (i, hexes[i], want)
+print(f"proc{pid}: all {B} sharded digests match the reference", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_distributed_hash_batch():
+    coord = f"127.0.0.1:{_free_port()}"
+    code = _CHILD.replace("@REPO@", REPO).replace("@COORD@", coord)
+    env = {k: v for k, v in os.environ.items() if "AXON" not in k}
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed processes hung:\n" + "\n".join(outs))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"proc failed:\n{out[-3000:]}"
+    assert "all 8 sharded digests match" in outs[0]
+    assert "all 8 sharded digests match" in outs[1]
