@@ -423,6 +423,20 @@ def _conv_transpose(jnp, attrs, x, w, b=None):
 
     spatial = w.ndim - 2
     strides = attrs.get("strides", [1] * spatial)
+    # Attributes this lowering does not model — refuse rather than compute
+    # a silently wrong result (module policy: unsupported gaps raise).
+    if attrs.get("group", 1) != 1:
+        raise NotImplementedError("ConvTranspose: group != 1")
+    if any(int(d) != 1 for d in attrs.get("dilations", [1] * spatial)):
+        raise NotImplementedError("ConvTranspose: dilations != 1")
+    if any(int(p) != 0 for p in attrs.get("output_padding", [0] * spatial)):
+        raise NotImplementedError("ConvTranspose: output_padding")
+    if "output_shape" in attrs:
+        raise NotImplementedError("ConvTranspose: output_shape")
+    if attrs.get("auto_pad", "NOTSET") not in (
+        "NOTSET", b"NOTSET", "VALID", b"VALID",  # VALID ≡ NOTSET w/ zero pads
+    ):
+        raise NotImplementedError("ConvTranspose: auto_pad SAME_*")
     pads = attrs.get("pads", [0] * (2 * spatial))
     pairs = [(int(pads[i]), int(pads[i + spatial])) for i in range(spatial)]
     # ONNX ConvTranspose weight is (C_in, C_out/groups, kH, kW)

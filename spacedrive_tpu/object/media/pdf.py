@@ -32,6 +32,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 MAX_RENDER_DIM = 512  # match the SVG cap; thumbnails are ≤512² anyway
+MAX_INFLATE = 64 * 1024 * 1024  # hard cap per decoded stream (deflate-bomb guard)
 
 
 class PdfError(Exception):
@@ -251,38 +252,68 @@ class Stream:
 # --- filters ---------------------------------------------------------------
 
 
+def _inflate_bounded(data: bytes, cap: int = MAX_INFLATE) -> bytes:
+    """zlib inflate with a hard output bound (untrusted-input bomb guard).
+
+    Raises zlib.error for truncated/corrupt streams exactly like
+    zlib.decompress did, so callers' fallback paths still trigger."""
+    d = zlib.decompressobj()
+    out = d.decompress(data, cap)
+    if d.unconsumed_tail or (not d.eof and d.decompress(b"", 1)):
+        raise PdfUnsupported(f"inflated stream exceeds {cap} byte cap")
+    if not d.eof:
+        raise zlib.error("incomplete or truncated deflate stream")
+    return out
+
+
 def _png_predictor(data: bytes, colors: int, bpc: int, columns: int) -> bytes:
     bpp = max(1, (colors * bpc) // 8)
     row_len = (columns * colors * bpc + 7) // 8
-    out = bytearray()
-    prev = bytearray(row_len)
-    pos = 0
-    while pos + 1 + row_len <= len(data):
-        ft = data[pos]
-        row = bytearray(data[pos + 1:pos + 1 + row_len])
-        pos += 1 + row_len
-        if ft == 1:  # Sub
-            for i in range(bpp, row_len):
-                row[i] = (row[i] + row[i - bpp]) & 0xFF
-        elif ft == 2:  # Up
+    n_rows = len(data) // (1 + row_len)
+    if n_rows == 0:
+        return b""
+    # Rows are [filter_type, row_len bytes]; reshape and split.
+    arr = np.frombuffer(data[: n_rows * (1 + row_len)], dtype=np.uint8)
+    arr = arr.reshape(n_rows, 1 + row_len)
+    ftypes = arr[:, 0]
+    rows = arr[:, 1:].copy()
+    # Sub and Up rows are vectorized (per-lane cumsum within the row /
+    # elementwise add of the previous row); Average and Paeth have a
+    # sequential left-dependency and stay scalar, but only those rows
+    # pay the Python loop.
+    prev = np.zeros(row_len, dtype=np.uint8)
+    for r in range(n_rows):
+        ft = ftypes[r]
+        row = rows[r]
+        if ft == 0:
+            pass
+        elif ft == 1:  # Sub: per-lane cumsum along the row (mod 256)
+            for lane in range(bpp):
+                acc = np.cumsum(row[lane::bpp], dtype=np.uint64)
+                row[lane::bpp] = (acc & 0xFF).astype(np.uint8)
+        elif ft == 2:  # Up: elementwise add of previous row
+            np.add(row, prev, out=row, casting="unsafe")
+        elif ft == 3:  # Average (left term is sequential; scalar per row)
+            rl = row.tolist()
+            pv = prev.tolist()
             for i in range(row_len):
-                row[i] = (row[i] + prev[i]) & 0xFF
-        elif ft == 3:  # Average
+                left = rl[i - bpp] if i >= bpp else 0
+                rl[i] = (rl[i] + (left + pv[i]) // 2) & 0xFF
+            row[:] = rl
+        elif ft == 4:  # Paeth (sequential; scalar per row)
+            rl = row.tolist()
+            pv = prev.tolist()
             for i in range(row_len):
-                left = row[i - bpp] if i >= bpp else 0
-                row[i] = (row[i] + (left + prev[i]) // 2) & 0xFF
-        elif ft == 4:  # Paeth
-            for i in range(row_len):
-                a = row[i - bpp] if i >= bpp else 0
-                b = prev[i]
-                c = prev[i - bpp] if i >= bpp else 0
+                a = rl[i - bpp] if i >= bpp else 0
+                b = pv[i]
+                c = pv[i - bpp] if i >= bpp else 0
                 p = a + b - c
                 pa, pb, pc = abs(p - a), abs(p - b), abs(p - c)
                 pr = a if (pa <= pb and pa <= pc) else (b if pb <= pc else c)
-                row[i] = (row[i] + pr) & 0xFF
-        out += row
+                rl[i] = (rl[i] + pr) & 0xFF
+            row[:] = rl
         prev = row
-    return bytes(out)
+    return rows.tobytes()
 
 
 def _apply_filters(doc: "PdfDocument", sdict: dict, raw: bytes,
@@ -301,7 +332,7 @@ def _apply_filters(doc: "PdfDocument", sdict: dict, raw: bytes,
         p = doc.resolve(parms[i]) if i < len(parms) else None
         p = p or {}
         if f in ("FlateDecode", "Fl"):
-            data = zlib.decompress(data)
+            data = _inflate_bounded(data)
             pred = doc.resolve(p.get("Predictor", 1)) or 1
             if pred >= 10:
                 data = _png_predictor(

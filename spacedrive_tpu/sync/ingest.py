@@ -38,6 +38,15 @@ RequestOps = Callable[
 ]
 
 
+# Global LWW order: (HLC timestamp, instance pub_id). is_operation_old and
+# the delete re-apply query MUST use the same predicate or equal-timestamp
+# delete/update races diverge by arrival order. Bind params:
+# (timestamp, timestamp, pub_id).
+_LWW_NEWER_SQL = (
+    "(co.timestamp > ? OR (co.timestamp = ? AND i.pub_id > ?))"
+)
+
+
 class State(enum.Enum):
     WAITING_FOR_NOTIFICATION = "waiting"
     RETRIEVING_MESSAGES = "retrieving"
@@ -46,18 +55,20 @@ class State(enum.Enum):
 
 def is_operation_old(sync: SyncManager, op: CRDTOperation) -> bool:
     """True if a stored op for the same (model, record) supersedes
-    `op` — same-field update or any delete with a newer-or-equal
-    timestamp (ref:ingest.rs:169-192)."""
+    `op` — same-field update or any delete that is strictly newer in
+    the global LWW order (HLC timestamp, instance pub_id), the same
+    order the delete re-apply path and the property-test oracle use
+    (ref:ingest.rs:169-192). An exact echo (same timestamp, same
+    instance) is not selected and re-applies idempotently."""
     rows = sync.db.query(
-        "SELECT kind, timestamp FROM crdt_operation "
-        "WHERE model = ? AND record_id = ? AND timestamp >= ? "
-        "ORDER BY timestamp DESC",
-        (op.model, _record_id_blob(op.record_id), int(op.timestamp)),
+        "SELECT co.kind FROM crdt_operation co "
+        "JOIN instance i ON i.id = co.instance_id "
+        "WHERE co.model = ? AND co.record_id = ? AND " + _LWW_NEWER_SQL,
+        (op.model, _record_id_blob(op.record_id), int(op.timestamp),
+         int(op.timestamp), op.instance.bytes),
     )
     mine = op.kind()
     for row in rows:
-        if NTP64(row["timestamp"]) == op.timestamp and row["kind"] == mine:
-            continue  # our own echo (same instance round trip)
         if row["kind"] == DELETE or row["kind"] == mine:
             return True
     return False
@@ -82,12 +93,18 @@ def receive_crdt_operation(sync: SyncManager, op: CRDTOperation) -> bool:
                 # exactly the state the other arrival order produces.
                 # (The reference resurrects-by-upsert and genuinely
                 # diverges here; found by tests/test_sync_properties.)
+                # "Newer" means the full LWW order (timestamp, instance
+                # pub_id) — a same-timestamp op from a higher instance id
+                # also supersedes this delete.
                 newer = conn.execute(
-                    "SELECT data FROM crdt_operation WHERE model = ? "
-                    "AND record_id = ? AND timestamp > ? "
-                    "ORDER BY timestamp ASC",
+                    "SELECT co.data FROM crdt_operation co "
+                    "JOIN instance i ON i.id = co.instance_id "
+                    "WHERE co.model = ? AND co.record_id = ? "
+                    "AND " + _LWW_NEWER_SQL +
+                    " ORDER BY co.timestamp ASC, i.pub_id ASC",
                     (op.model, _record_id_blob(op.record_id),
-                     int(op.timestamp)),
+                     int(op.timestamp), int(op.timestamp),
+                     op.instance.bytes),
                 ).fetchall()
                 for row in newer:
                     raw = row["data"] if isinstance(row, dict) else row[0]

@@ -257,3 +257,68 @@ def test_thumbnailer_generates_pdf_and_svg_thumbs(tmp_path):
         await thumb.shutdown()
 
     asyncio.run(run())
+
+
+def test_pdf_flate_bomb_is_bounded():
+    """A deflate bomb in a stream raises PdfUnsupported instead of
+    inflating past MAX_INFLATE (advisor r2: bounded-reader guarantee)."""
+    from spacedrive_tpu.object.media.pdf import (
+        MAX_INFLATE,
+        _apply_filters,
+        _inflate_bounded,
+    )
+
+    bomb = zlib.compress(b"\x00" * (MAX_INFLATE + 1024), 9)
+    assert len(bomb) < 1 << 20  # it really is a bomb
+    with pytest.raises(PdfUnsupported):
+        _inflate_bounded(bomb)
+    class _Doc:
+        def resolve(self, x):
+            return x
+
+    with pytest.raises(PdfUnsupported):
+        _apply_filters(_Doc(), {"Filter": "FlateDecode"}, bomb)
+
+
+def test_png_predictor_vectorized_matches_reference():
+    """All four PNG filter types round-trip correctly after the numpy
+    vectorization (Sub/Up fast paths vs scalar Average/Paeth)."""
+    from spacedrive_tpu.object.media.pdf import _png_predictor
+
+    rng = np.random.default_rng(7)
+    colors, bpc, columns = 3, 8, 64
+    row_len = columns * colors
+    raw = rng.integers(0, 256, size=(6, row_len), dtype=np.uint8)
+
+    # scalar oracle (the pre-vectorization algorithm)
+    def oracle(data):
+        bpp = colors * bpc // 8
+        out = bytearray()
+        prev = bytearray(row_len)
+        pos = 0
+        while pos + 1 + row_len <= len(data):
+            ft = data[pos]
+            row = bytearray(data[pos + 1:pos + 1 + row_len])
+            pos += 1 + row_len
+            for i in range(row_len):
+                a = row[i - bpp] if i >= bpp else 0
+                b = prev[i]
+                c = prev[i - bpp] if i >= bpp else 0
+                if ft == 1:
+                    row[i] = (row[i] + a) & 0xFF
+                elif ft == 2:
+                    row[i] = (row[i] + b) & 0xFF
+                elif ft == 3:
+                    row[i] = (row[i] + (a + b) // 2) & 0xFF
+                elif ft == 4:
+                    p = a + b - c
+                    pa, pb, pc = abs(p - a), abs(p - b), abs(p - c)
+                    pr = a if (pa <= pb and pa <= pc) else (b if pb <= pc else c)
+                    row[i] = (row[i] + pr) & 0xFF
+            out += row
+            prev = row
+        return bytes(out)
+
+    ftypes = [0, 1, 2, 3, 4, 2]
+    data = b"".join(bytes([ft]) + raw[r].tobytes() for r, ft in enumerate(ftypes))
+    assert _png_predictor(data, colors, bpc, columns) == oracle(data)

@@ -253,3 +253,53 @@ async def test_three_node_mesh_converges():
             assert row is not None and row["name"] == f"tag-{i}", (
                 f"{inst.sync.instance} missing tag-{i}"
             )
+
+
+@pytest.mark.asyncio
+async def test_equal_timestamp_delete_update_tiebreak_converges():
+    """Equal-HLC delete (instance A) vs update (instance B) must converge
+    to the same state on both arrival orders, decided by the
+    (timestamp, instance pub_id) LWW order — not arrival order
+    (advisor r2 + reviewer: one-sided tiebreaks diverge)."""
+    tag_pub = uuid.uuid4().bytes.hex()
+    T = NTP64(5000)
+
+    def build(lo: uuid.UUID, hi: uuid.UUID):
+        delete = CRDTOperation(
+            instance=lo, timestamp=T, id=uuid.uuid4(),
+            model="tag", record_id=tag_pub,
+            data=CRDTOperationData.delete(),
+        )
+        update = CRDTOperation(
+            instance=hi, timestamp=T, id=uuid.uuid4(),
+            model="tag", record_id=tag_pub,
+            data=CRDTOperationData.update("name", "survivor"),
+        )
+        return delete, update
+
+    ids = sorted([uuid.uuid4(), uuid.uuid4()], key=lambda u: u.bytes)
+
+    # Case 1: the update's instance is the LWW winner → both orders
+    # end with the row present.
+    delete, update = build(ids[0], ids[1])
+    n1, n2 = Instance("n1"), Instance("n2")
+    receive_crdt_operation(n1.sync, update)
+    receive_crdt_operation(n1.sync, delete)
+    receive_crdt_operation(n2.sync, delete)
+    receive_crdt_operation(n2.sync, update)
+    r1 = n1.db.find_one("tag", pub_id=bytes.fromhex(tag_pub))
+    r2 = n2.db.find_one("tag", pub_id=bytes.fromhex(tag_pub))
+    assert (r1 is None) == (r2 is None), "arrival-order divergence"
+    assert r1 is not None and r1["name"] == "survivor"
+    assert r2["name"] == "survivor"
+
+    # Case 2: the delete's instance is the LWW winner → both orders
+    # end deleted.
+    delete, update = build(ids[1], ids[0])
+    n3, n4 = Instance("n3"), Instance("n4")
+    receive_crdt_operation(n3.sync, update)
+    receive_crdt_operation(n3.sync, delete)
+    receive_crdt_operation(n4.sync, delete)
+    receive_crdt_operation(n4.sync, update)
+    assert n3.db.find_one("tag", pub_id=bytes.fromhex(tag_pub)) is None
+    assert n4.db.find_one("tag", pub_id=bytes.fromhex(tag_pub)) is None
