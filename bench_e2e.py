@@ -1,0 +1,386 @@
+"""End-to-end benchmarks for the BASELINE.md configs, on the REAL pipeline.
+
+Runs each config through the production machinery (Node → jobs → task
+system → device ops → SQLite), not synthetic kernels:
+
+  config 1 — file_identifier cas_id pass over an on-disk mixed-size
+             location (index job excluded from the timed window)
+  config 3 — thumbnailer pass (decode → device resize → webp store)
+             via the MediaProcessorJob + node thumbnail actor
+  config 4 — video thumbnails (native FFmpeg frontend → device resize)
+  config 5 — dedup: batched device pHash + all-pairs Hamming clustering
+
+(config 2 — the pure batched-BLAKE3 kernel — is bench.py's headline.)
+
+Every config runs twice: device backend and CPU backend, on identical
+corpora, so `vs_cpu1` is measured (not inferred); `vs_cpu16` divides by
+16× the 1-core number — the north star's 16-core host, which this 1-core
+rig can only project (stated explicitly in the output).
+
+Output: a human log on stderr; ONE JSON document on stdout, also written
+to BENCH_E2E.json. Scale knobs (defaults sized for ~10 min total under a
+healthy link): SD_E2E_FILES=10000 SD_E2E_IMAGES=256 SD_E2E_CLIPS=8
+SD_E2E_CONFIGS=1,3,4,5.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+CPU_BASELINE_CORES = 16
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# --- corpus builders -------------------------------------------------------
+
+
+def build_mixed_corpus(root: str, n: int) -> None:
+    """Mixed-size files matching the cas_id size classes: ~55% small
+    (≤100 KiB, whole-file hash), ~40% large (sampled 56 KiB), ~5% empty."""
+    rng = random.Random(11)
+    os.makedirs(root, exist_ok=True)
+    payload = os.urandom(1 << 20)  # recycled entropy, offsets vary per file
+    for i in range(n):
+        r = rng.random()
+        if r < 0.05:
+            size = 0
+        elif r < 0.60:
+            size = rng.randrange(1, 100 * 1024)
+        else:
+            size = rng.randrange(100 * 1024 + 1, 600 * 1024)
+        off = rng.randrange(0, len(payload) - 1)
+        with open(os.path.join(root, f"f{i:06d}.bin"), "wb") as f:
+            remaining = size
+            f.write(i.to_bytes(8, "little"))  # unique prefix → unique cas_id
+            remaining -= min(8, size)
+            while remaining > 0:
+                take = min(remaining, len(payload) - off)
+                f.write(payload[off:off + take])
+                remaining -= take
+                off = 0
+
+
+def build_image_corpus(root: str, n: int) -> None:
+    from PIL import Image
+
+    rng = np.random.default_rng(12)
+    os.makedirs(root, exist_ok=True)
+    for i in range(n):
+        w, h = [(640, 480), (800, 600), (512, 384)][i % 3]
+        arr = rng.integers(0, 255, size=(h // 8, w // 8, 3), dtype=np.uint8)
+        img = Image.fromarray(arr, "RGB").resize((w, h))  # compressible noise
+        img.save(os.path.join(root, f"img{i:05d}.jpg"), quality=80)
+
+
+def build_video_corpus(root: str, n: int) -> None:
+    import cv2
+
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(13)
+    for i in range(n):
+        w, h, fps, frames = 320, 240, 10, 40
+        vw = cv2.VideoWriter(
+            os.path.join(root, f"clip{i:03d}.mp4"),
+            cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h),
+        )
+        base = rng.integers(0, 255, size=(h, w, 3), dtype=np.uint8)
+        for t in range(frames):
+            frame = np.roll(base, t * 5, axis=1)
+            vw.write(frame)
+        vw.release()
+
+
+# --- pipeline drivers ------------------------------------------------------
+
+
+async def run_scan(data_dir: str, corpus: str, *, use_device: bool,
+                   backend: str) -> dict:
+    """Index + identify + media-process `corpus`; returns phase timings
+    from the real jobs."""
+    from spacedrive_tpu.jobs.manager import JobBuilder
+    from spacedrive_tpu.location.indexer.job import IndexerJob
+    from spacedrive_tpu.location.locations import LocationCreateArgs
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.object.file_identifier.job import FileIdentifierJob
+    from spacedrive_tpu.object.media.job import MediaProcessorJob
+
+    node = Node(data_dir, use_device=use_device, with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        lib = await node.create_library("bench")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+
+        t0 = time.perf_counter()
+        await JobBuilder(IndexerJob({"location_id": loc["id"]})).spawn(node.jobs, lib)
+        await node.jobs.wait_idle()
+        index_s = time.perf_counter() - t0
+
+        ident = FileIdentifierJob({"location_id": loc["id"], "backend": backend})
+        t0 = time.perf_counter()
+        await JobBuilder(ident).spawn(node.jobs, lib)
+        await node.jobs.wait_idle()
+        ident_s = time.perf_counter() - t0
+
+        media = MediaProcessorJob({"location_id": loc["id"]})
+        t0 = time.perf_counter()
+        await JobBuilder(media).spawn(node.jobs, lib)
+        await node.jobs.wait_idle()
+        media_s = time.perf_counter() - t0
+
+        files = lib.db.count("file_path", "is_dir = 0", ())
+        objects = lib.db.count("object")
+        thumbs = sum(
+            sum(1 for f in fs if f.endswith(".webp"))
+            for _, _, fs in os.walk(os.path.join(data_dir, "thumbnails"))
+        )
+        return {
+            "index_s": index_s, "identifier_s": ident_s, "media_s": media_s,
+            "files": files, "objects": objects, "thumbnails": thumbs,
+            "identifier_meta": dict(ident.run_metadata),
+        }
+    finally:
+        await node.shutdown()
+
+
+def probe_link() -> float:
+    """Best-of-3 host→device bandwidth (GB/s); congestion context for
+    every figure in the artifact. Waits (bounded) through spikes."""
+    import jax
+    import jax.numpy as jnp
+
+    buf = np.zeros((32 << 20,), np.uint8)
+    jax.block_until_ready(jax.device_put(buf[: 1 << 20]))
+
+    def once() -> float:
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(jnp.sum(jax.device_put(buf)))
+            best = max(best, buf.nbytes / (time.perf_counter() - t0))
+        return best / 1e9
+
+    wait_budget = float(os.environ.get("SD_BENCH_WAIT", "240"))
+    waited = 0.0
+    g = once()
+    while g < 0.5 and waited < wait_budget:
+        log(f"  link {g:.2f} GB/s (congested); waiting 30 s…")
+        time.sleep(30)
+        waited += 30
+        g = once()
+    log(f"  link probe: {g:.2f} GB/s")
+    return g
+
+
+def timed_pair(corpus_dir: str, tmp: str, tag: str, backend_pairs) -> dict:
+    """Run the scan once per backend on fresh nodes; returns both."""
+    out = {}
+    for name, use_device, backend in backend_pairs:
+        data_dir = os.path.join(tmp, f"node-{tag}-{name}")
+        res = asyncio.run(
+            run_scan(data_dir, corpus_dir, use_device=use_device, backend=backend)
+        )
+        out[name] = res
+        log(f"  [{name}] index {res['index_s']:.1f}s  identifier "
+            f"{res['identifier_s']:.1f}s  media {res['media_s']:.1f}s  "
+            f"files={res['files']} thumbs={res['thumbnails']}")
+    return out
+
+
+# --- configs ---------------------------------------------------------------
+
+
+def config_1(tmp: str, n_files: int) -> dict:
+    log(f"config 1: identifier pass, {n_files} mixed files…")
+    corpus = os.path.join(tmp, "corpus1")
+    t0 = time.perf_counter()
+    build_mixed_corpus(corpus, n_files)
+    log(f"  corpus built in {time.perf_counter()-t0:.1f}s")
+    runs = timed_pair(corpus, tmp, "c1", [
+        ("device", True, "tpu"), ("cpu", False, "cpu"),
+    ])
+    dev_fps = runs["device"]["files"] / runs["device"]["identifier_s"]
+    cpu_fps = runs["cpu"]["files"] / runs["cpu"]["identifier_s"]
+    return {
+        "name": "file_identifier cas_id pass, on-disk mixed location",
+        "files": runs["device"]["files"],
+        "device_files_per_s": round(dev_fps, 1),
+        "cpu1_files_per_s": round(cpu_fps, 1),
+        "vs_cpu1": round(dev_fps / cpu_fps, 3),
+        "vs_cpu16_projected": round(dev_fps / (cpu_fps * CPU_BASELINE_CORES), 3),
+        "prefetch": {
+            k: runs["device"]["identifier_meta"].get(k)
+            for k in ("prefetch_hits", "prefetch_misses", "hash_time", "db_time")
+        },
+    }
+
+
+def config_3(tmp: str, n_images: int) -> dict:
+    log(f"config 3: thumbnail pass, {n_images} JPEGs…")
+    corpus = os.path.join(tmp, "corpus3")
+    build_image_corpus(corpus, n_images)
+    runs = timed_pair(corpus, tmp, "c3", [
+        ("device", True, "tpu"), ("cpu", False, "cpu"),
+    ])
+    dev = runs["device"]["thumbnails"] / runs["device"]["media_s"]
+    cpu = runs["cpu"]["thumbnails"] / runs["cpu"]["media_s"]
+    return {
+        "name": "JPEG thumbnail pass (decode → resize → webp)",
+        "images": runs["device"]["thumbnails"],
+        "device_thumbs_per_s": round(dev, 2),
+        "cpu1_thumbs_per_s": round(cpu, 2),
+        "vs_cpu1": round(dev / cpu, 3),
+        "vs_cpu16_projected": round(dev / (cpu * CPU_BASELINE_CORES), 3),
+    }
+
+
+def config_4(tmp: str, n_clips: int) -> dict:
+    log(f"config 4: video thumbnails, {n_clips} clips…")
+    corpus = os.path.join(tmp, "corpus4")
+    build_video_corpus(corpus, n_clips)
+    runs = timed_pair(corpus, tmp, "c4", [
+        ("device", True, "tpu"), ("cpu", False, "cpu"),
+    ])
+    dev = runs["device"]["thumbnails"] / runs["device"]["media_s"]
+    cpu = runs["cpu"]["thumbnails"] / runs["cpu"]["media_s"]
+    return {
+        "name": "video thumbnails (FFmpeg keyframe → resize → webp)",
+        "clips": runs["device"]["thumbnails"],
+        "device_clips_per_s": round(dev, 2),
+        "cpu1_clips_per_s": round(cpu, 2),
+        "vs_cpu1": round(dev / cpu, 3),
+        "vs_cpu16_projected": round(dev / (cpu * CPU_BASELINE_CORES), 3),
+    }
+
+
+def config_5(tmp: str, n_images: int) -> dict:
+    """Dedup: device pHash + all-pairs Hamming vs numpy oracle, over a
+    corpus with planted near-duplicates."""
+    from PIL import Image
+
+    from spacedrive_tpu.ops import phash_jax
+
+    log(f"config 5: dedup clustering, {n_images} images (+25% dupes)…")
+    corpus = os.path.join(tmp, "corpus5")
+    build_image_corpus(corpus, n_images)
+    # plant near-duplicates: re-encode at lower quality
+    paths = sorted(
+        os.path.join(corpus, f) for f in os.listdir(corpus)
+    )
+    for i, p in enumerate(paths[: n_images // 4]):
+        Image.open(p).save(p.replace(".jpg", "_dup.jpg"), quality=40)
+    paths = sorted(os.path.join(corpus, f) for f in os.listdir(corpus))
+
+    grays = []
+    t0 = time.perf_counter()
+    for p in paths:
+        arr = np.asarray(Image.open(p).convert("RGBA"))
+        grays.append(phash_jax.to_gray32(arr))
+    decode_s = time.perf_counter() - t0
+    gray = np.stack(grays)
+
+    # real flow at corpus scale: device pHash + clustering correctness
+    bits = phash_jax.phash_batch(gray)
+    ham = phash_jax.hamming_matrix(
+        [bits[i].tobytes() for i in range(bits.shape[0])]
+    )
+    n = len(paths)
+    dup_pairs = int(((ham <= 10) & ~np.eye(n, dtype=bool)).sum()) // 2
+    planted = n_images // 4
+
+    # the O(N²) stage at LIBRARY scale: expand to n_hashes by bit
+    # perturbation, then all-pairs Hamming device vs a realistic packed
+    # uint64 + popcount CPU implementation
+    n_hashes = int(os.environ.get("SD_E2E_HASHES", "8192"))
+    rng = np.random.default_rng(14)
+    base = np.unpackbits(
+        np.frombuffer(
+            b"".join(bits[i].tobytes() for i in range(n)), np.uint8
+        ).reshape(n, 8), axis=1,
+    )
+    big = base[rng.integers(0, n, n_hashes)]
+    flips = rng.random(big.shape) < 0.2
+    big = (big ^ flips).astype(np.uint8)
+    hashes = [np.packbits(big[i]).tobytes() for i in range(n_hashes)]
+
+    t0 = time.perf_counter()
+    ham_big = phash_jax.hamming_matrix(hashes)
+    device_s = time.perf_counter() - t0
+
+    packed = np.frombuffer(b"".join(hashes), dtype=">u8")
+    popcnt = np.array([bin(i).count("1") for i in range(256)], np.uint16)
+    t0 = time.perf_counter()
+    cpu_rows = np.empty((n_hashes, n_hashes), np.uint16)
+    chunk = 512
+    for i in range(0, n_hashes, chunk):
+        x = packed[i:i + chunk, None] ^ packed[None, :]
+        cpu_rows[i:i + chunk] = popcnt[x.view(np.uint8).reshape(
+            x.shape[0], n_hashes, 8)].sum(-1, dtype=np.uint16)
+    cpu_s = time.perf_counter() - t0
+    assert (cpu_rows == ham_big).all(), "device Hamming mismatch vs CPU oracle"
+
+    pairs = n_hashes * n_hashes
+    return {
+        "name": "dedup: batched pHash + all-pairs Hamming",
+        "images": n,
+        "planted_dupes": planted,
+        "found_dup_pairs": dup_pairs,
+        "decode_s": round(decode_s, 2),
+        "hamming_n": n_hashes,
+        "device_mpairs_per_s": round(pairs / device_s / 1e6, 1),
+        "cpu1_mpairs_per_s": round(pairs / cpu_s / 1e6, 1),
+        "vs_cpu1": round(cpu_s / device_s, 3),
+        "vs_cpu16_projected": round(cpu_s / device_s / CPU_BASELINE_CORES, 3),
+    }
+
+
+def main() -> None:
+    from spacedrive_tpu.ops import configure_compilation_cache
+
+    configure_compilation_cache()
+    which = os.environ.get("SD_E2E_CONFIGS", "1,3,4,5").split(",")
+    n_files = int(os.environ.get("SD_E2E_FILES", "10000"))
+    n_images = int(os.environ.get("SD_E2E_IMAGES", "256"))
+    n_clips = int(os.environ.get("SD_E2E_CLIPS", "8"))
+
+    tmp = tempfile.mkdtemp(prefix="sd-bench-e2e-")
+    results: dict = {"host_cores": os.cpu_count(), "note": (
+        "cpu16 figures are 16x linear projections of the measured 1-core "
+        "CPU backend; this rig has a single CPU core and one tunneled "
+        "v5e chip"
+    )}
+    try:
+        t_all = time.perf_counter()
+        results["link_probe_gbps"] = round(probe_link(), 3)
+        if "1" in which:
+            results["config1"] = config_1(tmp, n_files)
+        if "3" in which:
+            results["config3"] = config_3(tmp, n_images)
+        if "4" in which:
+            results["config4"] = config_4(tmp, n_clips)
+        if "5" in which:
+            results["config5"] = config_5(tmp, n_images)
+        results["total_seconds"] = round(time.perf_counter() - t_all, 1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    doc = json.dumps(results, indent=2)
+    with open("BENCH_E2E.json", "w") as f:
+        f.write(doc + "\n")
+    print(doc, flush=True)
+
+
+if __name__ == "__main__":
+    main()

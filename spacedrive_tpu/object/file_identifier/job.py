@@ -35,8 +35,9 @@ logger = logging.getLogger(__name__)
 
 CHUNK_SIZE = 100            # ref:mod.rs:34 (CPU parity constant)
 DEVICE_CHUNK_SIZE = 1024    # device batches amortize dispatch overhead
-# (windows of 1024 pipeline: the next window's disk reads overlap the
-# current window's device hash — see execute_step's Prefetcher)
+PIPELINE_DEPTH = 3          # windows in flight: reads AND device
+# transfers for up to 3 windows overlap the current window's hash +
+# DB writes — see execute_step's WindowPipeline
 
 
 def orphan_where_clause(sub_path_mat: str | None = None) -> str:
@@ -58,7 +59,7 @@ class FileIdentifierJob(StatefulJob):
     NAME = "file_identifier"
     INVALIDATES = ("search.paths", "search.objects")
     IS_BATCHED = True
-    _prefetcher = None  # runtime-only double buffer (never serialized)
+    _pipeline = None  # runtime-only window pipeline (never serialized)
 
     async def init_job(self, ctx: JobContext) -> None:
         library = ctx.library
@@ -163,29 +164,34 @@ class FileIdentifierJob(StatefulJob):
     async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
         import asyncio
 
-        from ...parallel import Prefetcher
+        from ...parallel import WindowPipeline
 
         library = ctx.library
         d = self.data
-        if self._prefetcher is None:
-            self._prefetcher = Prefetcher()
+        if self._pipeline is None:
+            # The producer chains cursor windows back-to-back: window
+            # N+1's disk reads and device dispatch start as soon as N's
+            # reads finish, so up to PIPELINE_DEPTH transfers are in
+            # flight while this step's hashes complete and its DB writes
+            # run (SURVEY §7 hard part #2). Fetches are side-effect-free,
+            # so a pause/resume simply re-reads in-flight windows.
+            def fetch(cursor):
+                window = self._fetch_window(library, cursor)
+                rows = window[0]
+                if not rows:
+                    return None
+                return rows[-1]["id"], window
+
+            self._pipeline = WindowPipeline(
+                fetch, d["cursor"], depth=PIPELINE_DEPTH
+            )
 
         t0 = time.perf_counter()
-        cursor = d["cursor"]
-        rows, metas, messages, msg_rows, finisher = await asyncio.to_thread(
-            self._prefetcher.take,
-            cursor,
-            lambda: self._fetch_window(library, cursor),
-        )
-        if not rows:
+        window = await asyncio.to_thread(self._pipeline.take)
+        if window is None:
             return StepResult()
+        rows, metas, messages, msg_rows, finisher = window
         d["cursor"] = rows[-1]["id"]
-        # overlap: the next window's disk reads AND device dispatch run
-        # while this window's hashes complete (SURVEY §7 hard part #2)
-        next_cursor = d["cursor"]
-        self._prefetcher.submit(
-            next_cursor, lambda: self._fetch_window(library, next_cursor)
-        )
 
         cas_ids = await asyncio.to_thread(finisher)
         hash_time = time.perf_counter() - t0
@@ -289,14 +295,14 @@ class FileIdentifierJob(StatefulJob):
         return created, linked
 
     def cleanup(self) -> None:
-        """Every exit path (done/pause/cancel/fail) releases the
-        prefetch pool and keeps its stats."""
-        if self._prefetcher is not None:
-            stats = self._prefetcher.stats
+        """Every exit path (done/pause/cancel/fail) stops the window
+        pipeline and keeps its stats."""
+        if self._pipeline is not None:
+            stats = self._pipeline.stats
             self.run_metadata["prefetch_hits"] = stats.prefetch_hits
             self.run_metadata["prefetch_misses"] = stats.prefetch_misses
-            self._prefetcher.shutdown()
-            self._prefetcher = None
+            self._pipeline.close()
+            self._pipeline = None
 
     async def finalize(self, ctx: JobContext) -> Any:
         self.cleanup()

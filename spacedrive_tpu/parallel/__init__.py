@@ -5,7 +5,7 @@ maps to batch-parallel device meshes here; §7 hard part #2 — the
 host-side read pipeline that keeps the device fed.
 """
 
-from .feeder import PipelineStats, Prefetcher
+from .feeder import PipelineStats, WindowPipeline
 from .mesh import (
     AXES,
     batch_sharding,
@@ -20,7 +20,7 @@ from .mesh import (
 __all__ = [
     "AXES",
     "PipelineStats",
-    "Prefetcher",
+    "WindowPipeline",
     "batch_sharding",
     "factor3",
     "flat_mesh",

@@ -2,17 +2,23 @@
 
 SURVEY §7 hard part #2 ("feeding the beast"): on a 1M-file library the
 sampled reads (~56 KiB/file) dominate wall-clock, so the host must be
-reading batch N+1 while the device hashes batch N. `Prefetcher` is the
-double-buffer: a bounded thread pool runs the read stage for the next
-window while the caller consumes the current one; `PipelineStats`
-records overlap so jobs can report read vs compute time honestly
-(the reference's RunMetadata timing discipline,
+reading batch N+1 while the device hashes batch N.
+
+`WindowPipeline` is the mechanism: a producer thread walks a
+cursor-chained fetch function back-to-back (window N+1's reads start
+the moment N's reads finish, not when the consumer takes N) into a
+bounded queue of `depth` windows. Because each window's fetch also
+*dispatches* its device batch asynchronously, up to `depth` transfers
+ride the host→device link while earlier compute completes.
+
+`PipelineStats` records overlap so jobs can report read vs compute time
+honestly (the reference's RunMetadata timing discipline,
 ref:indexer/indexer_job.rs:76-88).
 """
 
 from __future__ import annotations
 
-import concurrent.futures
+import queue as _queue
 import threading
 import time
 from dataclasses import dataclass, field
@@ -29,46 +35,94 @@ class PipelineStats:
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
 
-class Prefetcher(Generic[T]):
-    """One-slot lookahead keyed by an opaque token (a cursor value):
-    `submit(key, fn)` schedules the next window's read stage;
-    `take(key)` returns it — immediately when the device outran the
-    disk, or after the residual wait otherwise."""
+class WindowPipeline(Generic[T]):
+    """Bounded multi-window producer pipeline.
 
-    def __init__(self, max_workers: int = 2):
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max_workers, thread_name_prefix="sd-prefetch"
-        )
-        self._slot: tuple[Any, concurrent.futures.Future] | None = None
+    `fetch(key)` returns `(next_key, window)` — or `None` when the
+    cursor is exhausted. A daemon producer thread chains fetches
+    back-to-back and parks up to `depth` ready windows; `take()` hands
+    them to the consumer in order (`None` = end of stream). `close()`
+    stops the producer promptly (it also aborts any blocked put), so
+    pause/cancel paths can't leak the thread; re-reading the in-flight
+    windows after a resume is the caller's contract (fetches must be
+    side-effect-free)."""
+
+    def __init__(
+        self,
+        fetch: Callable[[Any], "tuple[Any, T] | None"],
+        start_key: Any,
+        depth: int = 3,
+    ):
         self.stats = PipelineStats()
+        self._queue: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._fetch = fetch
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, args=(start_key,), name="sd-window-pipeline",
+            daemon=True,
+        )
+        self._thread.start()
 
-    def submit(self, key: Any, fn: Callable[[], T]) -> None:
-        self.cancel()  # one slot: a superseded prefetch is dropped
-        self._slot = (key, self._pool.submit(fn))
+    def _run(self, key: Any) -> None:
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                item = self._fetch(key)
+                with self.stats._lock:
+                    self.stats.read_time += time.perf_counter() - t0
+                if item is None:
+                    self._put(None)
+                    return
+                key, window = item
+                if not self._put(window):
+                    return
+        except BaseException as e:  # surfaced to the consumer on take()
+            self._error = e
+            self._put(None)
 
-    def take(self, key: Any, fallback: Callable[[], T]) -> T:
-        """The window for `key`, from the prefetch slot when it matches,
-        else computed inline via `fallback` (counted as a miss)."""
+    def _put(self, item) -> bool:
+        """Queue.put that aborts promptly when close() is called."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def take(self) -> T | None:
+        """Next window in order; None at end of stream (raises if the
+        producer died) or after close(). The time the consumer spent
+        blocked is recorded as a prefetch miss; instant handoffs count
+        as hits."""
         t0 = time.perf_counter()
-        slot = self._slot
-        if slot is not None and slot[0] == key:
-            self._slot = None
-            result = slot[1].result()
-            with self.stats._lock:
-                self.stats.prefetch_hits += 1
-                self.stats.read_time += time.perf_counter() - t0
-            return result
-        result = fallback()
+        while True:
+            try:
+                window = self._queue.get(timeout=0.1)
+                break
+            except _queue.Empty:
+                # close() may race a full queue (its sentinel is dropped
+                # on Full); poll the stop flag so a drained consumer
+                # can't block forever on a dead producer
+                if self._stop.is_set():
+                    window = None
+                    break
+        waited = time.perf_counter() - t0
         with self.stats._lock:
-            self.stats.prefetch_misses += 1
-            self.stats.read_time += time.perf_counter() - t0
-        return result
+            if waited < 0.002:
+                self.stats.prefetch_hits += 1
+            else:
+                self.stats.prefetch_misses += 1
+        if window is None and self._error is not None:
+            raise self._error
+        return window
 
-    def cancel(self) -> None:
-        if self._slot is not None:
-            self._slot[1].cancel()
-            self._slot = None
-
-    def shutdown(self) -> None:
-        self.cancel()
-        self._pool.shutdown(wait=False, cancel_futures=True)
+    def close(self) -> None:
+        self._stop.set()
+        # unblock a consumer waiting in take()
+        try:
+            self._queue.put_nowait(None)
+        except _queue.Full:
+            pass
+        self._thread.join(timeout=5)

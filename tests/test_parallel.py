@@ -14,7 +14,6 @@ import pytest
 
 from spacedrive_tpu.parallel import (
     AXES,
-    Prefetcher,
     batch_sharding,
     factor3,
     flat_mesh,
@@ -53,38 +52,6 @@ def test_multihost_init_noop_without_cluster():
 
     # no coordinator env: must be a clean no-op, never an exception
     assert multihost_init() is False
-
-
-def test_prefetcher_overlap_and_fallback():
-    pf = Prefetcher()
-    timeline = []
-
-    def slow_read(tag):
-        def run():
-            timeline.append(("start", tag, time.perf_counter()))
-            time.sleep(0.15)
-            timeline.append(("end", tag, time.perf_counter()))
-            return tag
-
-        return run
-
-    # miss: nothing prefetched yet
-    assert pf.take("a", slow_read("a")) == "a"
-    assert pf.stats.prefetch_misses == 1
-
-    # hit: submit "b", burn compute time, take should be ~instant
-    pf.submit("b", slow_read("b"))
-    time.sleep(0.2)  # the "device compute" window
-    t0 = time.perf_counter()
-    assert pf.take("b", slow_read("b-fallback")) == "b"
-    assert time.perf_counter() - t0 < 0.05  # read overlapped with compute
-    assert pf.stats.prefetch_hits == 1
-
-    # stale key falls back (and doesn't hand out the wrong window)
-    pf.submit("c", slow_read("c"))
-    assert pf.take("d", slow_read("d")) == "d"
-    assert pf.stats.prefetch_misses == 2
-    pf.shutdown()
 
 
 def test_identifier_pipelined_matches_oracle(tmp_path):
@@ -131,3 +98,66 @@ def test_identifier_pipelined_matches_oracle(tmp_path):
             await node.shutdown()
 
     asyncio.run(run())
+
+
+def test_window_pipeline_depth_order_and_errors():
+    from spacedrive_tpu.parallel import WindowPipeline
+
+    # ordering + exhaustion: windows arrive in cursor order, then None
+    fetched = []
+
+    def fetch(k):
+        if k >= 5:
+            return None
+        fetched.append(k)
+        return k + 1, f"w{k}"
+
+    pipe = WindowPipeline(fetch, 0, depth=2)
+    got = []
+    while (w := pipe.take()) is not None:
+        got.append(w)
+    assert got == [f"w{k}" for k in range(5)]
+    assert fetched == list(range(5))
+    pipe.close()
+
+    # depth bound: producer reads ahead at most depth windows + 1 in hand
+    started = []
+    release = threading.Event()
+
+    def slow_fetch(k):
+        if k >= 10:
+            return None
+        started.append(k)
+        release.wait(2)
+        return k + 1, k
+
+    pipe = WindowPipeline(slow_fetch, 0, depth=2)
+    time.sleep(0.3)
+    assert len(started) <= 1  # first fetch still blocked
+    release.set()
+    time.sleep(0.5)
+    # queue(2) full + one fetch in flight → at most 4 started, 0 taken
+    assert len(started) <= 4
+    assert pipe.take() == 0
+    pipe.close()
+
+    # error propagation: a raising fetch surfaces on take()
+    def bad_fetch(k):
+        if k == 1:
+            raise RuntimeError("disk on fire")
+        return k + 1, k
+
+    pipe = WindowPipeline(bad_fetch, 0, depth=2)
+    assert pipe.take() == 0
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        while pipe.take() is not None:
+            pass
+    pipe.close()
+
+    # close() while the producer is blocked on a full queue exits promptly
+    pipe = WindowPipeline(lambda k: (k + 1, k), 0, depth=1)
+    time.sleep(0.2)
+    t0 = time.perf_counter()
+    pipe.close()
+    assert time.perf_counter() - t0 < 2
+    assert not pipe._thread.is_alive()
