@@ -8,6 +8,11 @@ workload — a sharded cas_id BLAKE3 batch — with every digest verified
 against the host reference oracle. This is the CPU-mesh stand-in for
 the reference's NCCL/MPI-class comm backend (SURVEY §2.4) scaled past
 one process.
+
+The DEFAULT suite runs a shrunk variant (1 device per process, 4-row
+batch, 1-chunk messages, shared persistent compile cache) so a
+jax.distributed regression fails plain `pytest -q`; the full 2×2-device
+variant stays behind `-m slow`.
 """
 
 import os
@@ -23,29 +28,37 @@ _CHILD = r"""
 import sys
 sys.path.insert(0, "@REPO@")
 from spacedrive_tpu.utils.jaxenv import force_cpu_devices
-force_cpu_devices(2)  # 2 local devices per process -> 4 global
+
+pid = int(sys.argv[1])
+ndev = int(sys.argv[2])      # local devices per process
+B = int(sys.argv[3])         # global batch rows
+msg_len = int(sys.argv[4])
+max_chunks = int(sys.argv[5])
+
+force_cpu_devices(ndev)
 
 import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spacedrive_tpu.ops import configure_compilation_cache
 from spacedrive_tpu.parallel.mesh import multihost_init
 
-pid = int(sys.argv[1])
+configure_compilation_cache()  # warm repeats skip XLA compilation
 ok = multihost_init("@COORD@", num_processes=2, process_id=pid)
 assert ok, "multihost_init returned False"
 assert jax.process_count() == 2, jax.process_count()
 devices = jax.devices()
-assert len(devices) == 4, devices  # global view spans both processes
+assert len(devices) == 2 * ndev, devices  # global view spans both processes
 
 from spacedrive_tpu.ops import blake3_jax
 from spacedrive_tpu.ops.blake3_ref import blake3_hex
 
-B, CAP = 8, 2 * 1024
+CAP = max_chunks * 1024
 rng = np.random.default_rng(0)  # identical on both hosts
 msgs = rng.integers(0, 256, size=(B, CAP), dtype=np.uint8)
-lens = np.full((B,), 1500, np.int32)
-msgs[:, 1500:] = 0  # zero-pad beyond message length
+lens = np.full((B,), msg_len, np.int32)
+msgs[:, msg_len:] = 0  # zero-pad beyond message length
 
 mesh = Mesh(np.array(devices), ("dp",))
 sharding = NamedSharding(mesh, P("dp"))
@@ -55,7 +68,7 @@ garr = jax.make_array_from_callback(
 glens = jax.make_array_from_callback(
     (B,), NamedSharding(mesh, P("dp")), lambda idx: lens[idx]
 )
-words = blake3_jax.hash_batch(garr, glens, max_chunks=2)
+words = blake3_jax.hash_batch(garr, glens, max_chunks=max_chunks)
 
 from jax.experimental import multihost_utils
 
@@ -77,15 +90,16 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.slow
-def test_two_process_distributed_hash_batch():
+def _run_two_processes(ndev: int, batch: int, msg_len: int, max_chunks: int,
+                       timeout: int) -> None:
     coord = f"127.0.0.1:{_free_port()}"
     code = _CHILD.replace("@REPO@", REPO).replace("@COORD@", coord)
     env = {k: v for k, v in os.environ.items() if "AXON" not in k}
     env.pop("JAX_PLATFORMS", None)
+    args = [str(ndev), str(batch), str(msg_len), str(max_chunks)]
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", code, str(pid)],
+            [sys.executable, "-c", code, str(pid), *args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO,
         )
@@ -94,13 +108,33 @@ def test_two_process_distributed_hash_batch():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # salvage whatever each child printed so the failure is debuggable
+        if e.output:
+            outs.append(e.output if isinstance(e.output, str) else e.output.decode())
         for p in procs:
             p.kill()
+            try:
+                out, _ = p.communicate(timeout=10)
+                if out:
+                    outs.append(out)
+            except Exception:  # noqa: BLE001 - best-effort reap
+                pass
         pytest.fail("distributed processes hung:\n" + "\n".join(outs))
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"proc failed:\n{out[-3000:]}"
-    assert "all 8 sharded digests match" in outs[0]
-    assert "all 8 sharded digests match" in outs[1]
+    assert f"all {batch} sharded digests match" in outs[0]
+    assert f"all {batch} sharded digests match" in outs[1]
+
+
+def test_two_process_distributed_smoke():
+    """Default-suite guard: jax.distributed init + global mesh + sharded
+    hash, shrunk to 1 device/process and a 4-row 1-chunk batch."""
+    _run_two_processes(ndev=1, batch=4, msg_len=700, max_chunks=1, timeout=180)
+
+
+@pytest.mark.slow
+def test_two_process_distributed_hash_batch():
+    _run_two_processes(ndev=2, batch=8, msg_len=1500, max_chunks=2, timeout=420)
