@@ -333,6 +333,27 @@ def cmd_labeler(args: argparse.Namespace) -> int:
     or drop any `.onnx` classifier at <data-dir>/image_labeler/model.onnx.
     """
     labeler_dir = os.path.join(args.data_dir, "image_labeler")
+    if args.labeler_cmd == "provision":
+        from .models import provision
+
+        try:
+            classes = None
+            if args.classes:
+                with open(args.classes) as f:
+                    classes = [ln.strip() for ln in f if ln.strip()]
+            if args.src:
+                info = provision.import_artifact(
+                    args.src, labeler_dir, classes=classes
+                )
+            else:
+                url = args.url or provision.DEFAULT_MODEL_URL
+                print(f"downloading {url}…", file=sys.stderr, flush=True)
+                info = provision.fetch(url, labeler_dir, classes=classes)
+        except Exception as e:  # noqa: BLE001 - CLI contract: JSON + rc 1
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 1
+        print(json.dumps(info, indent=2))
+        return 0
     if args.labeler_cmd == "status":
         from .models.labeler_actor import ImageLabeler
 
@@ -465,6 +486,23 @@ def build_parser() -> argparse.ArgumentParser:
     lb = sub.add_parser("labeler", help="image-labeler model artifacts")
     lbs = lb.add_subparsers(dest="labeler_cmd", required=True)
     lbs.add_parser("status", help="show the provisioned model artifact")
+    lp = lbs.add_parser(
+        "provision",
+        help="install a pretrained model: download (default) or import a local file",
+    )
+    lp.add_argument(
+        "--from", dest="src",
+        help="local .onnx classifier or .npz checkpoint to import "
+             "(default: download --url)",
+    )
+    lp.add_argument(
+        "--url", default=None,
+        help="ONNX download URL (default: the official YOLOv8n release asset)",
+    )
+    lp.add_argument(
+        "--classes",
+        help="text file of class names, one per line (stored as classes.json)",
+    )
     lt = lbs.add_parser("train", help="train a checkpoint on a folder-per-class dataset")
     lt.add_argument("dataset", help="root dir: <root>/<class_name>/*.jpg")
     lt.add_argument("--out", help="checkpoint path (default: <data-dir>/image_labeler/weights.npz)")

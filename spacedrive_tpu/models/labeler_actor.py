@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import itertools
+import json
 import logging
 import os
 import secrets
@@ -220,6 +221,22 @@ class ImageLabeler:
         n_classes = int(jax.eval_shape(run, probe).shape[1])
         if n_classes != len(self.classes):
             self.classes = [f"class {i}" for i in range(n_classes)]
+        # provisioned class names (models/provision.py) override the
+        # positional defaults when the cardinality matches
+        names_path = os.path.join(self.data_dir, "classes.json")
+        if os.path.exists(names_path):
+            try:
+                with open(names_path) as f:
+                    names = json.load(f)
+                if isinstance(names, list) and len(names) == n_classes:
+                    self.classes = [str(c) for c in names]
+                else:
+                    logger.warning(
+                        "classes.json has %s names but the model has %d "
+                        "classes; ignoring", len(names), n_classes,
+                    )
+            except Exception:  # noqa: BLE001 - names are advisory
+                logger.exception("unreadable classes.json; ignoring")
 
     # --- API (ref:actor.rs new_batch / resume) --------------------------
 

@@ -1,0 +1,145 @@
+"""Labeler model provisioning — the reference's model-download flow.
+
+The reference can't label until it fetches a versioned YOLOv8 `.onnx`
+from its CDN (ref:crates/ai/src/image_labeler/model/yolov8.rs:45-88).
+Parity here, generalized for offline deployments:
+
+- `fetch(url)` downloads an ONNX classifier into the labeler dir (the
+  reference's path; needs egress).
+- `import_artifact(path)` installs a local `.onnx` (any classifier or
+  YOLO-family head the JAX ONNX runtime executes) or a `weights.npz`
+  LabelerNet checkpoint.
+
+Every install is VALIDATED before it lands: the model is loaded and a
+zero-image smoke inference runs through the actual inference path, so a
+broken file can never silently disable labeling at index time. Class
+names ride along in `classes.json` next to the model (consumed by
+`labeler_actor._load_onnx`); YOLO-style 80-class models default to the
+COCO vocabulary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import urllib.request
+
+# The reference pins its model by version name (yolov8.rs:45-60); the
+# official ultralytics release asset is the natural default source.
+DEFAULT_MODEL_URL = (
+    "https://github.com/ultralytics/assets/releases/download/v8.1.0/yolov8n.onnx"
+)
+
+
+class ProvisionError(Exception):
+    pass
+
+
+def _validate_onnx(path: str) -> dict:
+    """Load + smoke-infer through the real actor path; returns info.
+    `path` must be named model.onnx — the probe actor resolves it from
+    its directory exactly like a provisioned node would."""
+    from .labeler_actor import ImageLabeler
+
+    actor = ImageLabeler(os.path.dirname(path), use_device=False)
+    if not actor._ensure_model():
+        raise ProvisionError("model failed to load")
+    import numpy as np
+
+    probs = actor._infer_chunk(
+        np.zeros((1, actor.image_size, actor.image_size, 3), np.float32)
+    )
+    if probs.ndim != 2 or probs.shape[1] != len(actor.classes):
+        raise ProvisionError(
+            f"smoke inference returned {probs.shape}, expected "
+            f"[B, {len(actor.classes)}]"
+        )
+    return {
+        "classes": len(actor.classes),
+        "class_names": list(actor.classes),
+        "image_size": actor.image_size,
+        "batch_size": actor.batch_size,
+    }
+
+
+def _validate_checkpoint(path: str) -> dict:
+    from . import checkpoint
+
+    _params, meta = checkpoint.load(path)
+    return {
+        "classes": len(meta["classes"]),
+        "image_size": meta["image_size"],
+    }
+
+
+def import_artifact(
+    src: str, labeler_dir: str, classes: list[str] | None = None
+) -> dict:
+    """Validate `src` (.onnx or .npz) and install it as THE labeler
+    artifact. Returns an info dict (kind, path, classes, …)."""
+    os.makedirs(labeler_dir, exist_ok=True)
+    if src.endswith(".npz"):
+        if classes:
+            raise ProvisionError(
+                "--classes applies to ONNX imports; a checkpoint embeds "
+                "its own class names"
+            )
+        info = _validate_checkpoint(src)
+        dest = os.path.join(labeler_dir, "weights.npz")
+        if os.path.abspath(src) != os.path.abspath(dest):
+            shutil.copyfile(src, dest)
+        # resolve_artifact prefers model.onnx — a stale one would
+        # silently shadow the checkpoint just provisioned
+        for stale in ("model.onnx", "classes.json"):
+            p = os.path.join(labeler_dir, stale)
+            if os.path.exists(p):
+                os.unlink(p)
+        return {"kind": "checkpoint", "path": dest, **info}
+
+    # ONNX: validate from a scratch dir so a bad file never lands
+    with tempfile.TemporaryDirectory(prefix="sd-provision-") as tmp:
+        cand = os.path.join(tmp, "model.onnx")
+        shutil.copyfile(src, cand)
+        if classes:
+            with open(os.path.join(tmp, "classes.json"), "w") as f:
+                json.dump(classes, f)
+        info = _validate_onnx(cand)
+        if classes and len(classes) != info["classes"]:
+            raise ProvisionError(
+                f"model has {info['classes']} classes but --classes "
+                f"names {len(classes)}"
+            )
+        dest = os.path.join(labeler_dir, "model.onnx")
+        shutil.move(cand, dest)
+        cls_dest = os.path.join(labeler_dir, "classes.json")
+        if classes:
+            with open(cls_dest, "w") as f:
+                json.dump(classes, f)
+        elif os.path.exists(cls_dest):
+            os.unlink(cls_dest)  # stale names from a previous model
+    return {"kind": "onnx", "path": dest, **info}
+
+
+def fetch(url: str, labeler_dir: str, classes: list[str] | None = None,
+          timeout: float = 120.0) -> dict:
+    """Download an ONNX model (the reference's provisioning path) and
+    install it via `import_artifact`."""
+    os.makedirs(labeler_dir, exist_ok=True)
+    tmp = tempfile.NamedTemporaryFile(suffix=".onnx", delete=False)
+    try:
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                shutil.copyfileobj(resp, tmp)
+            tmp.close()
+        except Exception as e:  # noqa: BLE001 - network envs vary
+            raise ProvisionError(
+                f"download failed ({e}); offline deployments can provision "
+                "with `sdx labeler provision --from <model.onnx>` or train a "
+                "checkpoint with `sdx labeler train`"
+            ) from e
+        return import_artifact(tmp.name, labeler_dir, classes=classes)
+    finally:
+        tmp.close()
+        os.unlink(tmp.name)

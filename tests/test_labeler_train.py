@@ -254,3 +254,121 @@ def test_actor_onnx_artifact(tmp_path):
         await actor.shutdown()
 
     asyncio.run(run())
+
+
+def test_provision_onnx_then_index_labels_semantically(tmp_path):
+    """VERDICT r2 #3: fresh node + `sdx labeler provision` + media job ⇒
+    semantically correct label rows, through the CLI and actor path.
+
+    The provisioned ONNX is a hand-built dominant-color classifier
+    (channel means → Gemm), so red images MUST get the "red" label and
+    must NOT get "blue" — correctness is semantic, not just plumbing."""
+    import glob
+    import json
+    import sqlite3
+
+    import torch  # noqa: F401 - parity with sibling test imports
+
+    from spacedrive_tpu.cli import main
+    from spacedrive_tpu.models import onnx_proto as P
+
+    S = 32
+    # score_c = 8 * mean_c - 4  → sigmoid > 0.5 iff channel mean > 0.5
+    w = np.zeros((3, 3), np.float32)
+    np.fill_diagonal(w, 8.0)
+    b = np.full((3,), -4.0, np.float32)
+    nodes = [
+        P.make_node("GlobalAveragePool", ["x"], ["gap"]),
+        P.make_node("Flatten", ["gap"], ["f"]),
+        P.make_node("Gemm", ["f", "w", "b"], ["out"], transB=1),
+    ]
+    model = P.make_model(
+        nodes, [P.make_value_info("x", (2, 3, S, S))],
+        [P.make_value_info("out", (2, 3))], {"w": w, "b": b},
+    )
+    onnx_path = tmp_path / "color.onnx"
+    onnx_path.write_bytes(P.encode_model(model))
+    classes_txt = tmp_path / "classes.txt"
+    classes_txt.write_text("red\ngreen\nblue\n")
+
+    data_dir = str(tmp_path / "node")
+    rc = main([
+        "--data-dir", data_dir, "labeler", "provision",
+        "--from", str(onnx_path), "--classes", str(classes_txt),
+    ])
+    assert rc == 0
+    info = json.loads(
+        open(os.path.join(data_dir, "image_labeler", "classes.json")).read()
+    )
+    assert info == ["red", "green", "blue"]
+
+    from PIL import Image
+
+    corpus = tmp_path / "pics"
+    corpus.mkdir()
+    Image.new("RGB", (64, 64), (230, 25, 25)).save(corpus / "r.png")
+    Image.new("RGB", (64, 64), (20, 220, 30)).save(corpus / "g.png")
+    Image.new("RGB", (64, 64), (25, 25, 235)).save(corpus / "b.png")
+
+    rc = main(["--data-dir", data_dir, "index", str(corpus), "--no-p2p"])
+    assert rc == 0
+
+    db_path = glob.glob(os.path.join(data_dir, "libraries", "*.db"))[0]
+    conn = sqlite3.connect(db_path)
+    rows = conn.execute(
+        "SELECT fp.name, l.name FROM file_path fp "
+        "JOIN label_on_object lo ON lo.object_id = fp.object_id "
+        "JOIN label l ON l.id = lo.label_id WHERE fp.is_dir = 0"
+    ).fetchall()
+    conn.close()
+    got = {}
+    for fname, label in rows:
+        got.setdefault(fname, set()).add(label)
+    assert got["r"] == {"red"}, got
+    assert got["g"] == {"green"}, got
+    assert got["b"] == {"blue"}, got
+
+
+def test_provision_rejects_garbage_and_mismatched_classes(tmp_path):
+    from spacedrive_tpu.models import provision
+
+    bad = tmp_path / "model.onnx"
+    bad.write_bytes(b"not an onnx file")
+    with pytest.raises(Exception):
+        provision.import_artifact(str(bad), str(tmp_path / "dir"))
+    # labeler dir stays clean — a bad file never lands
+    assert not os.path.exists(tmp_path / "dir" / "model.onnx")
+
+    # offline fetch fails with the actionable hint, not a stack trace
+    with pytest.raises(provision.ProvisionError, match="offline deployments"):
+        provision.fetch(
+            "http://127.0.0.1:9/none.onnx", str(tmp_path / "dir"), timeout=2
+        )
+
+    # class-name cardinality mismatch is refused before install
+    from spacedrive_tpu.models import onnx_proto as P
+
+    w = np.zeros((3, 3), np.float32)
+    nodes = [
+        P.make_node("GlobalAveragePool", ["x"], ["gap"]),
+        P.make_node("Flatten", ["gap"], ["f"]),
+        P.make_node("Gemm", ["f", "w", "b"], ["out"], transB=1),
+    ]
+    m = P.make_model(
+        nodes, [P.make_value_info("x", (1, 3, 16, 16))],
+        [P.make_value_info("out", (1, 3))],
+        {"w": w, "b": np.zeros((3,), np.float32)},
+    )
+    good = tmp_path / "three.onnx"
+    good.write_bytes(P.encode_model(m))
+    with pytest.raises(provision.ProvisionError, match="--classes names 2"):
+        provision.import_artifact(
+            str(good), str(tmp_path / "dir2"), classes=["a", "b"]
+        )
+    assert not os.path.exists(tmp_path / "dir2" / "model.onnx")
+
+    # --classes with a checkpoint import is an explicit error
+    with pytest.raises(provision.ProvisionError, match="embeds"):
+        provision.import_artifact(
+            "whatever.npz", str(tmp_path / "dir3"), classes=["a"]
+        )
