@@ -84,6 +84,9 @@ def _root(r: Router) -> None:
             "device_model": accels[0]["kind"] if accels else "cpu",
             "accelerators": accels,
             "image_labeler_version": cfg.image_labeler_version,
+            "thumbnailer_background_percentage":
+                node.thumbnailer.background_percentage
+                if node.thumbnailer else 50,
         }
 
     @r.mutation("toggleFeatureFlag")

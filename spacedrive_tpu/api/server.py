@@ -55,6 +55,7 @@ class ApiServer:
         self.app.add_routes(
             [
                 web.get("/", self._index),
+                web.get("/static/{path:.*}", self._static),
                 web.get("/rspc/client.js", self._client_js),
                 web.get("/rspc/manifest", self._manifest),
                 web.post("/rspc/{key}", self._rspc_http),
@@ -91,6 +92,24 @@ class ApiServer:
             os.path.join(os.path.dirname(__file__), "static", "explorer.html"),
             headers={"Content-Type": "text/html; charset=utf-8"},
         )
+
+    async def _static(self, request: web.Request) -> web.StreamResponse:
+        """Explorer assets (traversal-guarded; .js/.css only)."""
+        root = os.path.abspath(os.path.join(os.path.dirname(__file__), "static"))
+        rel = request.match_info["path"]
+        full = os.path.abspath(os.path.join(root, rel))
+        if os.path.commonpath([full, root]) != root:
+            raise web.HTTPBadRequest(text="bad path")
+        if not os.path.isfile(full):
+            raise web.HTTPNotFound()
+        ctype = {
+            ".js": "application/javascript",
+            ".css": "text/css",
+            ".html": "text/html; charset=utf-8",
+        }.get(os.path.splitext(full)[1])
+        if ctype is None:
+            raise web.HTTPNotFound()
+        return web.FileResponse(full, headers={"Content-Type": ctype})
 
     async def _client_js(self, _request: web.Request) -> web.Response:
         """The generated JS client (ref:packages/client/src/core.ts is

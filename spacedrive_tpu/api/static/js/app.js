@@ -1,0 +1,177 @@
+// Explorer entry point: boot, library selection, nav, subscriptions,
+// keyboard navigation (role parity: ref:interface/app + apps/web entry).
+
+import client, { SdSocket } from "/rspc/client.js";
+import { $, bus, el, fmtBytes, state } from "/static/js/util.js";
+import { loadContent, moveSelection, openDir, setView, upDir } from "/static/js/views.js";
+import { closeInspector, select } from "/static/js/inspector.js";
+import { onJobProgress, renderJobs, wireJobsPanel } from "/static/js/jobs.js";
+import { openDropPanel, rejectPendingOffer, showDropOffer, wireDropPanel } from "/static/js/spacedrop.js";
+import { addLocationModal, wireSettingsPanel } from "/static/js/settings.js";
+import { showOnboarding } from "/static/js/onboarding.js";
+
+const sock = new SdSocket();
+let unsubJobs = null;
+
+// late-bound hooks for the other modules
+bus.select = select;
+bus.openDropPanel = openDropPanel;
+bus.loadContent = loadContent;
+bus.reloadLibraries = loadLibraries;
+bus.refreshNav = () => state.lib && refreshNav();
+bus.refreshHeader = async () => {
+  const ns = await client.nodeState();
+  $("device").textContent = `${ns.name} · ${ns.device_model}`;
+};
+
+// ---------- libraries / nav ----------
+export async function loadLibraries() {
+  const libs = await client.library.list();
+  if (!libs.length) { showOnboarding(); return; }
+  $("onboard").classList.remove("open");
+  const sel = $("libsel");
+  sel.innerHTML = "";
+  for (const l of libs) {
+    const o = el("option", "", l.config.name);
+    o.value = l.uuid; sel.appendChild(o);
+  }
+  sel.onchange = () => selectLibrary(sel.value);
+  const keep = libs.some(l => l.uuid === state.lib) ? state.lib : libs[0].uuid;
+  sel.value = keep;
+  // a rename/new-library invalidation must NOT reset browsing state
+  // when the selected library is unchanged — just refresh the chrome
+  if (keep === state.lib) await refreshNav();
+  else await selectLibrary(keep);
+  bus.refreshHeader();
+}
+
+async function selectLibrary(id) {
+  Object.assign(state, { lib:id, loc:null, tag:null, search:"", cursor:null,
+                         path:"/", mode:"browse", selected:null });
+  if (unsubJobs) unsubJobs();
+  unsubJobs = sock.subscribe("jobs.progress", onJobProgress, {libraryId:id});
+  await refreshNav();
+  loadContent(true);
+}
+
+async function refreshNav() {
+  const [locs, tags, stats] = await Promise.all([
+    client.locations.list(null, state.lib),
+    client.tags.list(null, state.lib),
+    client.library.statistics(null, state.lib),
+  ]);
+  state.locPaths = {};
+  state.locNames = {};
+  const locDiv = $("locs");
+  locDiv.innerHTML = "";
+  for (const n of locs.nodes) {
+    state.locPaths[n.id] = n.path;
+    state.locNames[n.id] = n.name || n.path;
+    const item = el("div", "item", "📂 " + (n.name || n.path));
+    item.onclick = () => { setActive(item);
+      Object.assign(state, {loc:n.id, tag:null, cursor:null, path:"/",
+                            mode:"browse"});
+      loadContent(true); };
+    locDiv.appendChild(item);
+  }
+  state.allTags = tags.nodes;
+  const tagDiv = $("tags");
+  tagDiv.innerHTML = "";
+  for (const n of tags.nodes) {
+    const item = el("div", "item", "🏷️ " + (n.name || "?"));
+    item.onclick = () => { setActive(item);
+      Object.assign(state, {tag:n.id, loc:null, cursor:null, mode:"browse"});
+      loadContent(true); };
+    tagDiv.appendChild(item);
+  }
+  const tools = $("tools");
+  tools.innerHTML = "";
+  const dup = el("div", "item", "♊ Duplicates");
+  dup.onclick = () => { setActive(dup);
+    Object.assign(state, {mode:"duplicates", loc:null, tag:null});
+    loadContent(true); };
+  tools.appendChild(dup);
+  $("stats").textContent =
+    `${stats.total_object_count} objects · ${fmtBytes(+stats.total_bytes_used)} indexed`;
+}
+
+function setActive(item) {
+  document.querySelectorAll("nav .item.active")
+    .forEach(e => e.classList.remove("active"));
+  if (item) item.classList.add("active");
+}
+
+// ---------- header wiring ----------
+document.querySelectorAll("#viewsw button").forEach(b =>
+  b.onclick = () => setView(b.dataset.view));
+$("search").addEventListener("keydown", (e) => {
+  if (e.key === "Enter") {
+    state.search = e.target.value;
+    state.mode = state.search ? "search" : "browse";
+    loadContent(true);
+  }
+  if (e.key === "Escape") e.target.blur();
+});
+$("btn-addloc").onclick = () => addLocationModal();
+wireJobsPanel();
+wireDropPanel();
+wireSettingsPanel();
+
+// ---------- keyboard navigation ----------
+const VIEWS = ["grid", "list", "media"];
+document.addEventListener("keydown", (e) => {
+  const typing = ["INPUT", "TEXTAREA", "SELECT"]
+    .includes(document.activeElement?.tagName);
+  if (typing) return;
+  switch (e.key) {
+    case "/":
+      e.preventDefault();
+      $("search").focus();
+      break;
+    case "ArrowRight": e.preventDefault(); moveSelection(1, 0); break;
+    case "ArrowLeft": e.preventDefault(); moveSelection(-1, 0); break;
+    case "ArrowDown": e.preventDefault(); moveSelection(0, 1); break;
+    case "ArrowUp": e.preventDefault(); moveSelection(0, -1); break;
+    case "j": moveSelection(1, 0); break;
+    case "k": moveSelection(-1, 0); break;
+    case "Enter":
+      if (state.selected?.is_dir) openDir(state.selected);
+      break;
+    case "Backspace": upDir(); break;
+    case "v":
+      setView(VIEWS[(VIEWS.indexOf(state.view) + 1) % VIEWS.length]);
+      break;
+    case "Escape":
+      // a pending spacedrop offer must be answered, not dismissed
+      if (rejectPendingOffer()) break;
+      document.querySelectorAll(".panel.open")
+        .forEach(p => p.classList.remove("open"));
+      $("modal-back").classList.remove("open");
+      closeInspector();
+      break;
+  }
+});
+
+// ---------- live events ----------
+sock.subscribe("p2p.events", (ev) => {
+  if (ev.kind === "SpacedropRequest") showDropOffer(ev);
+  if (ev.kind === "SpacedropProgress")
+    $("events").textContent = `📡 transfer ${ev.percent}%`;
+  if (ev.kind && ev.kind.startsWith("Peer") &&
+      $("drop-panel").classList.contains("open")) openDropPanel();
+});
+sock.subscribe("invalidation.listen", (ev) => {
+  $("events").textContent = `↻ ${ev.key}`;
+  if (["search.paths", "locations.list", "tags.list"].includes(ev.key))
+    loadContent(true);
+  if (ev.key === "locations.list" || ev.key === "tags.list") refreshNav();
+  if (ev.key === "library.list") loadLibraries();
+  if (ev.key === "jobs.reports" &&
+      $("jobs-panel").classList.contains("open")) renderJobs();
+});
+
+// ---------- boot ----------
+setView(state.view);
+loadLibraries().catch(e => {
+  $("stats").textContent = "error: " + e.message;
+});

@@ -1,0 +1,138 @@
+// Inspector panel: file details, favorite, note, tag chips + editor,
+// labels (role parity: ref:interface/app/$libraryId/Explorer/Inspector).
+
+import client from "/rspc/client.js";
+import { $, bus, el, fmtBytes, fullPath, state } from "/static/js/util.js";
+
+export function updateSelection() {
+  const sel = state.selected;
+  document.querySelectorAll("#content .card, #content tr[data-fp]")
+    .forEach(e => e.classList.toggle("selected",
+      sel != null && e.dataset.fp === String(sel.id)));
+}
+
+export async function select(n) {
+  state.selected = n;
+  updateSelection();
+  const insp = $("inspector");
+  insp.classList.add("open");
+  insp.innerHTML = "";
+  insp.appendChild(el("h3", "",
+    n.name + (n.extension ? "." + n.extension : "")));
+  const dl = el("dl");
+  const add = (k, v) => { if (v !== undefined && v !== null && v !== "") {
+    dl.appendChild(el("dt", "", k)); dl.appendChild(el("dd", "", String(v))); } };
+  add("kind", n.is_dir ? "folder" : (n.object_kind ?? ""));
+  add("size", n.is_dir ? "" : fmtBytes(n.size_in_bytes));
+  add("created", (n.date_created || "").slice(0, 19));
+  add("modified", (n.date_modified || "").slice(0, 19));
+  add("path", (n.materialized_path || "") + n.name);
+  add("cas_id", n.cas_id);
+  insp.appendChild(dl);
+
+  if (n.object_id) {
+    // favorite + note (files.setFavorite/setNote take the file_path id)
+    const favBtn = el("button", "",
+      n.object_favorite ? "★ favorited" : "☆ favorite");
+    favBtn.onclick = async () => {
+      n.object_favorite = n.object_favorite ? 0 : 1;
+      await client.files.setFavorite(
+        {id: n.id, favorite: !!n.object_favorite}, state.lib);
+      select(n);
+    };
+    insp.appendChild(favBtn);
+    insp.appendChild(el("h4", "", " "));
+    const note = el("textarea");
+    note.id = "note";
+    note.placeholder = "note…";
+    note.value = n.object_note || "";
+    note.onblur = async () => {
+      if (note.value !== (n.object_note || "")) {
+        n.object_note = note.value;
+        await client.files.setNote(
+          {id: n.id, note: note.value}, state.lib);
+      }
+    };
+    insp.appendChild(note);
+
+    // tags (chips + editor)
+    const tagHead = el("h4", "", "Tags");
+    tagHead.style.margin = "12px 0 4px";
+    insp.appendChild(tagHead);
+    const chipBox = el("div");
+    insp.appendChild(chipBox);
+    const myTags = (await client.tags.getForObject(n.object_id, state.lib)).nodes;
+    for (const t of myTags) {
+      const chip = el("span", "chip");
+      const dot = el("i", "dot");
+      dot.style.background = t.color || "#5a7bfc";
+      chip.appendChild(dot);
+      chip.appendChild(document.createTextNode(t.name || "?"));
+      const x = el("span", "x", "×");
+      x.onclick = async () => {
+        await client.tags.assign(
+          {tag_id: t.id, object_ids: [n.object_id], unassign: true}, state.lib);
+        select(n);
+      };
+      chip.appendChild(x);
+      chipBox.appendChild(chip);
+    }
+    const addBox = el("div", "addtag");
+    const sel = el("select");
+    sel.appendChild(el("option", "", "+ tag…"));
+    for (const t of state.allTags) {
+      if (myTags.some(m => m.id === t.id)) continue;
+      const o = el("option", "", t.name || "?");
+      o.value = t.id;
+      sel.appendChild(o);
+    }
+    const newOpt = el("option", "", "new tag…");
+    newOpt.value = "__new__";
+    sel.appendChild(newOpt);
+    sel.onchange = async () => {
+      if (sel.value === "__new__") {
+        const name = prompt("tag name");
+        if (!name) { sel.selectedIndex = 0; return; }
+        const color = "#" + Math.floor(Math.random()*0xffffff)
+          .toString(16).padStart(6, "0");
+        const tid = await client.tags.create({name, color}, state.lib);
+        await client.tags.assign(
+          {tag_id: tid, object_ids: [n.object_id]}, state.lib);
+      } else if (sel.value) {
+        await client.tags.assign(
+          {tag_id: +sel.value, object_ids: [n.object_id]}, state.lib);
+      }
+      const tags = await client.tags.list(null, state.lib);
+      state.allTags = tags.nodes;
+      bus.refreshNav?.();
+      select(n);
+    };
+    addBox.appendChild(sel);
+    insp.appendChild(addBox);
+
+    // labels (read-only; written by the image labeler)
+    const labels =
+      (await client.labels.getForObject(n.object_id, state.lib)).nodes;
+    if (labels.length) {
+      const lh = el("h4", "", "Labels");
+      lh.style.margin = "12px 0 4px";
+      insp.appendChild(lh);
+      const lb = el("div");
+      for (const l of labels)
+        lb.appendChild(el("span", "chip", l.name));
+      insp.appendChild(lb);
+    }
+
+    // spacedrop shortcut
+    const dropBtn = el("button", "", "📡 spacedrop this file");
+    dropBtn.style.marginTop = "12px";
+    dropBtn.onclick = () => bus.openDropPanel([fullPath(n)]);
+    insp.appendChild(dropBtn);
+  }
+}
+
+export function closeInspector() {
+  state.selected = null;
+  updateSelection();
+  $("inspector").classList.remove("open");
+}

@@ -1,0 +1,47 @@
+// Onboarding: first-run library creation + first location
+// (role parity: ref:interface/app/onboarding).
+
+import client from "/rspc/client.js";
+import { $, bus, el } from "/static/js/util.js";
+
+export function showOnboarding() {
+  const board = $("onboard");
+  board.classList.add("open");
+  const box = board.querySelector(".box");
+  box.innerHTML = "";
+  box.appendChild(el("h1", "", ""));
+  box.querySelector("h1").innerHTML = "Welcome to <b>spacedrive-tpu</b>";
+  box.appendChild(el("p", "",
+    "A library is the database that indexes your files. Create one to "
+    + "get started — you can add locations (folders to index) next."));
+  const name = el("input");
+  name.placeholder = "library name";
+  name.value = "My Library";
+  box.appendChild(name);
+  const path = el("input");
+  path.placeholder = "first location path (optional, e.g. /home/me/files)";
+  box.appendChild(path);
+  const err = el("div", "meta");
+  err.style.color = "var(--err)";
+  box.appendChild(err);
+  const actions = el("div", "modal-actions");
+  const go = el("button", "primary", "create library");
+  go.onclick = async () => {
+    if (!name.value) { err.textContent = "name required"; return; }
+    go.disabled = true;
+    try {
+      const lib = await client.library.create({name: name.value});
+      if (path.value) {
+        await client.locations.create({path: path.value}, lib.uuid);
+      }
+      board.classList.remove("open");
+      await bus.reloadLibraries?.();
+    } catch (e) {
+      err.textContent = e.message;
+      go.disabled = false;
+    }
+  };
+  actions.appendChild(go);
+  box.appendChild(actions);
+  name.focus();
+}

@@ -1,0 +1,197 @@
+// Settings panel: node, library, locations, features, volumes
+// (role parity: ref:interface/app/$libraryId/settings screens).
+
+import client from "/rspc/client.js";
+import { $, bus, el, fmtBytes, modal, state } from "/static/js/util.js";
+
+export async function renderSettings() {
+  const p = $("settings-panel");
+  p.innerHTML = "";
+  p.appendChild(el("h2", "", "Settings"));
+
+  const ns = await client.nodeState();
+
+  // --- node -----------------------------------------------------------
+  p.appendChild(el("h4", "", "This node"));
+  const nameRow = el("div", "row");
+  const nameIn = el("input");
+  nameIn.value = ns.name || "";
+  const nameBtn = el("button", "mini", "rename");
+  nameBtn.onclick = async () => {
+    await client.nodes.edit({name: nameIn.value});
+    bus.refreshHeader?.();
+  };
+  nameRow.appendChild(nameIn);
+  nameRow.appendChild(nameBtn);
+  p.appendChild(nameRow);
+
+  const bgRow = el("div", "row");
+  bgRow.appendChild(el("span", "", "background thumbnailing %"));
+  const bgIn = el("input");
+  bgIn.type = "number";
+  bgIn.min = 1; bgIn.max = 100;
+  bgIn.style.width = "70px";
+  bgIn.value = ns.thumbnailer_background_percentage ?? 50;
+  bgIn.onchange = () => client.nodes.updateThumbnailerPreferences(
+    {background_processing_percentage: +bgIn.value});
+  bgRow.appendChild(bgIn);
+  p.appendChild(bgRow);
+
+  for (const feat of ["filesOverP2P", "cloudSync"]) {
+    const row = el("div", "row");
+    row.appendChild(el("span", "", feat));
+    const cb = el("input");
+    cb.type = "checkbox";
+    cb.checked = (ns.features || []).includes(feat);
+    cb.onchange = () =>
+      client.toggleFeatureFlag({feature: feat, enabled: cb.checked});
+    row.appendChild(cb);
+    p.appendChild(row);
+  }
+
+  // --- library --------------------------------------------------------
+  p.appendChild(el("h4", "", "Library"));
+  const libs = await client.library.list();
+  const cur = libs.find(l => l.uuid === state.lib);
+  if (cur) {
+    const rn = el("div", "row");
+    const libIn = el("input");
+    libIn.value = cur.config.name;
+    const rb = el("button", "mini", "rename");
+    rb.onclick = async () => {
+      await client.library.edit({id: state.lib, name: libIn.value});
+      bus.reloadLibraries?.();
+    };
+    rn.appendChild(libIn);
+    rn.appendChild(rb);
+    p.appendChild(rn);
+
+    const act = el("div", "row");
+    const newBtn = el("button", "mini", "+ new library");
+    newBtn.onclick = () => createLibraryModal();
+    const delBtn = el("button", "mini danger", "delete library");
+    delBtn.onclick = () => modal("Delete library?", (m, close) => {
+      m.appendChild(el("p", "meta",
+        `“${cur.config.name}” and its index will be removed (files on `
+        + "disk are untouched)."));
+      const actions = el("div", "modal-actions");
+      const cancel = el("button", "", "cancel");
+      cancel.onclick = close;
+      const go = el("button", "danger", "delete");
+      go.onclick = async () => {
+        await client.library.delete({id: state.lib});
+        close();
+        bus.reloadLibraries?.();
+      };
+      actions.appendChild(cancel); actions.appendChild(go);
+      m.appendChild(actions);
+    });
+    act.appendChild(newBtn);
+    act.appendChild(delBtn);
+    p.appendChild(act);
+  }
+
+  // --- locations ------------------------------------------------------
+  p.appendChild(el("h4", "", "Locations"));
+  const locs = await client.locations.list(null, state.lib);
+  for (const n of locs.nodes) {
+    const row = el("div", "loc-row");
+    row.appendChild(el("b", "", n.name || n.path));
+    row.appendChild(el("div", "meta", n.path));
+    const act = el("div", "actions");
+    const rescan = el("button", "mini", "rescan");
+    rescan.onclick = async () => {
+      await client.locations.fullRescan(
+        {location_id: n.id, reidentify_objects: false}, state.lib);
+      rescan.textContent = "rescanning…";
+    };
+    const del = el("button", "mini danger", "remove");
+    del.onclick = async () => {
+      await client.locations.delete(n.id, state.lib);
+      renderSettings();
+      bus.refreshNav?.();
+    };
+    act.appendChild(rescan);
+    act.appendChild(del);
+    row.appendChild(act);
+    p.appendChild(row);
+  }
+  const addBtn = el("button", "", "+ add location");
+  addBtn.onclick = () => addLocationModal();
+  p.appendChild(addBtn);
+
+  // --- volumes --------------------------------------------------------
+  p.appendChild(el("h4", "", "Volumes"));
+  const vols = await client.volumes.list();
+  for (const v of vols) {
+    const row = el("div", "row");
+    row.appendChild(el("span", "", `${v.name || v.mount_point}`));
+    row.appendChild(el("span", "meta",
+      `${fmtBytes(v.available_capacity)} free of ${fmtBytes(v.total_capacity)}`));
+    p.appendChild(row);
+  }
+}
+
+export function addLocationModal() {
+  modal("Add location", (m, close) => {
+    m.appendChild(el("p", "meta",
+      "absolute path of a directory to index and watch"));
+    const path = el("input");
+    path.placeholder = "/path/to/files";
+    m.appendChild(path);
+    const name = el("input");
+    name.placeholder = "display name (optional)";
+    m.appendChild(name);
+    const err = el("div", "meta");
+    err.style.color = "var(--err)";
+    m.appendChild(err);
+    const actions = el("div", "modal-actions");
+    const cancel = el("button", "", "cancel");
+    cancel.onclick = close;
+    const go = el("button", "primary", "add & index");
+    go.onclick = async () => {
+      try {
+        await client.locations.create(
+          {path: path.value, name: name.value || null}, state.lib);
+        close();
+        bus.refreshNav?.();
+      } catch (e) {
+        err.textContent = e.message;
+      }
+    };
+    actions.appendChild(cancel); actions.appendChild(go);
+    m.appendChild(actions);
+    path.focus();
+  });
+}
+
+export function createLibraryModal() {
+  modal("New library", (m, close) => {
+    const name = el("input");
+    name.placeholder = "library name";
+    m.appendChild(name);
+    const actions = el("div", "modal-actions");
+    const cancel = el("button", "", "cancel");
+    cancel.onclick = close;
+    const go = el("button", "primary", "create");
+    go.onclick = async () => {
+      if (!name.value) return;
+      await client.library.create({name: name.value});
+      close();
+      bus.reloadLibraries?.();
+    };
+    actions.appendChild(cancel); actions.appendChild(go);
+    m.appendChild(actions);
+    name.focus();
+  });
+}
+
+export function wireSettingsPanel() {
+  $("btn-settings").onclick = () => {
+    const p = $("settings-panel");
+    $("jobs-panel").classList.remove("open");
+    $("drop-panel").classList.remove("open");
+    p.classList.toggle("open");
+    if (p.classList.contains("open")) renderSettings();
+  };
+}

@@ -1,0 +1,102 @@
+// Spacedrop panel: peer list, staged sends, incoming offer modal
+// (role parity: ref:core/src/p2p/operations/spacedrop.rs UI flow).
+
+import client from "/rspc/client.js";
+import { $, el, fmtBytes } from "/static/js/util.js";
+
+let dropQueue = [];  // file paths staged for sending
+
+export async function openDropPanel(paths) {
+  if (paths) dropQueue = paths;
+  $("jobs-panel").classList.remove("open");
+  $("settings-panel").classList.remove("open");
+  const p = $("drop-panel");
+  p.classList.add("open");
+  const st = await client.p2p.state();
+  $("drop-self").textContent = st.enabled
+    ? `this node: ${st.identity.slice(0, 20)}…` : "p2p disabled";
+  $("drop-status").textContent = dropQueue.length
+    ? `ready to send: ${dropQueue.map(x => x.split("/").pop()).join(", ")}`
+    : "select a file → “spacedrop this file”, then pick a peer";
+  const peers = $("peers");
+  peers.innerHTML = "";
+  for (const peer of st.peers || []) {
+    const row = el("div", "peer");
+    const label = el("div", "",
+      `${peer.metadata?.name || "node"} · ${peer.identity.slice(0, 16)}…` +
+      (peer.connected ? " ✓" : ""));
+    row.appendChild(label);
+    const send = el("button", dropQueue.length ? "primary" : "", "send");
+    send.disabled = !dropQueue.length;
+    send.onclick = async () => {
+      try {
+        $("drop-status").textContent = "sending…";
+        await client.p2p.spacedrop(
+          {identity: peer.identity, file_paths: dropQueue});
+        $("drop-status").textContent = "✓ sent";
+        dropQueue = [];
+      } catch (e) {
+        $("drop-status").textContent = "✗ " + e.message;
+      }
+    };
+    row.appendChild(send);
+    peers.appendChild(row);
+  }
+  if (!(st.peers || []).length)
+    peers.appendChild(el("div", "meta", "no peers discovered yet"));
+}
+
+let pendingOffer = null;  // offer id awaiting accept/reject
+
+/** Escape on a pending offer = explicit reject (a dismissed modal
+ *  would strand the sender). Returns true if an offer was handled. */
+export function rejectPendingOffer() {
+  if (pendingOffer == null) return false;
+  const id = pendingOffer;
+  pendingOffer = null;
+  client.p2p.rejectSpacedrop(id).catch(() => {});
+  $("modal-back").classList.remove("open");
+  return true;
+}
+
+export function showDropOffer(ev) {
+  const back = $("modal-back");
+  const modal = $("modal");
+  pendingOffer = ev.id;
+  modal.innerHTML = "";
+  modal.appendChild(el("h2", "", "Incoming Spacedrop"));
+  modal.appendChild(el("div", "meta", `from ${ev.peer.slice(0, 24)}…`));
+  const list = el("div");
+  list.style.margin = "8px 0";
+  for (const f of ev.files) list.appendChild(el("div", "", "• " + f));
+  modal.appendChild(list);
+  modal.appendChild(el("div", "meta", fmtBytes(ev.total_size)));
+  const dir = el("input");
+  dir.placeholder = "target directory (blank = default)";
+  modal.appendChild(dir);
+  const actions = el("div", "modal-actions");
+  const reject = el("button", "danger", "reject");
+  reject.onclick = async () => {
+    pendingOffer = null;
+    await client.p2p.rejectSpacedrop(ev.id);
+    back.classList.remove("open");
+  };
+  const accept = el("button", "primary", "accept");
+  accept.onclick = async () => {
+    pendingOffer = null;
+    await client.p2p.acceptSpacedrop(
+      {id: ev.id, target_dir: dir.value || null});
+    back.classList.remove("open");
+  };
+  actions.appendChild(reject); actions.appendChild(accept);
+  modal.appendChild(actions);
+  back.classList.add("open");
+}
+
+export function wireDropPanel() {
+  $("btn-drop").onclick = () => {
+    const p = $("drop-panel");
+    if (p.classList.contains("open")) p.classList.remove("open");
+    else openDropPanel();
+  };
+}

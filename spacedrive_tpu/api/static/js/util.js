@@ -1,0 +1,54 @@
+// Shared state + tiny DOM helpers (role parity: packages/client stores).
+
+export const KIND_ICON = {0:"📄",1:"📑",2:"📁",3:"📝",4:"📦",5:"🖼️",6:"🎵",
+                          7:"🎬",8:"🗜️",9:"⚙️",10:"🔗",11:"🔒",12:"🔑",
+                          13:"🔗",14:"🌐"};
+
+export const state = {
+  lib: null, loc: null, tag: null, search: "", cursor: null,
+  path: "/",                       // materialized path inside the location
+  mode: "browse",                  // browse | search | duplicates
+  view: localStorage.getItem("sd-view") || "grid",
+  nodes: [], selected: null, locPaths: {}, locNames: {}, allTags: [],
+};
+
+// late-bound cross-module calls (registered by app.js; avoids cycles)
+export const bus = {};
+
+export function el(tag, cls, text) {
+  const e = document.createElement(tag);
+  if (cls) e.className = cls;
+  if (text !== undefined) e.textContent = text;
+  return e;
+}
+
+export const $ = (id) => document.getElementById(id);
+
+export function fmtBytes(n) {
+  if (!n && n !== 0) return "";
+  const u = ["B","KB","MB","GB","TB"]; let i = 0;
+  while (n >= 1024 && i < u.length-1) { n /= 1024; i++; }
+  return n.toFixed(n < 10 && i ? 1 : 0) + " " + u[i];
+}
+
+export const thumbUrl = (n) =>
+  `/spacedrive/thumbnail/${state.lib}/${n.cas_id.slice(0,3)}/${n.cas_id}.webp`;
+
+export const fullPath = (n) => {
+  const base = state.locPaths[n.location_id] || "";
+  return base + (n.materialized_path || "/") + n.name +
+         (n.extension ? "." + n.extension : "");
+};
+
+/** Simple modal helper: body builder receives the modal element and a
+ *  close function; returns close. */
+export function modal(title, build) {
+  const back = $("modal-back");
+  const m = $("modal");
+  m.innerHTML = "";
+  m.appendChild(el("h2", "", title));
+  const close = () => back.classList.remove("open");
+  build(m, close);
+  back.classList.add("open");
+  return close;
+}

@@ -1,0 +1,234 @@
+// Content area: grid/list/media views, breadcrumbs + directory
+// drill-down, pagination, duplicates groups
+// (role parity: ref:interface/app/$libraryId/Explorer views).
+
+import client from "/rspc/client.js";
+import { $, KIND_ICON, bus, el, fmtBytes, state, thumbUrl } from "/static/js/util.js";
+
+export function setView(view) {
+  state.view = view;
+  localStorage.setItem("sd-view", view);
+  document.querySelectorAll("#viewsw button").forEach(b =>
+    b.classList.toggle("active", b.dataset.view === view));
+  loadContent(true);
+}
+
+let loadSeq = 0;  // drop stale responses when loads overlap
+
+export async function loadContent(reset) {
+  if (state.mode === "duplicates") return loadDuplicates();
+  if (reset) { state.cursor = null; state.nodes = []; }
+  const seq = ++loadSeq;
+  const before = state.nodes.length;
+  const filter = {};
+  if (state.mode === "search") {
+    if (state.search) filter.search = state.search;
+    if (state.loc) filter.locationId = state.loc;
+  } else {
+    if (state.loc) {
+      filter.locationId = state.loc;
+      filter.path = state.path;     // non-recursive directory listing
+    }
+  }
+  if (state.tag) filter.tags = [state.tag];
+  if (state.view === "media") filter.kinds = [5, 7];
+  const page = await client.search.paths(
+    {filter, take: 60, cursor: state.cursor}, state.lib);
+  if (seq !== loadSeq) return;  // a newer load superseded this one
+  state.cursor = page.cursor;
+  state.nodes = state.nodes.concat(page.nodes);
+  renderCrumbs();
+  if (before === 0) render();
+  else appendFrom(before);  // keep scroll position on "load more"
+}
+
+export function renderCrumbs() {
+  const c = $("crumbs");
+  c.innerHTML = "";
+  const seg = (label, onclick) => {
+    const s = el("span", "seg", label);
+    s.onclick = onclick;
+    c.appendChild(s);
+  };
+  if (state.mode === "search") {
+    c.appendChild(el("span", "", `search: “${state.search}”`));
+    const back = el("button", "mini", "clear");
+    back.style.marginLeft = "8px";
+    back.onclick = () => { state.mode = "browse"; state.search = "";
+      $("search").value = ""; loadContent(true); };
+    c.appendChild(back);
+    return;
+  }
+  if (state.mode === "duplicates") {
+    c.appendChild(el("span", "", "duplicate groups (cas_id exact match)"));
+    return;
+  }
+  if (state.tag) {
+    c.appendChild(el("span", "", "tagged files"));
+    return;
+  }
+  if (!state.loc) {
+    c.appendChild(el("span", "", "select a location"));
+    return;
+  }
+  seg("📂 " + (state.locNames[state.loc] || "location"), () => {
+    state.path = "/"; loadContent(true);
+  });
+  const parts = state.path.split("/").filter(Boolean);
+  let acc = "/";
+  for (const p of parts) {
+    c.appendChild(el("span", "sep", "›"));
+    acc += p + "/";
+    const target = acc;
+    seg(p, () => { state.path = target; loadContent(true); });
+  }
+}
+
+export function openDir(n) {
+  state.path = (n.materialized_path || "/") + n.name + "/";
+  state.selected = null;
+  loadContent(true);
+}
+
+export function upDir() {
+  if (state.mode !== "browse" || !state.loc || state.path === "/") return;
+  const parts = state.path.split("/").filter(Boolean);
+  parts.pop();
+  state.path = "/" + parts.map(p => p + "/").join("");
+  if (state.path === "") state.path = "/";
+  loadContent(true);
+}
+
+function render() {
+  const c = $("content");
+  c.className = state.view;
+  c.innerHTML = "";
+  appendFrom(0);
+}
+
+function appendFrom(start) {
+  const c = $("content");
+  $("more")?.remove();
+  let listBody = c.querySelector("table.listing");
+  if (state.view === "list") {
+    if (!listBody) {
+      listBody = el("table", "listing");
+      const head = el("tr");
+      for (const h of ["Name", "Kind", "Size", "Modified", "Path"])
+        head.appendChild(el("th", "", h));
+      listBody.appendChild(head);
+      c.appendChild(listBody);
+    }
+    renderListRows(listBody, state.nodes.slice(start));
+  } else {
+    renderCards(c, state.view === "media", state.nodes.slice(start));
+  }
+  if (state.cursor) {
+    const btn = el("button", "", "load more");
+    btn.id = "more";
+    btn.onclick = () => loadContent(false);
+    c.appendChild(btn);
+  }
+}
+
+function activate(n) {
+  if (n.is_dir) openDir(n);
+  else bus.select(n);
+}
+
+function renderCards(c, mediaOnly, nodes) {
+  for (const n of nodes) {
+    if (mediaOnly && ![5,7].includes(n.object_kind)) continue;
+    const card = el("div", "card");
+    card.dataset.fp = String(n.id);
+    if (state.selected && state.selected.id === n.id)
+      card.classList.add("selected");
+    const thumb = el("div", "thumb");
+    if (n.cas_id && [5,7].includes(n.object_kind)) {
+      const img = el("img");
+      img.loading = "lazy";
+      img.src = thumbUrl(n);
+      img.onerror = () => { thumb.textContent = KIND_ICON[n.object_kind] || "📄"; };
+      thumb.appendChild(img);
+    } else {
+      thumb.textContent = n.is_dir ? "📁" : (KIND_ICON[n.object_kind] || "📄");
+    }
+    card.appendChild(thumb);
+    card.appendChild(el("div", "name",
+      n.name + (n.extension ? "." + n.extension : "")));
+    card.appendChild(el("div", "meta",
+      n.is_dir ? "folder" : fmtBytes(n.size_in_bytes)));
+    card.onclick = () => bus.select(n);
+    card.ondblclick = () => activate(n);
+    c.appendChild(card);
+  }
+}
+
+function renderListRows(table, nodes) {
+  for (const n of nodes) {
+    const tr = el("tr");
+    tr.dataset.fp = String(n.id);
+    if (state.selected && state.selected.id === n.id)
+      tr.classList.add("selected");
+    const icon = n.is_dir ? "📁" : (KIND_ICON[n.object_kind] || "📄");
+    tr.appendChild(el("td", "",
+      `${icon} ${n.name}${n.extension ? "." + n.extension : ""}`));
+    tr.appendChild(el("td", "", n.is_dir ? "folder" : (n.extension || "")));
+    tr.appendChild(el("td", "", n.is_dir ? "" : fmtBytes(n.size_in_bytes)));
+    tr.appendChild(el("td", "", (n.date_modified || "").slice(0, 16)));
+    tr.appendChild(el("td", "", n.materialized_path || ""));
+    tr.onclick = () => bus.select(n);
+    tr.ondblclick = () => activate(n);
+    table.appendChild(tr);
+  }
+}
+
+// ---------- duplicates (config-5 flow surfaced in the UI) ----------
+async function loadDuplicates() {
+  renderCrumbs();
+  const c = $("content");
+  c.className = "";
+  c.innerHTML = "";
+  c.appendChild(el("div", "meta", "scanning…"));
+  const groups = await client.search.duplicates({threshold: 8}, state.lib);
+  c.innerHTML = "";
+  if (!groups.length) {
+    const box = el("div", "dupgroup");
+    box.appendChild(el("div", "meta", "no duplicate groups found"));
+    c.appendChild(box);
+    return;
+  }
+  for (const g of groups) {
+    const box = el("div", "dupgroup");
+    box.appendChild(el("b", "",
+      `${g.files.length} files (${g.kind === "exact" ? "identical" : "near-duplicate"})`));
+    const files = el("div", "files");
+    for (const p of g.files) {
+      files.appendChild(el("div", "meta",
+        `${p.materialized_path || "/"}${p.name}`
+        + `${p.extension ? "." + p.extension : ""} · ${fmtBytes(p.size_in_bytes)}`));
+    }
+    box.appendChild(files);
+    c.appendChild(box);
+  }
+}
+
+// ---------- keyboard navigation ----------
+export function moveSelection(dx, dy) {
+  const nodes = state.nodes;
+  if (!nodes.length) return;
+  let idx = state.selected
+    ? nodes.findIndex(n => n.id === state.selected.id) : -1;
+  let cols = 1;
+  if (state.view !== "list") {
+    const c = $("content");
+    const card = c.querySelector(".card");
+    if (card) cols = Math.max(1, Math.floor(
+      c.clientWidth / (card.offsetWidth + 12)));
+  }
+  const delta = dx + dy * cols;
+  idx = idx < 0 ? 0 : Math.max(0, Math.min(nodes.length - 1, idx + delta));
+  bus.select(nodes[idx]);
+  document.querySelector(`#content [data-fp="${nodes[idx].id}"]`)
+    ?.scrollIntoView({block: "nearest"});
+}

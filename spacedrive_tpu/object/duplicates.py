@@ -159,4 +159,26 @@ def find_duplicates(library: Any, threshold: int = 8) -> list[dict[str, Any]]:
                 "kind": "exact",
             }
         )
+    # enrich with the file_path rows so clients can render the groups
+    from ..db.database import blob_u64
+
+    all_ids = sorted({oid for g in out for oid in g["object_ids"]})
+    by_object: dict[int, list[dict[str, Any]]] = {}
+    for off in range(0, len(all_ids), 900):  # SQLite bind-variable limit
+        chunk = all_ids[off:off + 900]
+        qmarks = ",".join("?" * len(chunk))
+        for row in library.db.query(
+            f"SELECT object_id, name, extension, materialized_path, cas_id, "
+            f"size_in_bytes_bytes FROM file_path WHERE object_id IN ({qmarks})",
+            tuple(chunk),
+        ):
+            by_object.setdefault(row["object_id"], []).append({
+                "name": row["name"],
+                "extension": row["extension"],
+                "materialized_path": row["materialized_path"],
+                "cas_id": row["cas_id"],
+                "size_in_bytes": blob_u64(row["size_in_bytes_bytes"]) or 0,
+            })
+    for g in out:
+        g["files"] = [f for oid in g["object_ids"] for f in by_object.get(oid, [])]
     return out

@@ -64,9 +64,10 @@ class Thumbnailer:
         self.use_device = use_device
         cores = os.cpu_count() or 1
         self._fg_parallelism = cores
-        self._bg_parallelism = max(
-            1, cores * max(0, min(100, background_processing_percentage)) // 100
+        self.background_percentage = max(
+            0, min(100, background_processing_percentage)
         )
+        self._bg_parallelism = max(1, cores * self.background_percentage // 100)
         self._fg: collections.deque[Batch] = collections.deque()  # LIFO
         self._bg: collections.deque[Batch] = collections.deque()  # FIFO
         self._current: Batch | None = None  # in-flight (for persistence)
@@ -143,7 +144,8 @@ class Thumbnailer:
         """Re-derive background parallelism from a percentage of cores
         (ref:actor.rs:98 `background_processing_percentage` update)."""
         cores = os.cpu_count() or 1
-        self._bg_parallelism = max(1, cores * max(0, min(100, pct)) // 100)
+        self.background_percentage = max(0, min(100, pct))
+        self._bg_parallelism = max(1, cores * self.background_percentage // 100)
 
     def new_indexed_thumbnails_batch(
         self,
