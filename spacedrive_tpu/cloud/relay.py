@@ -50,16 +50,25 @@ class CloudRelay:
         )
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
+        # WAN P2P rendezvous (p2p/relay.py): relayed Spacedrop /
+        # files-over-P2P for non-LAN peers, not just sync
+        from ..p2p.relay import RelayServer
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self.p2p_relay = RelayServer()
+        self.p2p_port: int | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    p2p_port: int = 0) -> int:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        self.p2p_port = await self.p2p_relay.start(host, p2p_port)
         return self.port
 
     async def shutdown(self) -> None:
+        await self.p2p_relay.shutdown()
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

@@ -57,12 +57,14 @@ class NodeConfigP2P:
     enabled: bool = True
     port: int = 0
     discovery: P2PDiscoveryState = P2PDiscoveryState.EVERYONE
+    relay: str | None = None  # "host:port" WAN relay rendezvous (optional)
 
     def to_dict(self) -> dict[str, Any]:
         return {
             "enabled": self.enabled,
             "port": self.port,
             "discovery": self.discovery.value,
+            "relay": self.relay,
         }
 
     @classmethod
@@ -71,6 +73,7 @@ class NodeConfigP2P:
             enabled=bool(d.get("enabled", True)),
             port=int(d.get("port", 0)),
             discovery=P2PDiscoveryState(d.get("discovery", "everyone")),
+            relay=d.get("relay") or None,
         )
 
 

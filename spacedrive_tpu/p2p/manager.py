@@ -63,6 +63,22 @@ class P2PManager:
                 bind_port=0 if self._beacon_addrs is not None else 41841,
             )
             await mdns.start()
+        if cfg.p2p.relay:
+            host, _, port_s = cfg.p2p.relay.rpartition(":")
+            if not host or not port_s.isdigit():
+                logger.error(
+                    "p2p.relay %r is not \"host:port\" (IPv6: \"[::1]:7000\")"
+                    " — WAN relay disabled", cfg.p2p.relay,
+                )
+            else:
+                from .relay import RelayClient
+
+                relay = RelayClient(
+                    self.p2p, (host.strip("[]"), int(port_s)),
+                    self.p2p._on_stream,
+                )
+                await relay.start()
+                self.p2p.register_discovery(relay)
         for lib in self.node.libraries.libraries.values():
             self.register_library(lib)
 

@@ -32,6 +32,7 @@ class Peer:
     discovered_by: set[str] = field(default_factory=set)
     last_seen: float = 0.0
     active_connections: int = 0
+    relayed: bool = False  # reachable through the WAN relay (p2p/relay.py)
 
     @property
     def is_connected(self) -> bool:
@@ -39,7 +40,7 @@ class Peer:
 
     @property
     def is_discovered(self) -> bool:
-        return bool(self.addrs)
+        return bool(self.addrs) or self.relayed
 
 
 StreamHandler = Callable[[EncryptedStream], Awaitable[None]]
@@ -58,6 +59,8 @@ class P2P:
         self.listener: Listener | None = None
         self._handler: StreamHandler | None = None
         self._discovery: list[Any] = []
+        # relayed dialing fallback, set by p2p/relay.py RelayClient
+        self.relay_dial: Callable[[RemoteIdentity], Awaitable[EncryptedStream]] | None = None
 
     # --- listener ------------------------------------------------------
 
@@ -132,29 +135,40 @@ class P2P:
     async def new_stream(
         self, identity: RemoteIdentity, timeout: float = 10.0
     ) -> EncryptedStream:
-        """Open a fresh authenticated unicast stream to a discovered peer
-        (ref:p2p2 `Peer::new_stream`)."""
+        """Open a fresh authenticated unicast stream to a discovered
+        peer: direct LAN addresses first, then the WAN relay fallback
+        (ref:p2p2 `Peer::new_stream`; relayed parity with
+        quic/transport.rs:212,344)."""
         peer = self.peers.get(identity)
-        if peer is None or not peer.addrs:
+        if peer is None or not peer.is_discovered:
             raise ConnectionError(f"peer {identity} not discovered")
+
+        def adopt(stream: EncryptedStream) -> EncryptedStream:
+            peer.active_connections += 1
+            orig_close = stream.close
+
+            async def close(_orig=orig_close, _peer=peer):
+                _peer.active_connections -= 1
+                await _orig()
+
+            stream.close = close  # type: ignore[method-assign]
+            return stream
+
         last_err: Exception | None = None
         for addr in sorted(peer.addrs):
             try:
-                stream = await transport.connect(
+                return adopt(await transport.connect(
                     addr, self.identity, expect=identity, timeout=timeout
-                )
-                peer.active_connections += 1
-                orig_close = stream.close
-
-                async def close(_orig=orig_close, _peer=peer):
-                    _peer.active_connections -= 1
-                    await _orig()
-
-                stream.close = close  # type: ignore[method-assign]
-                return stream
+                ))
             except (OSError, transport.HandshakeError, asyncio.TimeoutError) as e:
                 last_err = e
-        raise ConnectionError(f"all addresses failed for {identity}: {last_err}")
+        if peer.relayed and self.relay_dial is not None:
+            try:
+                return adopt(await self.relay_dial(identity, timeout=timeout))
+            except (OSError, ConnectionError, transport.HandshakeError,
+                    asyncio.TimeoutError) as e:
+                last_err = e
+        raise ConnectionError(f"all routes failed for {identity}: {last_err}")
 
     # --- lifecycle -----------------------------------------------------
 

@@ -1,0 +1,414 @@
+"""WAN P2P via a relay — rendezvous + dumb byte pipe.
+
+Parity: the reference reaches non-LAN peers through relayed libp2p
+streams with hole punching layered on top
+(ref:crates/p2p2/src/quic/transport.rs:212,344 `Control::
+open_stream_with_addrs` over the patched libp2p relay). Here the cloud
+relay (cloud/relay.py) doubles as the rendezvous: nodes hold a control
+connection (`listen`), dialers ask the relay to splice a fresh TCP pair
+(`dial` ↔ `accept`), and from then on the relay copies bytes blindly —
+the normal Noise-style handshake (p2p/transport.py) runs END-TO-END
+through the pipe, so the relay can neither read nor impersonate
+(circuit-v2's trust model).
+
+Control protocol (4-byte BE length + JSON). Registering an identity
+requires proving possession of its ed25519 key (challenge signature),
+or any client could hijack a victim's relayed reachability and spoof
+its metadata:
+  node → relay   {"cmd":"listen","identity":b58,"meta":{…}}
+  relay → node   {"challenge":hex}
+  node → relay   {"sig":hex}                  → {"ok":true}
+  node → relay   {"cmd":"query"}              → {"peers":[{identity,meta}]}
+  node → relay   {"cmd":"ping"}               → {"ok":true}
+  relay → node   {"event":"incoming","conn":N}
+  dialer → relay {"cmd":"dial","target":b58}  → {"ok":true} then raw pipe
+  node → relay   {"cmd":"accept","conn":N}    → {"ok":true} then raw pipe
+Dialing needs no relay-level auth: the end-to-end handshake pins the
+expected identity, so a misrouted pipe just fails to authenticate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import secrets
+import struct
+from typing import Any, Awaitable, Callable
+
+from .identity import Identity, RemoteIdentity
+from .transport import EncryptedStream, _client_handshake, _server_handshake
+
+logger = logging.getLogger(__name__)
+
+MAX_FRAME = 64 * 1024
+PIPE_CHUNK = 64 * 1024
+DIAL_TIMEOUT = 15.0
+_LISTEN_CONTEXT = b"sd-relay-listen-v1"
+
+
+async def read_frame(reader: asyncio.StreamReader) -> dict[str, Any]:
+    hdr = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", hdr)
+    if length > MAX_FRAME:
+        raise ValueError("oversized control frame")
+    return json.loads(await reader.readexactly(length))
+
+
+def write_frame(writer: asyncio.StreamWriter, msg: dict[str, Any]) -> None:
+    data = json.dumps(msg).encode()
+    writer.write(struct.pack(">I", len(data)) + data)
+
+
+async def _splice(a_r, a_w, b_r, b_w) -> None:
+    """Copy bytes both ways until either side closes."""
+
+    async def pump(r, w):
+        try:
+            while True:
+                chunk = await r.read(PIPE_CHUNK)
+                if not chunk:
+                    break
+                w.write(chunk)
+                await w.drain()
+        except (ConnectionError, asyncio.CancelledError, OSError):
+            pass
+        finally:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+    await asyncio.gather(pump(a_r, b_w), pump(b_r, a_w))
+
+
+class RelayServer:
+    """The rendezvous half that rides on the cloud relay process."""
+
+    def __init__(self) -> None:
+        self._listeners: dict[str, asyncio.StreamWriter] = {}
+        self._meta: dict[str, dict[str, Any]] = {}
+        self._pending: dict[int, tuple[asyncio.StreamReader, asyncio.StreamWriter,
+                                       "asyncio.Future[None]"]] = {}
+        self._conn_ids = itertools.count(1)
+        self._server: asyncio.base_events.Server | None = None
+        self.port: int | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def shutdown(self) -> None:
+        # close the control connections FIRST: on Python 3.12+
+        # Server.wait_closed() blocks until every connection handler
+        # returns, and listener handlers loop until their socket dies
+        for w in list(self._listeners.values()):
+            w.close()
+        self._listeners.clear()
+        for _r, w, fut in self._pending.values():
+            if not fut.done():
+                fut.cancel()
+            w.close()
+        self._pending.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            msg = await asyncio.wait_for(read_frame(reader), 30)
+        except Exception:
+            writer.close()
+            return
+        cmd = msg.get("cmd")
+        try:
+            if cmd == "listen":
+                await self._serve_listener(reader, writer, msg)
+            elif cmd == "dial":
+                await self._serve_dial(reader, writer, msg)
+            elif cmd == "accept":
+                await self._serve_accept(reader, writer, msg)
+            else:
+                write_frame(writer, {"ok": False, "error": "unknown cmd"})
+                writer.close()
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            writer.close()
+
+    async def _serve_listener(self, reader, writer, msg) -> None:
+        ident = msg["identity"]
+        # challenge-response: only the holder of the ed25519 key may
+        # register (and keep re-registering metadata for) an identity
+        try:
+            pub = RemoteIdentity.from_str(ident)
+        except Exception:
+            write_frame(writer, {"ok": False, "error": "bad identity"})
+            await writer.drain()
+            writer.close()
+            return
+        nonce = secrets.token_bytes(32)
+        write_frame(writer, {"challenge": nonce.hex()})
+        await writer.drain()
+        answer = await asyncio.wait_for(read_frame(reader), 30)
+        sig = bytes.fromhex(answer.get("sig", ""))
+        if not pub.verify(sig, _LISTEN_CONTEXT + nonce):
+            write_frame(writer, {"ok": False, "error": "auth failed"})
+            await writer.drain()
+            writer.close()
+            return
+        old = self._listeners.get(ident)
+        if old is not None and old is not writer:
+            old.close()  # the authenticated newcomer supersedes
+        self._listeners[ident] = writer
+        self._meta[ident] = msg.get("meta", {})
+        write_frame(writer, {"ok": True})
+        await writer.drain()
+        try:
+            while True:
+                req = await read_frame(reader)
+                c = req.get("cmd")
+                if c == "query":
+                    write_frame(writer, {"event": "peers", "peers": [
+                        {"identity": i, "meta": m}
+                        for i, m in self._meta.items() if i != ident
+                    ]})
+                elif c == "listen":  # metadata refresh
+                    self._meta[ident] = req.get("meta", {})
+                    write_frame(writer, {"ok": True})
+                elif c == "ping":
+                    write_frame(writer, {"ok": True})
+                await writer.drain()
+        finally:
+            if self._listeners.get(ident) is writer:
+                del self._listeners[ident]
+                self._meta.pop(ident, None)
+            writer.close()
+
+    async def _serve_dial(self, reader, writer, msg) -> None:
+        target = msg.get("target")
+        host_w = self._listeners.get(target)
+        if host_w is None:
+            write_frame(writer, {"ok": False, "error": "target not registered"})
+            await writer.drain()
+            writer.close()
+            return
+        conn_id = next(self._conn_ids)
+        accepted: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[conn_id] = (reader, writer, accepted)
+        try:
+            write_frame(host_w, {"event": "incoming", "conn": conn_id})
+            await host_w.drain()
+            await asyncio.wait_for(accepted, DIAL_TIMEOUT)
+        except Exception:
+            self._pending.pop(conn_id, None)
+            write_frame(writer, {"ok": False, "error": "accept timeout"})
+            try:
+                await writer.drain()
+            except Exception:
+                pass
+            writer.close()
+        # on success the accept side owns the splice; nothing more here
+
+    async def _serve_accept(self, reader, writer, msg) -> None:
+        entry = self._pending.pop(int(msg.get("conn", -1)), None)
+        if entry is None:
+            write_frame(writer, {"ok": False, "error": "unknown conn"})
+            await writer.drain()
+            writer.close()
+            return
+        dial_r, dial_w, accepted = entry
+        write_frame(writer, {"ok": True})
+        write_frame(dial_w, {"ok": True})
+        await writer.drain()
+        await dial_w.drain()
+        accepted.set_result(None)
+        await _splice(dial_r, dial_w, reader, writer)
+
+
+class RelayClient:
+    """Node-side: keeps a control connection registered on the relay,
+    accepts relayed inbound streams, dials relayed outbound streams,
+    and feeds relay-discovered peers into the P2P registry."""
+
+    def __init__(self, p2p: Any, relay_addr: tuple[str, int],
+                 on_stream: Callable[[EncryptedStream], Awaitable[None]],
+                 query_interval: float = 5.0):
+        self.p2p = p2p
+        self.addr = relay_addr
+        self.identity: Identity = p2p.identity
+        self._on_stream = on_stream
+        self._interval = query_interval
+        self._task: asyncio.Task | None = None
+        self._accepts: set[asyncio.Task] = set()  # keep strong refs
+        self._stopped = asyncio.Event()
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+        # expose relayed dialing to P2P.new_stream's fallback
+        self.p2p.relay_dial = self.dial
+
+    async def shutdown(self) -> None:
+        self._stopped.set()
+        for t in (self._task, *self._accepts):
+            if t is None:
+                continue
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._accepts.clear()
+        if getattr(self.p2p, "relay_dial", None) is self.dial:
+            self.p2p.relay_dial = None
+
+    # --- control loop ---------------------------------------------------
+
+    async def _run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                await self._session()
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001 - reconnect loop
+                logger.debug("relay session ended: %s", e)
+            try:
+                await asyncio.wait_for(self._stopped.wait(), 2.0)
+            except asyncio.TimeoutError:
+                pass
+
+    def _meta(self) -> dict[str, Any]:
+        return dict(self.p2p.metadata)
+
+    async def _session(self) -> None:
+        reader, writer = await asyncio.open_connection(*self.addr)
+        try:
+            write_frame(writer, {
+                "cmd": "listen",
+                "identity": str(self.p2p.remote_identity),
+                "meta": self._meta(),
+            })
+            await writer.drain()
+            challenge = await asyncio.wait_for(read_frame(reader), 30)
+            if "challenge" not in challenge:
+                raise ConnectionError(f"relay refused listen: {challenge}")
+            nonce = bytes.fromhex(challenge["challenge"])
+            write_frame(writer, {
+                "sig": self.identity.sign(_LISTEN_CONTEXT + nonce).hex()
+            })
+            await writer.drain()
+            resp = await asyncio.wait_for(read_frame(reader), 30)
+            if not resp.get("ok"):
+                raise ConnectionError(f"relay auth failed: {resp}")
+
+            # dedicated read loop: incoming dials are answered the
+            # moment the relay announces them, never a poll-cycle later
+            async def reads():
+                while True:
+                    msg = await read_frame(reader)
+                    if msg.get("event") == "incoming":
+                        task = asyncio.create_task(self._accept(msg["conn"]))
+                        self._accepts.add(task)
+                        task.add_done_callback(self._accepts.discard)
+                    elif msg.get("event") == "peers":
+                        self._ingest_peers(msg.get("peers", []))
+                    # {"ok":true} replies to refreshes need no action
+
+            read_task = asyncio.create_task(reads())
+            try:
+                last_meta = self._meta()
+                while not self._stopped.is_set():
+                    write_frame(writer, {"cmd": "query"})
+                    if self._meta() != last_meta:
+                        last_meta = self._meta()
+                        write_frame(writer, {
+                            "cmd": "listen",
+                            "identity": str(self.p2p.remote_identity),
+                            "meta": last_meta,
+                        })
+                    await writer.drain()
+                    done, _ = await asyncio.wait(
+                        [read_task], timeout=self._interval
+                    )
+                    if done:  # the control socket died → reconnect
+                        read_task.result()
+                        return
+            finally:
+                read_task.cancel()
+                try:
+                    await read_task
+                except (asyncio.CancelledError, Exception):
+                    pass
+        finally:
+            writer.close()
+
+    def _ingest_peers(self, peers: list[dict[str, Any]]) -> None:
+        seen = set()
+        for entry in peers:
+            try:
+                ident = RemoteIdentity.from_str(entry["identity"])
+            except Exception:
+                continue
+            seen.add(ident)
+            if ident == self.p2p.remote_identity:
+                continue
+            peer = self.p2p.touch_peer(ident)
+            fresh = not peer.is_discovered
+            meta = {str(k): str(v) for k, v in (entry.get("meta") or {}).items()}
+            changed = any(peer.metadata.get(k) != v for k, v in meta.items())
+            peer.metadata.update(meta)
+            peer.discovered_by.add("relay")
+            peer.relayed = True
+            if fresh:
+                self.p2p.events.emit(("PeerDiscovered", ident))
+            elif changed:
+                self.p2p.events.emit(("PeerMetadataChanged", ident))
+        for ident, peer in self.p2p.peers.items():
+            if peer.relayed and ident not in seen:
+                peer.relayed = False
+                self.p2p.expired("relay", ident)  # one expiry semantics
+
+    # --- streams --------------------------------------------------------
+
+    async def _accept(self, conn_id: int) -> None:
+        """Dial back to the relay, claim the conn, run the SERVER side
+        of the Noise handshake through the pipe."""
+        try:
+            reader, writer = await asyncio.open_connection(*self.addr)
+            write_frame(writer, {"cmd": "accept", "conn": conn_id})
+            await writer.drain()
+            resp = await asyncio.wait_for(read_frame(reader), DIAL_TIMEOUT)
+            if not resp.get("ok"):
+                writer.close()
+                return
+            stream = await asyncio.wait_for(
+                _server_handshake(reader, writer, self.identity), DIAL_TIMEOUT
+            )
+        except Exception as e:  # noqa: BLE001 - inbound is best-effort
+            logger.debug("relayed accept %s failed: %s", conn_id, e)
+            return
+        try:
+            await self._on_stream(stream)
+        finally:
+            await stream.close()
+
+    async def dial(self, identity: RemoteIdentity,
+                   timeout: float = DIAL_TIMEOUT) -> EncryptedStream:
+        """Open a relayed stream to `identity` (CLIENT handshake through
+        the spliced pipe)."""
+        reader, writer = await asyncio.open_connection(*self.addr)
+        try:
+            write_frame(writer, {"cmd": "dial", "target": str(identity)})
+            await writer.drain()
+            resp = await asyncio.wait_for(read_frame(reader), timeout)
+            if not resp.get("ok"):
+                raise ConnectionError(f"relay dial failed: {resp.get('error')}")
+            return await asyncio.wait_for(
+                _client_handshake(reader, writer, self.identity, identity),
+                timeout,
+            )
+        except BaseException:
+            writer.close()
+            raise

@@ -115,6 +115,10 @@ def test_two_nodes_converge_via_cloud(tmp_path):
                 if (
                     lib_b.db.count("location") == 1
                     and cas_map(lib_b.db) == a_cas  # cas updates land last
+                    # the actors' counters update after apply — poll
+                    # them too or a tight schedule races the assert
+                    and cloud_a.sent_ops > 0
+                    and cloud_b.ingested_ops > 0
                 ):
                     break
                 await asyncio.sleep(0.1)

@@ -759,3 +759,179 @@ def test_two_node_sync_convergence_and_file_request(tmp_path):
             await b.shutdown()
 
     asyncio.run(run())
+
+
+def test_spacedrop_over_wan_relay(tmp_path):
+    """Two nodes with LAN discovery DISABLED reach each other only
+    through the relay rendezvous: discovery via relay registry, the
+    stream spliced through the relay's dumb pipe, the Noise handshake
+    end-to-end (ref:p2p2 quic/transport.rs:212,344 relayed streams)."""
+
+    async def run():
+        from spacedrive_tpu.cloud.relay import CloudRelay
+        from spacedrive_tpu.node.config import P2PDiscoveryState
+        from spacedrive_tpu.p2p.relay import RelayClient
+
+        relay = CloudRelay()
+        await relay.start()
+
+        a = await _make_node(tmp_path, "wan-a")
+        b = await _make_node(tmp_path, "wan-b")
+        clients = []
+        try:
+            for n in (a, b):
+                n.config.config.p2p.discovery = P2PDiscoveryState.DISABLED
+                await n.p2p.start()
+                assert not n.p2p.p2p._discovery  # no LAN discovery at all
+                rc = RelayClient(
+                    n.p2p.p2p, ("127.0.0.1", relay.p2p_port),
+                    n.p2p.p2p._on_stream, query_interval=0.1,
+                )
+                await rc.start()
+                clients.append(rc)
+
+            for _ in range(200):
+                if (a.p2p.p2p.discovered_peers()
+                        and b.p2p.p2p.discovered_peers()):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise TimeoutError("relay discovery never converged")
+            peer_b = a.p2p.p2p.discovered_peers()[0]
+            assert peer_b.relayed and not peer_b.addrs  # relay-only route
+            assert peer_b.metadata.get("name") == "wan-b"
+
+            src = os.path.join(tmp_path, "wan-gift.bin")
+            payload = os.urandom(200_000)
+            with open(src, "wb") as f:
+                f.write(payload)
+            dest = os.path.join(tmp_path, "wan-inbox")
+            offers = []
+            b.event_bus.on(
+                lambda ev: offers.append(ev[1])
+                if isinstance(ev, tuple) and ev and ev[0] == "SpacedropRequest"
+                else None
+            )
+
+            async def auto_accept():
+                for _ in range(200):
+                    if offers:
+                        b.p2p.spacedrop.accept(offers[0].id, dest)
+                        return
+                    await asyncio.sleep(0.05)
+
+            drop_id, _ = await asyncio.gather(
+                a.p2p.spacedrop.send(peer_b.identity, [src]),
+                auto_accept(),
+            )
+            with open(os.path.join(dest, "wan-gift.bin"), "rb") as f:
+                assert f.read() == payload
+            assert a.p2p.spacedrop.progress[drop_id] == 100
+        finally:
+            for rc in clients:
+                await rc.shutdown()
+            await a.shutdown()
+            await b.shutdown()
+            await relay.shutdown()
+
+    asyncio.run(run())
+
+
+def test_relay_from_node_config(tmp_path):
+    """`p2p.relay = "host:port"` in node config wires the RelayClient
+    automatically at P2P start."""
+
+    async def run():
+        from spacedrive_tpu.cloud.relay import CloudRelay
+        from spacedrive_tpu.node.config import P2PDiscoveryState
+
+        relay = CloudRelay()
+        await relay.start()
+        a = await _make_node(tmp_path, "cfg-a")
+        b = await _make_node(tmp_path, "cfg-b")
+        try:
+            for n in (a, b):
+                n.config.config.p2p.discovery = P2PDiscoveryState.DISABLED
+                n.config.config.p2p.relay = f"127.0.0.1:{relay.p2p_port}"
+                await n.p2p.start()
+            # shrink the poll interval for test speed
+            for n in (a, b):
+                n.p2p.p2p._discovery[-1]._interval = 0.1
+            for _ in range(200):
+                if (a.p2p.p2p.discovered_peers()
+                        and b.p2p.p2p.discovered_peers()):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise TimeoutError("config-path relay discovery failed")
+            # a relayed ping round-trip through the spliced pipe
+            from spacedrive_tpu.p2p.operations import ping
+
+            ident = a.p2p.p2p.discovered_peers()[0].identity
+            assert await ping(a.p2p.p2p, ident)
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+            await relay.shutdown()
+
+    asyncio.run(run())
+
+
+def test_relay_listen_requires_identity_proof(tmp_path):
+    """Registering an identity on the relay requires signing the
+    challenge with that identity's key — a spoofer can't hijack a
+    victim's relayed reachability or metadata."""
+
+    async def run():
+        from spacedrive_tpu.p2p.identity import Identity
+        from spacedrive_tpu.p2p.relay import (
+            RelayServer, read_frame, write_frame, _LISTEN_CONTEXT,
+        )
+
+        relay = RelayServer()
+        await relay.start()
+        try:
+            victim = Identity()
+            attacker = Identity()
+
+            # attacker claims the victim's identity, signs with own key
+            r, w = await asyncio.open_connection("127.0.0.1", relay.port)
+            write_frame(w, {
+                "cmd": "listen",
+                "identity": str(victim.to_remote_identity()),
+                "meta": {"name": "evil"},
+            })
+            await w.drain()
+            ch = await read_frame(r)
+            write_frame(w, {
+                "sig": attacker.sign(
+                    _LISTEN_CONTEXT + bytes.fromhex(ch["challenge"])
+                ).hex(),
+            })
+            await w.drain()
+            resp = await read_frame(r)
+            assert resp == {"ok": False, "error": "auth failed"}
+            assert str(victim.to_remote_identity()) not in relay._listeners
+            w.close()
+
+            # the legitimate holder registers fine
+            r, w = await asyncio.open_connection("127.0.0.1", relay.port)
+            write_frame(w, {
+                "cmd": "listen",
+                "identity": str(victim.to_remote_identity()),
+                "meta": {"name": "victim"},
+            })
+            await w.drain()
+            ch = await read_frame(r)
+            write_frame(w, {
+                "sig": victim.sign(
+                    _LISTEN_CONTEXT + bytes.fromhex(ch["challenge"])
+                ).hex(),
+            })
+            await w.drain()
+            assert (await read_frame(r)).get("ok") is True
+            w.close()
+        finally:
+            await relay.shutdown()
+
+    asyncio.run(run())
