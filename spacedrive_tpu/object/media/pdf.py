@@ -818,15 +818,26 @@ def render_pdf(path_or_bytes: str | bytes,
     doc = PdfDocument(data)
     page = doc.first_page()
 
-    # 1. the page's own /Thumb image
+    # 1. the page's own /Thumb image (PDF's bundled thumbnail)
     thumb = doc.resolve(page.get("Thumb"))
     arr = None
     if isinstance(thumb, Stream):
         arr = _decode_image_xobject(doc, thumb)
-    # 2. largest image on the page
+    # 2. real page render: content-stream rasterizer over cairo
+    # (pdf_raster.py — paths, text, transforms, placed images; the
+    # PDFium-role renderer, ref:crates/images/src/pdf.rs:82-83)
+    if arr is None:
+        from .pdf_raster import rasterize_page
+
+        try:
+            arr = rasterize_page(doc, page, max_dim)
+        except Exception:
+            logger.debug("pdf raster failed; falling back", exc_info=True)
+            arr = None
+    # 3. largest image on the page (cairo unavailable / nothing painted)
     if arr is None:
         arr = _largest_page_image(doc, page)
-    # 3. typeset extracted text
+    # 4. typeset extracted text
     if arr is None:
         lines = _extract_text(doc, page)
         if not lines:

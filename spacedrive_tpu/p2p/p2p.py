@@ -60,7 +60,8 @@ class P2P:
         self._handler: StreamHandler | None = None
         self._discovery: list[Any] = []
         # relayed dialing fallback, set by p2p/relay.py RelayClient
-        self.relay_dial: Callable[[RemoteIdentity], Awaitable[EncryptedStream]] | None = None
+        # (signature: (identity, *, timeout) -> EncryptedStream)
+        self.relay_dial: Callable[..., Awaitable[EncryptedStream]] | None = None
 
     # --- listener ------------------------------------------------------
 

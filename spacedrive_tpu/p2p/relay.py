@@ -135,7 +135,8 @@ class RelayServer:
             else:
                 write_frame(writer, {"ok": False, "error": "unknown cmd"})
                 writer.close()
-        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+        except (ConnectionError, asyncio.IncompleteReadError, OSError,
+                asyncio.TimeoutError):
             writer.close()
 
     async def _serve_listener(self, reader, writer, msg) -> None:
@@ -168,7 +169,9 @@ class RelayServer:
         await writer.drain()
         try:
             while True:
-                req = await read_frame(reader)
+                # clients query every ~5 s; a long-silent control
+                # connection is half-open — evict the ghost listener
+                req = await asyncio.wait_for(read_frame(reader), 120)
                 c = req.get("cmd")
                 if c == "query":
                     write_frame(writer, {"event": "peers", "peers": [
@@ -220,11 +223,19 @@ class RelayServer:
             writer.close()
             return
         dial_r, dial_w, accepted = entry
+        # resolve the future FIRST: the dial side's wait_for may cancel
+        # it during any await below, and set_result on a cancelled
+        # future raises InvalidStateError
+        if accepted.cancelled():
+            write_frame(writer, {"ok": False, "error": "dial gone"})
+            await writer.drain()
+            writer.close()
+            return
+        accepted.set_result(None)
         write_frame(writer, {"ok": True})
         write_frame(dial_w, {"ok": True})
         await writer.drain()
         await dial_w.drain()
-        accepted.set_result(None)
         await _splice(dial_r, dial_w, reader, writer)
 
 

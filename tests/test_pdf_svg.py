@@ -131,8 +131,15 @@ SVG_DOC = b"""<svg xmlns="http://www.w3.org/2000/svg" width="100" height="50"
 
 
 def test_pdf_image_page_renders_the_image():
+    from spacedrive_tpu.object.media.pdf_raster import raster_available
+
     arr = render_pdf(image_pdf_bytes())
-    assert arr.shape == (200, 300, 4)
+    h, w = arr.shape[:2]
+    if raster_available():
+        # full page render at max_dim with the page's 300x200 aspect
+        assert w == 512 and abs(h - int(512 * 200 / 300)) <= 2
+    else:
+        assert (h, w) == (200, 300)  # largest-image fallback
     # quadrant colors survive (JPEG-lossy, so approximate)
     assert abs(int(arr[10, 10, 1]) - 200) < 30   # green top-left
     assert abs(int(arr[-10, -10, 0]) - 200) < 30  # red bottom-right
@@ -322,3 +329,90 @@ def test_png_predictor_vectorized_matches_reference():
     ftypes = [0, 1, 2, 3, 4, 2]
     data = b"".join(bytes([ft]) + raw[r].tobytes() for r, ft in enumerate(ftypes))
     assert _png_predictor(data, colors, bpc, columns) == oracle(data)
+
+
+def vector_pdf_bytes() -> bytes:
+    """Hand-assembled vector-art page: red filled triangle, blue rect,
+    thick green stroked line, black text — the constructs the
+    content-stream rasterizer must place correctly."""
+    content = b"""
+1 0 0 RG 0.9 0.1 0.1 rg
+50 50 m 250 50 l 150 250 l h f
+0.1 0.2 0.9 rg
+300 500 200 150 re f
+0 0.6 0 RG 8 w
+50 600 m 250 700 l S
+BT /F1 36 Tf 1 0 0 1 300 300 Tm 0 0 0 rg (Hello PDF) Tj ET
+"""
+    stream = zlib.compress(content)
+    objs = [
+        b"<< /Type /Catalog /Pages 2 0 R >>",
+        b"<< /Type /Pages /Kids [3 0 R] /Count 1 >>",
+        b"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 612 792] "
+        b"/Contents 4 0 R /Resources << /Font << /F1 5 0 R >> >> >>",
+        b"<< /Length " + str(len(stream)).encode()
+        + b" /Filter /FlateDecode >>\nstream\n" + stream + b"\nendstream",
+        b"<< /Type /Font /Subtype /Type1 /BaseFont /Helvetica >>",
+    ]
+    out = bytearray(b"%PDF-1.4\n")
+    offsets = []
+    for i, o in enumerate(objs, 1):
+        offsets.append(len(out))
+        out += str(i).encode() + b" 0 obj\n" + o + b"\nendobj\n"
+    xref = len(out)
+    out += b"xref\n0 " + str(len(objs) + 1).encode() + b"\n0000000000 65535 f \n"
+    for off in offsets:
+        out += f"{off:010d} 00000 n \n".encode()
+    out += (b"trailer\n<< /Size " + str(len(objs) + 1).encode()
+            + b" /Root 1 0 R >>\nstartxref\n" + str(xref).encode()
+            + b"\n%%EOF\n")
+    return bytes(out)
+
+
+def test_pdf_vector_page_rasterizes_recognizably():
+    """VERDICT r2 #7: vector/text pages get a real render — fills,
+    strokes, and text land where the page puts them, pixel-checked."""
+    from spacedrive_tpu.object.media.pdf_raster import raster_available
+
+    if not raster_available():
+        pytest.skip("cairo not available")
+    arr = render_pdf(vector_pdf_bytes())
+    h, w = arr.shape[:2]
+    assert h == 512 and abs(w - int(512 * 612 / 792)) <= 2
+    s = 512 / 792
+
+    def px(x_pdf, y_pdf):
+        return arr[int((792 - y_pdf) * s), int(x_pdf * s), :3].astype(int)
+
+    # red triangle interior
+    r, g, b = px(150, 100)
+    assert r > 180 and g < 90 and b < 90, (r, g, b)
+    # blue rectangle interior
+    r, g, b = px(400, 575)
+    assert b > 180 and r < 90, (r, g, b)
+    # green stroked line midpoint (8pt wide stroke)
+    r, g, b = px(150, 650)
+    assert g > 100 and r < 120, (r, g, b)
+    # background stays white
+    assert (px(550, 100) > 250).all()
+    # the text region contains dark ink
+    text = arr[int((792 - 310) * s):int((792 - 285) * s),
+               int(295 * s):int(500 * s), :3]
+    assert text.min() < 100 and text.mean() < 253
+
+
+def test_pdf_rasterizer_survives_hostile_streams():
+    """Garbage operators, unbalanced q/Q, bogus operands — skip, don't
+    crash (the interpreter's skip-not-raise contract)."""
+    from spacedrive_tpu.object.media import pdf_raster
+    from spacedrive_tpu.object.media.pdf import PdfDocument
+
+    if not pdf_raster.raster_available():
+        pytest.skip("cairo not available")
+    base = vector_pdf_bytes()
+    hostile = base.replace(
+        b"1 0 0 RG", b"Q Q Q (str) 9999999999 unknownop /X cm w re f"
+    )
+    doc = PdfDocument(hostile)
+    arr = pdf_raster.rasterize_page(doc, doc.first_page(), 256)
+    assert arr is not None and arr.shape[0] > 0  # still painted the rest
