@@ -95,6 +95,28 @@ class KeyManager:
             raise CryptoError("key manager is locked")
         return bytes(self._master)
 
+    # --- OS keyring (ref:keys/keyring/mod.rs:44-45) --------------------
+
+    _KEYRING_SERVICE = "spacedrive-tpu"
+
+    def remember_master(self, keyring, account: str = "master") -> None:
+        """Persist the master password in the OS keyring so the next
+        session unlocks without prompting (the reference's keyring
+        usage). Call after set_master_password; raises when locked."""
+        keyring.set(self._KEYRING_SERVICE, account, self._require_master())
+
+    def unlock_from_keyring(self, keyring, account: str = "master") -> bool:
+        """Unlock from a remembered master password; False when the
+        keyring has no entry."""
+        secret = keyring.get(self._KEYRING_SERVICE, account)
+        if secret is None:
+            return False
+        self.set_master_password(secret)
+        return True
+
+    def forget_master(self, keyring, account: str = "master") -> bool:
+        return keyring.delete(self._KEYRING_SERVICE, account)
+
     # --- key CRUD (ref:keymanager.rs add_to_keystore/mount/unmount) ----
 
     def add_key(

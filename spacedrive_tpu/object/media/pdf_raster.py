@@ -168,6 +168,8 @@ class _Raster:
         self.base = base_ctm
         self.gs = _GState(base_ctm)
         self.stack: list[_GState] = []
+        self.floors = [0]  # per-form gstate-stack floor: inner Q can't
+        # pop the caller's states (or underflow cairo's save stack)
         self.ops = 0
         self.painted = 0  # fills/strokes/images actually drawn
         self.pending_clip: int | None = None
@@ -324,6 +326,8 @@ class _Raster:
             sub_res = self.doc.resolve(obj.dict.get("Resources")) or resources
             self.stack.append(self.gs.copy())
             self.c.cairo_save(self.cr)
+            floor = len(self.stack)
+            self.floors.append(floor)
             mtx = self.doc.resolve(obj.dict.get("Matrix"))
             if isinstance(mtx, list) and len(mtx) == 6:
                 try:
@@ -332,9 +336,16 @@ class _Raster:
                     )
                 except (TypeError, ValueError):
                     pass
-            self.run(content, sub_res, depth + 1)
-            self.c.cairo_restore(self.cr)
-            self.gs = self.stack.pop()
+            try:
+                self.run(content, sub_res, depth + 1)
+            finally:
+                # rebalance any unclosed q's the form content left open
+                while len(self.stack) > floor:
+                    self.gs = self.stack.pop()
+                    self.c.cairo_restore(self.cr)
+                self.floors.pop()
+                self.c.cairo_restore(self.cr)
+                self.gs = self.stack.pop()
 
     # --- the interpreter ------------------------------------------------
 
@@ -357,6 +368,8 @@ class _Raster:
                 if ch in (0x2F, 0x28, 0x3C, 0x5B) or 0x30 <= ch <= 0x39 \
                         or ch in (0x2B, 0x2D, 0x2E):
                     operands.append(lex.parse())
+                    self.ops += 1  # operands burn budget too, or a
+                    # stream of bare numbers spins outside the cap
                     if len(operands) > 32:
                         del operands[:-32]
                     continue
@@ -389,7 +402,9 @@ class _Raster:
             self.stack.append(gs.copy())
             c.cairo_save(cr)
         elif op == b"Q":
-            if self.stack:
+            # never pop past the current form's floor — an excess Q in
+            # form content must not consume the caller's states
+            if len(self.stack) > self.floors[-1]:
                 self.gs = self.stack.pop()
                 c.cairo_restore(cr)
         elif op == b"cm" and len(st) >= 6:

@@ -45,6 +45,10 @@ logger = logging.getLogger(__name__)
 MAX_FRAME = 64 * 1024
 PIPE_CHUNK = 64 * 1024
 DIAL_TIMEOUT = 15.0
+# protocol contract: clients must send SOMETHING on the control socket
+# at least every CONTROL_IDLE_TIMEOUT seconds (their query loop does);
+# the server evicts silent listeners as half-open after that
+CONTROL_IDLE_TIMEOUT = 120.0
 _LISTEN_CONTEXT = b"sd-relay-listen-v1"
 
 
@@ -169,9 +173,10 @@ class RelayServer:
         await writer.drain()
         try:
             while True:
-                # clients query every ~5 s; a long-silent control
-                # connection is half-open — evict the ghost listener
-                req = await asyncio.wait_for(read_frame(reader), 120)
+                # a control connection silent past the contract window
+                # is half-open — evict the ghost listener
+                req = await asyncio.wait_for(read_frame(reader),
+                                             CONTROL_IDLE_TIMEOUT)
                 c = req.get("cmd")
                 if c == "query":
                     write_frame(writer, {"event": "peers", "peers": [
@@ -251,7 +256,9 @@ class RelayClient:
         self.addr = relay_addr
         self.identity: Identity = p2p.identity
         self._on_stream = on_stream
-        self._interval = query_interval
+        # the server evicts listeners silent past CONTROL_IDLE_TIMEOUT;
+        # clamp so a tuned-up interval can't violate the contract
+        self._interval = min(query_interval, CONTROL_IDLE_TIMEOUT / 4)
         self._task: asyncio.Task | None = None
         self._accepts: set[asyncio.Task] = set()  # keep strong refs
         self._stopped = asyncio.Event()

