@@ -52,6 +52,7 @@ def test_explorer_assets_and_client_shape(tmp_path):
                     assert resp.status == 200
                     page = await resp.text()
                 assert "/static/js/app.js" in page
+                assert "/static/ui.css" in page  # the component library
                 assert "/static/explorer.css" in page
 
                 # every module the app imports must be served
@@ -63,8 +64,9 @@ def test_explorer_assets_and_client_shape(tmp_path):
                 for mod in mods:
                     async with http.get(f"{base}{mod}") as resp:
                         assert resp.status == 200, mod
-                async with http.get(f"{base}/static/explorer.css") as resp:
-                    assert resp.status == 200
+                for css in ("/static/ui.css", "/static/explorer.css"):
+                    async with http.get(f"{base}{css}") as resp:
+                        assert resp.status == 200, css
                 # traversal is refused
                 async with http.get(
                     f"{base}/static/..%2F..%2Fnamespaces.py"
