@@ -134,9 +134,27 @@ def _chunk_cvs(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> tuple[ja
     nch_n = jnp.repeat(n_chunks[:, None], c_dim, axis=1).reshape(n)
 
     chunk_len = jnp.clip(len_n - chunk_idx * CHUNK_LEN, 0, CHUNK_LEN)  # [N]
-    n_blocks = jnp.maximum(1, (chunk_len + BLOCK_LEN - 1) // BLOCK_LEN)
     is_root_chunk = nch_n == 1  # single-chunk messages root at the chunk level
+    t_lo = chunk_idx.astype(_U)
 
+    mode = _pallas_mode_static.get("mode")
+    if mode is not None:
+        # Pallas kernel for the hot stage (ops/blake3_pallas.py): it
+        # derives block_len/flags/active from the compact per-lane
+        # vectors in VMEM, so only [N]-sized arrays cross HBM
+        from . import blake3_pallas
+
+        h_fin8 = blake3_pallas.chunk_cvs(
+            words,
+            chunk_len.astype(_U)[None, :],
+            is_root_chunk.astype(_U)[None, :],
+            t_lo[None, :],
+            interpret=(mode == "interpret"),
+        )  # [8, N]
+        cvs = h_fin8.T.reshape(b_dim, c_dim, 8)
+        return cvs, n_chunks
+
+    n_blocks = jnp.maximum(1, (chunk_len + BLOCK_LEN - 1) // BLOCK_LEN)
     blk = jnp.arange(16, dtype=jnp.int32)[:, None]  # [16, 1]
     block_len = jnp.clip(chunk_len[None, :] - blk * BLOCK_LEN, 0, BLOCK_LEN)  # [16, N]
     active = blk < n_blocks[None, :]
@@ -147,24 +165,6 @@ def _chunk_cvs(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> tuple[ja
         | jnp.where(is_last, _U(CHUNK_END), _U(0))
         | jnp.where(is_last & is_root_chunk[None, :], _U(ROOT), _U(0))
     )
-
-    t_lo = chunk_idx.astype(_U)
-
-    mode = _pallas_mode_static.get("mode")
-    if mode is not None:
-        # Pallas kernel for the hot stage (ops/blake3_pallas.py)
-        from . import blake3_pallas
-
-        h_fin8 = blake3_pallas.chunk_cvs(
-            words,
-            block_len.astype(_U),
-            flags,
-            active.astype(_U),
-            t_lo[None, :],
-            interpret=(mode == "interpret"),
-        )  # [8, N]
-        cvs = h_fin8.T.reshape(b_dim, c_dim, 8)
-        return cvs, n_chunks
 
     h0 = [_U(IV[i]) + jnp.zeros((n,), _U) for i in range(8)]
 

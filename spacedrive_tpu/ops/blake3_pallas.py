@@ -26,7 +26,7 @@ import os
 
 import numpy as np
 
-from .blake3_ref import IV, MSG_PERMUTATION
+from .blake3_ref import BLOCK_LEN, CHUNK_END, CHUNK_START, IV, MSG_PERMUTATION, ROOT
 
 LANES = 2048  # big-batch lane tile: [16,16,2048] words ≈ 2 MiB VMEM (scoped limit 16 MiB)
 LANES_SMALL = 512  # small batches / interpret mode: avoid the pad-to-tile floor
@@ -56,18 +56,30 @@ def _build_kernel():
     def rotr(x, r):
         return (x >> np.uint32(r)) | (x << np.uint32(32 - r))
 
-    def kernel(words_ref, block_len_ref, flags_ref, active_ref, t_ref, out_ref):
+    def kernel(words_ref, chunk_len_ref, is_root_ref, t_ref, out_ref):
         lanes = out_ref.shape[1]
         zeros = jnp.zeros((lanes,), U)
+        # per-block block_len/flags/active derive from the compact
+        # per-lane chunk_len IN-KERNEL: shipping them as [16, N] arrays
+        # cost ~4 ms/batch of HBM traffic + XLA prologue on a v5e
+        chunk_len = chunk_len_ref[0, :].astype(jnp.int32)
+        n_blocks = jnp.maximum(1, (chunk_len + BLOCK_LEN - 1) // BLOCK_LEN)
+        is_root = is_root_ref[0, :] != np.uint32(0)
         t_lo = t_ref[0, :]
         h = [iv[i] + zeros for i in range(8)]
 
         for b in range(16):  # fully unrolled block walk
             m = [words_ref[b, j] for j in range(16)]
-            act = active_ref[b, :] != np.uint32(0)
+            blen = jnp.clip(chunk_len - b * BLOCK_LEN, 0, BLOCK_LEN).astype(U)
+            last = n_blocks == (b + 1)
+            flags = jnp.where(last, U(CHUNK_END), U(0))
+            flags = jnp.where(last & is_root, flags | U(ROOT), flags)
+            if b == 0:
+                flags = flags | U(CHUNK_START)
+            act = n_blocks > b
             v = list(h) + [
                 iv[0] + zeros, iv[1] + zeros, iv[2] + zeros, iv[3] + zeros,
-                t_lo, zeros, block_len_ref[b, :], flags_ref[b, :],
+                t_lo, zeros, blen, flags,
             ]
 
             def g(a, bb, c, d, mx, my):
@@ -111,9 +123,9 @@ def _chunk_cvs_call(interpret: bool, lanes: int):
     mem = {} if interpret else {"memory_space": pltpu.VMEM}
 
     @functools.partial(jax.jit, static_argnames=())
-    def run(words, block_len, flags, active, t_lo):
-        """words [16,16,N], block_len/flags/active [16,N], t_lo [1,N]
-        (N a multiple of `lanes`) -> cvs [8, N] uint32."""
+    def run(words, chunk_len, is_root, t_lo):
+        """words [16,16,N]; chunk_len/is_root/t_lo [1,N] (N a multiple
+        of `lanes`) -> cvs [8, N] uint32."""
         n = words.shape[2]
         grid = (n // lanes,)
         return pl.pallas_call(
@@ -122,14 +134,13 @@ def _chunk_cvs_call(interpret: bool, lanes: int):
             grid=grid,
             in_specs=[
                 pl.BlockSpec((16, 16, lanes), lambda i: (0, 0, i), **mem),
-                pl.BlockSpec((16, lanes), lambda i: (0, i), **mem),
-                pl.BlockSpec((16, lanes), lambda i: (0, i), **mem),
-                pl.BlockSpec((16, lanes), lambda i: (0, i), **mem),
+                pl.BlockSpec((1, lanes), lambda i: (0, i), **mem),
+                pl.BlockSpec((1, lanes), lambda i: (0, i), **mem),
                 pl.BlockSpec((1, lanes), lambda i: (0, i), **mem),
             ],
             out_specs=pl.BlockSpec((8, lanes), lambda i: (0, i), **mem),
             interpret=interpret,
-        )(words, block_len, flags, active, t_lo)
+        )(words, chunk_len, is_root, t_lo)
 
     return run
 
@@ -154,11 +165,12 @@ def pallas_mode() -> str | None:
     return "interpret" if env == "1" else None
 
 
-def chunk_cvs(words, block_len, flags, active, t_lo, *, interpret: bool):
+def chunk_cvs(words, chunk_len, is_root, t_lo, *, interpret: bool):
     """Pad the lane dim to the chosen tile and run the kernel; returns
-    [8, N]. Big batches use the wide tile (fewer grid steps); small
-    batches and interpret mode use the small one so the pad-to-tile
-    floor stays cheap."""
+    [8, N]. Inputs beyond `words` are compact per-lane vectors [1, N]
+    (block_len/flags/active derive in-kernel). Big batches use the wide
+    tile (fewer grid steps); small batches and interpret mode use the
+    small one so the pad-to-tile floor stays cheap."""
     import jax.numpy as jnp
 
     n = words.shape[2]
@@ -166,9 +178,9 @@ def chunk_cvs(words, block_len, flags, active, t_lo, *, interpret: bool):
     pad = (-n) % lanes
     if pad:
         words = jnp.pad(words, ((0, 0), (0, 0), (0, pad)))
-        block_len = jnp.pad(block_len, ((0, 0), (0, pad)))
-        flags = jnp.pad(flags, ((0, 0), (0, pad)))
-        active = jnp.pad(active, ((0, 0), (0, pad)))
+        # pad lanes hash as zero-length chunks; their CVs are sliced off
+        chunk_len = jnp.pad(chunk_len, ((0, 0), (0, pad)))
+        is_root = jnp.pad(is_root, ((0, 0), (0, pad)))
         t_lo = jnp.pad(t_lo, ((0, 0), (0, pad)))
-    out = _chunk_cvs_call(interpret, lanes)(words, block_len, flags, active, t_lo)
+    out = _chunk_cvs_call(interpret, lanes)(words, chunk_len, is_root, t_lo)
     return out[:, :n]
