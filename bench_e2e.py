@@ -315,21 +315,29 @@ def config_5(tmp: str, n_images: int) -> dict:
     big = (big ^ flips).astype(np.uint8)
     hashes = [np.packbits(big[i]).tobytes() for i in range(n_hashes)]
 
+    # device: the production dedup path (blockwise on-device threshold,
+    # packed-bitmap readback — never materializes N² on the host)
     t0 = time.perf_counter()
-    ham_big = phash_jax.hamming_matrix(hashes)
+    dev_pairs = set(phash_jax.near_pairs(hashes, 10))
     device_s = time.perf_counter() - t0
 
     packed = np.frombuffer(b"".join(hashes), dtype=">u8")
     popcnt = np.array([bin(i).count("1") for i in range(256)], np.uint16)
     t0 = time.perf_counter()
-    cpu_rows = np.empty((n_hashes, n_hashes), np.uint16)
+    cpu_pairs = set()
     chunk = 512
     for i in range(0, n_hashes, chunk):
         x = packed[i:i + chunk, None] ^ packed[None, :]
-        cpu_rows[i:i + chunk] = popcnt[x.view(np.uint8).reshape(
+        d = popcnt[x.view(np.uint8).reshape(
             x.shape[0], n_hashes, 8)].sum(-1, dtype=np.uint16)
+        rows, cols = np.nonzero(d <= 10)
+        cpu_pairs.update(
+            (i + int(r), int(c)) for r, c in zip(rows, cols) if i + r < c
+        )
     cpu_s = time.perf_counter() - t0
-    assert (cpu_rows == ham_big).all(), "device Hamming mismatch vs CPU oracle"
+    assert dev_pairs == cpu_pairs, (
+        f"device pairs {len(dev_pairs)} != cpu {len(cpu_pairs)}"
+    )
 
     pairs = n_hashes * n_hashes
     return {
