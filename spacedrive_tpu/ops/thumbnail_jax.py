@@ -10,8 +10,9 @@ Parity targets (behavior, not implementation):
   dimension to 256 instead.
 
 TPU-first design. The reference resizes one image at a time on a CPU
-pool. Here, decoded images are padded into a small set of square size
-*buckets* (bounded XLA compile shapes) and a whole batch is resized in
+pool. Here, decoded images are padded into a small set of canvas
+*buckets* (squares + landscape halves; portraits transpose in — bounded
+XLA compile shapes) and a whole batch is resized in
 ONE device call per bucket via `jax.image.scale_and_translate`, vmapped
 with *per-image* scale factors as traced arguments — so a single
 compiled program handles arbitrary (h, w) inputs inside a bucket. XLA
@@ -62,13 +63,28 @@ def video_dimensions(w: int, h: int, max_dim: int = VIDEO_MAX_DIM) -> tuple[int,
     return max(1, round(w * ratio)), max(1, round(h * ratio))
 
 
-def bucket_for(h: int, w: int) -> int | None:
-    """Smallest square bucket holding (h, w); None if over the cap."""
+def bucket_for(h: int, w: int) -> tuple[int, int] | None:
+    """Smallest canvas bucket holding (h, w) in its landscape
+    orientation; None if over the cap.
+
+    Buckets are (b, b) squares plus the (b/2, b) landscape half — most
+    photos are 4:3/3:2/16:9, so the half canvas cuts the padded
+    host→device transfer nearly 2× while keeping the compiled-shape
+    count at 2 per ladder rung (the reason canvases exist at all:
+    SURVEY §7 hard part 3, shape bucketing vs recompilation). Portrait
+    images transpose into the landscape canvas on the host
+    (resize_batch), so both orientations share one device call."""
     m = max(h, w)
-    for b in BUCKETS:
-        if m <= b:
-            return b
-    return None
+    b = next((x for x in BUCKETS if m <= x), None)
+    if b is None:
+        return None
+    half = b // 2
+    # only the big rungs: for small canvases the halved payload saves
+    # less than the ~5-20 s per-process executable load each extra
+    # jitted shape costs on a tunneled chip
+    if b >= 1024 and min(h, w) <= half:
+        return (half, b)
+    return (b, b)
 
 
 @functools.cache
@@ -79,8 +95,9 @@ def _resize_fn():
 
     @functools.partial(jax.jit, static_argnames=("out_size",))
     def resize_bucket(canvases, scales, out_size: int):
-        # [B, S, S, 4] uint8 RGBA canvases + per-image [B, 2] (sy, sx)
-        # scales → [B, OUT, OUT, 4] uint8, resized into the top-left
+        # [B, BH, BW, 4] uint8 RGBA canvases (square or landscape-half
+        # buckets) + per-image [B, 2] (sy, sx) scales → [B, OUT, OUT, 4]
+        # uint8, resized into the top-left
         # corner. One compiled program per (bucket, out) pair; the
         # per-image scale is a traced operand, so every (h, w) in the
         # bucket reuses it.
@@ -114,25 +131,32 @@ def resize_batch(
     the output canvas must be filtered by the caller beforehand.
     """
     results: list[np.ndarray | None] = [None] * len(images)
-    by_bucket: dict[int, list[int]] = {}
+    by_bucket: dict[tuple[int, int], list[int]] = {}
+    flip: list[bool] = [False] * len(images)
     for i, img in enumerate(images):
         h, w = img.shape[:2]
         b = bucket_for(h, w)
         if b is None:
             raise ValueError(f"image {i} ({h}x{w}) exceeds max bucket")
+        # portrait images ride the landscape half-canvas transposed
+        # (cheap uint8 host transpose; un-transposed after the crop)
+        flip[i] = b[0] < b[1] and h > w
         by_bucket.setdefault(b, []).append(i)
 
-    for b, idxs in by_bucket.items():
+    for (bh, bw), idxs in by_bucket.items():
         # Pad the batch dim to the next power of two so compile count is
         # bounded at (buckets × log2 max-batch) programs, not one per
         # arbitrary group size.
         bpad = 1 << max(0, (len(idxs) - 1).bit_length())
-        canv = np.zeros((bpad, b, b, 4), np.uint8)
+        canv = np.zeros((bpad, bh, bw, 4), np.uint8)
         scales = np.ones((bpad, 2), np.float32)
         for j, i in enumerate(idxs):
             img = images[i]
+            th, tw = targets[i]
+            if flip[i]:
+                img = np.transpose(img, (1, 0, 2))
+                th, tw = tw, th
             h, w = img.shape[:2]
-            th, tw = targets[i][0], targets[i][1]
             # Edge-replicate into the padding so the antialias window
             # clamps at the image boundary instead of pulling in zeros
             # (the reference resampler clamps at edges too).
@@ -144,7 +168,10 @@ def resize_batch(
         out = np.asarray(_resize_fn()(canv, scales, out_size=out_size))
         for j, i in enumerate(idxs):
             th, tw = targets[i]
-            results[i] = out[j, :th, :tw]
+            if flip[i]:
+                results[i] = np.transpose(out[j, :tw, :th], (1, 0, 2))
+            else:
+                results[i] = out[j, :th, :tw]
     return results  # type: ignore[return-value]
 
 
