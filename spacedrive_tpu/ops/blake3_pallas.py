@@ -4,13 +4,15 @@ The chunk stage is ~94% of the hash FLOPs (16 blocks × 7 rounds of the
 compression permutation per 1 KiB chunk; the tree merge above it is
 O(log C)). This kernel runs that stage as one Pallas program over lane
 tiles: every buffer lives in VMEM laid out `[..., LANES]` so the VPU's
-8×128 registers vectorize across chunk lanes, and BOTH loops — the
-16-block walk and the 7 rounds — are fully unrolled with
-HOST-precomputed message schedules (perm^r applied to static indices —
-no in-kernel gathers). Unrolling the block walk matters: a `fori_loop`
-carrying the `[8, LANES]` state costs a layout round-trip per block and
-measured 5.5× slower on a v5e (31 ms vs 5.6 ms marginal for a
-4096×57-chunk batch; chained-dispatch timing, distinct inputs).
+8×128 registers vectorize across chunk lanes, with HOST-precomputed
+message schedules (perm^r applied to static indices — no in-kernel
+gathers). On real TPUs BOTH loops — the 16-block walk and the 7
+rounds — are fully unrolled: a `fori_loop` carrying the `[8, LANES]`
+state costs a Mosaic layout round-trip per block and measured 5.5×
+slower on a v5e (31 ms vs 5.6 ms marginal for a 4096×57-chunk batch;
+chained-dispatch timing, distinct inputs). Interpret mode (tests)
+keeps the block walk ROLLED instead — the unrolled body is a ~5k-op
+graph whose CPU compile takes minutes (see _build_kernel).
 
 Bit-exactness contract is identical to ops/blake3_jax.py (golden-tested
 against the reference vectors); `ops/blake3_jax.hash_batch` calls this
@@ -46,7 +48,15 @@ def _schedules() -> tuple[tuple[int, ...], ...]:
     return tuple(out)
 
 
-def _build_kernel():
+def _build_kernel(unroll: bool = True):
+    """The chunk kernel. `unroll=True` (real TPU) inlines the 16-block
+    walk — a fori_loop carrying the [8, L] state costs a Mosaic layout
+    round-trip per block, measured 5.5× slower on a v5e. Interpret mode
+    gets `unroll=False`: the unrolled body is a ~5k-op graph whose CPU
+    compile takes MINUTES (the parity test ran hours), while the rolled
+    loop compiles the body once; the block math is shared, so parity
+    coverage is identical."""
+    import jax
     import jax.numpy as jnp
 
     U = jnp.uint32
@@ -66,16 +76,15 @@ def _build_kernel():
         n_blocks = jnp.maximum(1, (chunk_len + BLOCK_LEN - 1) // BLOCK_LEN)
         is_root = is_root_ref[0, :] != np.uint32(0)
         t_lo = t_ref[0, :]
-        h = [iv[i] + zeros for i in range(8)]
 
-        for b in range(16):  # fully unrolled block walk
+        def block_step(b, h):
+            """One 64-byte block over all lanes; `b` may be traced."""
             m = [words_ref[b, j] for j in range(16)]
             blen = jnp.clip(chunk_len - b * BLOCK_LEN, 0, BLOCK_LEN).astype(U)
             last = n_blocks == (b + 1)
             flags = jnp.where(last, U(CHUNK_END), U(0))
             flags = jnp.where(last & is_root, flags | U(ROOT), flags)
-            if b == 0:
-                flags = flags | U(CHUNK_START)
+            flags = jnp.where(b == 0, flags | U(CHUNK_START), flags)
             act = n_blocks > b
             v = list(h) + [
                 iv[0] + zeros, iv[1] + zeros, iv[2] + zeros, iv[3] + zeros,
@@ -104,7 +113,14 @@ def _build_kernel():
                 g(3, 4, 9, 14, m[s[14]], m[s[15]])
 
             out = [v[i] ^ v[i + 8] for i in range(8)]
-            h = [jnp.where(act, out[i], h[i]) for i in range(8)]
+            return tuple(jnp.where(act, out[i], h[i]) for i in range(8))
+
+        h = tuple(iv[i] + zeros for i in range(8))
+        if unroll:
+            for b in range(16):
+                h = block_step(b, h)
+        else:
+            h = jax.lax.fori_loop(0, 16, block_step, h)
 
         for i in range(8):
             out_ref[i, :] = h[i]
@@ -119,7 +135,7 @@ def _chunk_cvs_call(interpret: bool, lanes: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    kernel = _build_kernel()
+    kernel = _build_kernel(unroll=not interpret)
     mem = {} if interpret else {"memory_space": pltpu.VMEM}
 
     @functools.partial(jax.jit, static_argnames=())
