@@ -8,6 +8,7 @@ import { closeInspector, select } from "/static/js/inspector.js";
 import { onJobProgress, renderJobs, wireJobsPanel } from "/static/js/jobs.js";
 import { openDropPanel, rejectPendingOffer, showDropOffer, wireDropPanel } from "/static/js/spacedrop.js";
 import { addLocationModal, wireSettingsPanel } from "/static/js/settings.js";
+import { showMenu, wireContextMenu } from "/static/js/contextmenu.js";
 import { showOnboarding } from "/static/js/onboarding.js";
 
 const sock = new SdSocket();
@@ -113,9 +114,11 @@ $("search").addEventListener("keydown", (e) => {
   if (e.key === "Escape") e.target.blur();
 });
 $("btn-addloc").onclick = () => addLocationModal();
+bus.showMenu = showMenu;
 wireJobsPanel();
 wireDropPanel();
 wireSettingsPanel();
+wireContextMenu();
 
 // ---------- keyboard navigation ----------
 const VIEWS = ["grid", "list", "media"];

@@ -160,6 +160,8 @@ function renderCards(c, mediaOnly, nodes) {
       n.is_dir ? "folder" : fmtBytes(n.size_in_bytes)));
     card.onclick = () => bus.select(n);
     card.ondblclick = () => activate(n);
+    card.oncontextmenu = (e) => { e.preventDefault(); bus.select(n);
+      bus.showMenu(e.clientX, e.clientY, n); };
     c.appendChild(card);
   }
 }
@@ -179,6 +181,8 @@ function renderListRows(table, nodes) {
     tr.appendChild(el("td", "", n.materialized_path || ""));
     tr.onclick = () => bus.select(n);
     tr.ondblclick = () => activate(n);
+    tr.oncontextmenu = (e) => { e.preventDefault(); bus.select(n);
+      bus.showMenu(e.clientX, e.clientY, n); };
     table.appendChild(tr);
   }
 }

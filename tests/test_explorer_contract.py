@@ -206,6 +206,33 @@ def test_explorer_six_flows(tmp_path, corpus=None):
                     assert resp.status in (400, 404, 500)  # rejected, not absent
                 # (full 2-node spacedrop e2e: tests/test_p2p.py)
 
+                # --- context-menu file ops (rename/copy/delete) --------
+                await _rspc(http, base, "files.renameFile",
+                            {"id": beta["id"], "new_name": "beta2.txt"},
+                            lib_id)
+                assert (root / "sub" / "beta2.txt").exists()
+                alpha = next(n for n in top["nodes"] if n["name"] == "alpha")
+                await _rspc(http, base, "files.copyFiles", {
+                    "source_location_id": alpha["location_id"],
+                    "target_location_id": alpha["location_id"],
+                    "sources_file_path_ids": [alpha["id"]],
+                    "target_relative_path": "/sub/",
+                }, lib_id)
+                for _ in range(100):
+                    if (root / "sub" / "alpha.txt").exists():
+                        break
+                    await asyncio.sleep(0.1)
+                assert (root / "sub" / "alpha.txt").exists()
+                await _rspc(http, base, "files.deleteFiles", {
+                    "location_id": alpha["location_id"],
+                    "file_path_ids": [alpha["id"]],
+                }, lib_id)
+                for _ in range(100):
+                    if not (root / "alpha.txt").exists():
+                        break
+                    await asyncio.sleep(0.1)
+                assert not (root / "alpha.txt").exists()
+
                 # settings surface the panel binds to
                 ns = await _rspc(http, base, "nodeState")
                 assert "thumbnailer_background_percentage" in ns
