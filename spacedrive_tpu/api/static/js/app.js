@@ -3,7 +3,7 @@
 
 import client, { SdSocket } from "/rspc/client.js";
 import { $, bus, el, fmtBytes, state } from "/static/js/util.js";
-import { loadContent, moveSelection, openDir, setView, upDir } from "/static/js/views.js";
+import { clearSelection, loadContent, moveSelection, openDir, setView, upDir } from "/static/js/views.js";
 import { closeInspector, select } from "/static/js/inspector.js";
 import { onJobProgress, renderJobs, wireJobsPanel } from "/static/js/jobs.js";
 import { openDropPanel, rejectPendingOffer, showDropOffer, wireDropPanel } from "/static/js/spacedrop.js";
@@ -48,7 +48,8 @@ export async function loadLibraries() {
 
 async function selectLibrary(id) {
   Object.assign(state, { lib:id, loc:null, tag:null, search:"", cursor:null,
-                         path:"/", mode:"browse", selected:null });
+                         path:"/", mode:"browse", selected:null,
+                         selectedIds:new Set() });
   if (unsubJobs) unsubJobs();
   unsubJobs = sock.subscribe("jobs.progress", onJobProgress, {libraryId:id});
   await refreshNav();
@@ -72,6 +73,7 @@ async function refreshNav() {
     item.onclick = () => { setActive(item);
       Object.assign(state, {loc:n.id, tag:null, cursor:null, path:"/",
                             mode:"browse"});
+      clearSelection();
       loadContent(true); };
     locDiv.appendChild(item);
   }
@@ -82,6 +84,7 @@ async function refreshNav() {
     const item = el("div", "item", "🏷️ " + (n.name || "?"));
     item.onclick = () => { setActive(item);
       Object.assign(state, {tag:n.id, loc:null, cursor:null, mode:"browse"});
+      clearSelection();
       loadContent(true); };
     tagDiv.appendChild(item);
   }
@@ -109,6 +112,7 @@ $("search").addEventListener("keydown", (e) => {
   if (e.key === "Enter") {
     state.search = e.target.value;
     state.mode = state.search ? "search" : "browse";
+    clearSelection();
     loadContent(true);
   }
   if (e.key === "Escape") e.target.blur();

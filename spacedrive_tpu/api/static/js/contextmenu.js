@@ -32,6 +32,15 @@ export function showMenu(x, y, n) {
   closeMenu();
   menuEl = el("div", "ctxmenu");
   const refresh = () => bus.loadContent(true);
+  // when the clicked item is part of a multi-selection, batch ops
+  // cover the whole selection (same location only — the jobs are
+  // per-location like the reference's)
+  const chosen = state.selectedIds.has(n.id) && state.selectedIds.size > 1
+    ? state.nodes.filter(
+        x => state.selectedIds.has(x.id) && x.location_id === n.location_id)
+    : [n];
+  const many = chosen.length > 1;
+  const label = (verb) => many ? `${verb} ${chosen.length} items` : verb;
 
   menuEl.appendChild(item("Rename…", async () => {
     const name = prompt(
@@ -42,15 +51,15 @@ export function showMenu(x, y, n) {
     refresh();
   }));
 
-  menuEl.appendChild(item("Copy", () => {
-    clipboard = {op: "copy", ids: [n.id], location_id: n.location_id,
-                 lib: state.lib};
-    $("events").textContent = "copied 1 item";
+  menuEl.appendChild(item(label("Copy"), () => {
+    clipboard = {op: "copy", ids: chosen.map(x => x.id),
+                 location_id: n.location_id, lib: state.lib};
+    $("events").textContent = `copied ${chosen.length} item(s)`;
   }));
-  menuEl.appendChild(item("Cut", () => {
-    clipboard = {op: "cut", ids: [n.id], location_id: n.location_id,
-                 lib: state.lib};
-    $("events").textContent = "cut 1 item";
+  menuEl.appendChild(item(label("Cut"), () => {
+    clipboard = {op: "cut", ids: chosen.map(x => x.id),
+                 location_id: n.location_id, lib: state.lib};
+    $("events").textContent = `cut ${chosen.length} item(s)`;
   }));
   if (clipboard && clipboard.lib !== state.lib) clipboard = null;
   if (clipboard && state.loc && state.mode === "browse") {
@@ -77,13 +86,14 @@ export function showMenu(x, y, n) {
         sub_path: n.materialized_path || "/",
       }, state.lib)));
   }
-  menuEl.appendChild(item("📡 Spacedrop", () =>
-    bus.openDropPanel([fullPath(n)])));
+  menuEl.appendChild(item(label("📡 Spacedrop"), () =>
+    bus.openDropPanel(chosen.map(fullPath))));
 
-  menuEl.appendChild(item("Delete", () => modal("Delete?", (m, close) => {
+  menuEl.appendChild(item(label("Delete"), () => modal("Delete?", (m, close) => {
     m.appendChild(el("p", "meta",
-      `“${n.name}${n.extension ? "." + n.extension : ""}” will be moved `
-      + "out of the library and removed from disk."));
+      (many ? `${chosen.length} items` :
+       `“${n.name}${n.extension ? "." + n.extension : ""}”`)
+      + " will be moved out of the library and removed from disk."));
     const actions = el("div", "modal-actions");
     const cancel = el("button", "", "cancel");
     cancel.onclick = close;
@@ -92,7 +102,8 @@ export function showMenu(x, y, n) {
       close();
       try {
         await client.files.deleteFiles(
-          {location_id: n.location_id, file_path_ids: [n.id]}, state.lib);
+          {location_id: n.location_id,
+           file_path_ids: chosen.map(x => x.id)}, state.lib);
       } catch (e) {
         $("events").textContent = "✗ delete: " + e.message;
       }

@@ -5,18 +5,49 @@ import client from "/rspc/client.js";
 import { $, bus, el, fmtBytes, fullPath, state } from "/static/js/util.js";
 
 export function updateSelection() {
-  const sel = state.selected;
+  const ids = state.selectedIds;
   document.querySelectorAll("#content .card, #content tr[data-fp]")
-    .forEach(e => e.classList.toggle("selected",
-      sel != null && e.dataset.fp === String(sel.id)));
+    .forEach(e => e.classList.toggle("selected", ids.has(+e.dataset.fp)));
 }
 
-export async function select(n) {
+/** Selection model: plain click = single; ctrl/cmd = toggle; shift =
+ *  range from the anchor (ref:interface Explorer multi-select). */
+export async function select(n, ev = null) {
+  if (ev && (ev.ctrlKey || ev.metaKey)) {
+    if (state.selectedIds.has(n.id) && state.selectedIds.size > 1) {
+      state.selectedIds.delete(n.id);
+      n = state.nodes.find(x => state.selectedIds.has(x.id)) || n;
+    } else {
+      state.selectedIds.add(n.id);
+    }
+  } else if (ev && ev.shiftKey && state.selected) {
+    const a = state.nodes.findIndex(x => x.id === state.selected.id);
+    const b = state.nodes.findIndex(x => x.id === n.id);
+    if (a >= 0 && b >= 0) {
+      state.selectedIds = new Set(
+        state.nodes.slice(Math.min(a, b), Math.max(a, b) + 1).map(x => x.id)
+      );
+    } else {
+      // stale anchor (nodes were reloaded): degrade to single-select
+      // so the inspector never disagrees with the highlight
+      state.selectedIds = new Set([n.id]);
+    }
+  } else {
+    state.selectedIds = new Set([n.id]);
+  }
   state.selected = n;
   updateSelection();
   const insp = $("inspector");
   insp.classList.add("open");
   insp.innerHTML = "";
+  if (state.selectedIds.size > 1) {
+    insp.appendChild(el("h3", "", `${state.selectedIds.size} items selected`));
+    const chosen = state.nodes.filter(x => state.selectedIds.has(x.id));
+    const bytes = chosen.reduce(
+      (s, x) => s + (x.is_dir ? 0 : (x.size_in_bytes || 0)), 0);
+    insp.appendChild(el("div", "meta", `${fmtBytes(bytes)} total`));
+    return;
+  }
   insp.appendChild(el("h3", "",
     n.name + (n.extension ? "." + n.extension : "")));
   const dl = el("dl");
@@ -133,6 +164,8 @@ export async function select(n) {
 
 export function closeInspector() {
   state.selected = null;
+  state.selectedIds = new Set();  // a dismissed selection must not
+  // stay live for batch context-menu operations
   updateSelection();
   $("inspector").classList.remove("open");
 }

@@ -9,7 +9,8 @@ export const state = {
   path: "/",                       // materialized path inside the location
   mode: "browse",                  // browse | search | duplicates
   view: localStorage.getItem("sd-view") || "grid",
-  nodes: [], selected: null, locPaths: {}, locNames: {}, allTags: [],
+  nodes: [], selected: null, selectedIds: new Set(),
+  locPaths: {}, locNames: {}, allTags: [],
 };
 
 // late-bound cross-module calls (registered by app.js; avoids cycles)

@@ -87,11 +87,20 @@ export function renderCrumbs() {
 export function openDir(n) {
   state.path = (n.materialized_path || "/") + n.name + "/";
   state.selected = null;
+  state.selectedIds = new Set();
   loadContent(true);
+}
+
+/** Navigation context changed (folder/search/tag): drop the selection
+ *  so stale per-folder ids can't feed batch operations. */
+export function clearSelection() {
+  state.selected = null;
+  state.selectedIds = new Set();
 }
 
 export function upDir() {
   if (state.mode !== "browse" || !state.loc || state.path === "/") return;
+  clearSelection();
   const parts = state.path.split("/").filter(Boolean);
   parts.pop();
   state.path = "/" + parts.map(p => p + "/").join("");
@@ -141,7 +150,7 @@ function renderCards(c, mediaOnly, nodes) {
     if (mediaOnly && ![5,7].includes(n.object_kind)) continue;
     const card = el("div", "card");
     card.dataset.fp = String(n.id);
-    if (state.selected && state.selected.id === n.id)
+    if (state.selectedIds.has(n.id))
       card.classList.add("selected");
     const thumb = el("div", "thumb");
     if (n.cas_id && [5,7].includes(n.object_kind)) {
@@ -158,9 +167,10 @@ function renderCards(c, mediaOnly, nodes) {
       n.name + (n.extension ? "." + n.extension : "")));
     card.appendChild(el("div", "meta",
       n.is_dir ? "folder" : fmtBytes(n.size_in_bytes)));
-    card.onclick = () => bus.select(n);
+    card.onclick = (e) => bus.select(n, e);
     card.ondblclick = () => activate(n);
-    card.oncontextmenu = (e) => { e.preventDefault(); bus.select(n);
+    card.oncontextmenu = (e) => { e.preventDefault();
+      if (!state.selectedIds.has(n.id)) bus.select(n);
       bus.showMenu(e.clientX, e.clientY, n); };
     c.appendChild(card);
   }
@@ -170,7 +180,7 @@ function renderListRows(table, nodes) {
   for (const n of nodes) {
     const tr = el("tr");
     tr.dataset.fp = String(n.id);
-    if (state.selected && state.selected.id === n.id)
+    if (state.selectedIds.has(n.id))
       tr.classList.add("selected");
     const icon = n.is_dir ? "📁" : (KIND_ICON[n.object_kind] || "📄");
     tr.appendChild(el("td", "",
@@ -179,9 +189,10 @@ function renderListRows(table, nodes) {
     tr.appendChild(el("td", "", n.is_dir ? "" : fmtBytes(n.size_in_bytes)));
     tr.appendChild(el("td", "", (n.date_modified || "").slice(0, 16)));
     tr.appendChild(el("td", "", n.materialized_path || ""));
-    tr.onclick = () => bus.select(n);
+    tr.onclick = (e) => bus.select(n, e);
     tr.ondblclick = () => activate(n);
-    tr.oncontextmenu = (e) => { e.preventDefault(); bus.select(n);
+    tr.oncontextmenu = (e) => { e.preventDefault();
+      if (!state.selectedIds.has(n.id)) bus.select(n);
       bus.showMenu(e.clientX, e.clientY, n); };
     table.appendChild(tr);
   }
