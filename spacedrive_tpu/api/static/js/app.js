@@ -93,6 +93,7 @@ async function refreshNav() {
   const dup = el("div", "item", "♊ Duplicates");
   dup.onclick = () => { setActive(dup);
     Object.assign(state, {mode:"duplicates", loc:null, tag:null});
+    clearSelection();
     loadContent(true); };
   tools.appendChild(dup);
   $("stats").textContent =

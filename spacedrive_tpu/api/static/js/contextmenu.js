@@ -35,10 +35,12 @@ export function showMenu(x, y, n) {
   // when the clicked item is part of a multi-selection, batch ops
   // cover the whole selection (same location only — the jobs are
   // per-location like the reference's)
-  const chosen = state.selectedIds.has(n.id) && state.selectedIds.size > 1
-    ? state.nodes.filter(
-        x => state.selectedIds.has(x.id) && x.location_id === n.location_id)
-    : [n];
+  const multi = state.selectedIds.has(n.id) && state.selectedIds.size > 1;
+  // file jobs are per-location; spacedrop is path-based and takes the
+  // WHOLE selection regardless of location
+  const chosenAll = multi
+    ? state.nodes.filter(x => state.selectedIds.has(x.id)) : [n];
+  const chosen = chosenAll.filter(x => x.location_id === n.location_id);
   const many = chosen.length > 1;
   const label = (verb) => many ? `${verb} ${chosen.length} items` : verb;
 
@@ -86,8 +88,10 @@ export function showMenu(x, y, n) {
         sub_path: n.materialized_path || "/",
       }, state.lib)));
   }
-  menuEl.appendChild(item(label("📡 Spacedrop"), () =>
-    bus.openDropPanel(chosen.map(fullPath))));
+  menuEl.appendChild(item(
+    chosenAll.length > 1 ? `📡 Spacedrop ${chosenAll.length} items`
+                         : "📡 Spacedrop",
+    () => bus.openDropPanel(chosenAll.map(fullPath))));
 
   menuEl.appendChild(item(label("Delete"), () => modal("Delete?", (m, close) => {
     m.appendChild(el("p", "meta",

@@ -55,7 +55,7 @@ export function renderCrumbs() {
     const back = el("button", "mini", "clear");
     back.style.marginLeft = "8px";
     back.onclick = () => { state.mode = "browse"; state.search = "";
-      $("search").value = ""; loadContent(true); };
+      $("search").value = ""; clearSelection(); loadContent(true); };
     c.appendChild(back);
     return;
   }
@@ -72,7 +72,7 @@ export function renderCrumbs() {
     return;
   }
   seg("📂 " + (state.locNames[state.loc] || "location"), () => {
-    state.path = "/"; loadContent(true);
+    state.path = "/"; clearSelection(); loadContent(true);
   });
   const parts = state.path.split("/").filter(Boolean);
   let acc = "/";
@@ -80,7 +80,7 @@ export function renderCrumbs() {
     c.appendChild(el("span", "sep", "›"));
     acc += p + "/";
     const target = acc;
-    seg(p, () => { state.path = target; loadContent(true); });
+    seg(p, () => { state.path = target; clearSelection(); loadContent(true); });
   }
 }
 

@@ -123,6 +123,29 @@ async def cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+async def cmd_relay(args: argparse.Namespace) -> int:
+    """Run the standalone self-hosted relay: WAN sync collections over
+    HTTP + the P2P rendezvous (authenticated listen/dial splicing) —
+    the deployable form of what the reference's closed cloud provides."""
+    from .cloud.relay import CloudRelay
+
+    relay = CloudRelay()
+    port = await relay.start(host=args.host, port=args.port,
+                             p2p_port=args.p2p_port)
+    print(f"relay: sync API on http://{args.host}:{port}/api  "
+          f"(point nodes' --cloud at http://{args.host}:{port})")
+    print(f"relay: p2p rendezvous on {args.host}:{relay.p2p_port}  "
+          f"(point nodes' p2p.relay at {args.host}:{relay.p2p_port})")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await relay.shutdown()
+    return 0
+
+
 async def cmd_status(args: argparse.Namespace) -> int:
     node = _make_node(args, with_labeler=False)
     await node.start()
@@ -516,6 +539,13 @@ def build_parser() -> argparse.ArgumentParser:
     ld.add_argument("--steps", type=int, default=300)
     ld.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
 
+    rl = sub.add_parser(
+        "relay", help="run the standalone sync relay + P2P rendezvous"
+    )
+    rl.add_argument("--host", default="0.0.0.0")
+    rl.add_argument("--port", type=int, default=8490)
+    rl.add_argument("--p2p-port", type=int, default=8491)
+
     sub.add_parser("bench", help="run the headline benchmark")
     return p
 
@@ -526,6 +556,8 @@ def main(argv: list[str] | None = None) -> int:
         return asyncio.run(cmd_index(args))
     if args.cmd == "serve":
         return asyncio.run(cmd_serve(args))
+    if args.cmd == "relay":
+        return asyncio.run(cmd_relay(args))
     if args.cmd == "status":
         return asyncio.run(cmd_status(args))
     if args.cmd == "browse":

@@ -83,3 +83,70 @@ def test_cli_index_browse_crypto(tmp_path, capsys):
     )
     assert rc == 0
     assert secret.read_text() == "classified"
+
+
+def test_relay_command_serves_rendezvous(tmp_path):
+    """`sdx relay` runs the standalone relay: sync HTTP API up AND the
+    P2P rendezvous accepting authenticated registrations."""
+    import asyncio
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    async def run():
+        import aiohttp
+
+        from spacedrive_tpu.cli import cmd_relay
+        from spacedrive_tpu.p2p.identity import Identity
+        from spacedrive_tpu.p2p.relay import (
+            _LISTEN_CONTEXT, read_frame, write_frame,
+        )
+
+        class Args:
+            host = "127.0.0.1"
+            port = free_port()
+            p2p_port = free_port()
+
+        task = asyncio.ensure_future(cmd_relay(Args()))
+        try:
+            async with aiohttp.ClientSession() as http:
+                for _ in range(100):
+                    try:
+                        async with http.post(
+                            f"http://127.0.0.1:{Args.port}/api/libraries",
+                            json={"uuid": "u", "name": "n"},
+                        ) as resp:
+                            assert resp.status == 200
+                            break
+                    except aiohttp.ClientConnectorError:
+                        await asyncio.sleep(0.05)
+                else:
+                    raise TimeoutError("relay HTTP never came up")
+
+            ident = Identity()
+            r, w = await asyncio.open_connection("127.0.0.1", Args.p2p_port)
+            write_frame(w, {
+                "cmd": "listen",
+                "identity": str(ident.to_remote_identity()),
+                "meta": {},
+            })
+            await w.drain()
+            ch = await read_frame(r)
+            write_frame(w, {"sig": ident.sign(
+                _LISTEN_CONTEXT + bytes.fromhex(ch["challenge"])).hex()})
+            await w.drain()
+            assert (await read_frame(r)).get("ok") is True
+            w.close()
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    asyncio.run(run())
