@@ -57,10 +57,11 @@ async function selectLibrary(id) {
 }
 
 async function refreshNav() {
-  const [locs, tags, stats] = await Promise.all([
+  const [locs, tags, stats, saved] = await Promise.all([
     client.locations.list(null, state.lib),
     client.tags.list(null, state.lib),
     client.library.statistics(null, state.lib),
+    client.search.saved.list(null, state.lib),
   ]);
   state.locPaths = {};
   state.locNames = {};
@@ -88,6 +89,26 @@ async function refreshNav() {
       loadContent(true); };
     tagDiv.appendChild(item);
   }
+  const savDiv = $("saved");
+  savDiv.innerHTML = "";
+  for (const s of saved.nodes) {
+    const item = el("div", "item", `🔖 ${s.name || s.search || "?"}`);
+    item.onclick = () => { setActive(item);
+      Object.assign(state, {mode:"search", search:s.search || "",
+                            loc:null, tag:null, cursor:null});
+      $("search").value = state.search;
+      clearSelection();
+      loadContent(true); };
+    item.oncontextmenu = async (e) => {
+      e.preventDefault();
+      if (confirm(`delete saved search “${s.name || s.search}”?`)) {
+        await client.search.saved.delete(s.id, state.lib);
+        refreshNav();
+      }
+    };
+    savDiv.appendChild(item);
+  }
+
   const tools = $("tools");
   tools.innerHTML = "";
   const dup = el("div", "item", "♊ Duplicates");
@@ -118,6 +139,22 @@ $("search").addEventListener("keydown", (e) => {
   }
   if (e.key === "Escape") e.target.blur();
 });
+$("btn-save-search").onclick = async () => {
+  // commit whatever is in the box first — the button must never save
+  // a stale query or silently no-op on un-entered text
+  const text = $("search").value.trim();
+  if (!text) return;
+  if (text !== state.search || state.mode !== "search") {
+    state.search = text;
+    state.mode = "search";
+    clearSelection();
+    loadContent(true);
+  }
+  const name = prompt("save this search as…", text);
+  if (!name) return;
+  await client.search.saved.create({name, search: text}, state.lib);
+  refreshNav();
+};
 $("btn-addloc").onclick = () => addLocationModal();
 bus.showMenu = showMenu;
 wireJobsPanel();
@@ -172,7 +209,8 @@ sock.subscribe("invalidation.listen", (ev) => {
   $("events").textContent = `↻ ${ev.key}`;
   if (["search.paths", "locations.list", "tags.list"].includes(ev.key))
     loadContent(true);
-  if (ev.key === "locations.list" || ev.key === "tags.list") refreshNav();
+  if (["locations.list", "tags.list", "search.saved.list"].includes(ev.key))
+    refreshNav();
   if (ev.key === "library.list") loadLibraries();
   if (ev.key === "jobs.reports" &&
       $("jobs-panel").classList.contains("open")) renderJobs();

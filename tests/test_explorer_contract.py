@@ -233,6 +233,15 @@ def test_explorer_six_flows(tmp_path, corpus=None):
                     await asyncio.sleep(0.1)
                 assert not (root / "alpha.txt").exists()
 
+                # --- saved searches (nav section + save button) --------
+                sid = await _rspc(http, base, "search.saved.create",
+                                  {"name": "betas", "search": "bet"}, lib_id)
+                savs = await _rspc(http, base, "search.saved.list", None, lib_id)
+                assert [s["name"] for s in savs["nodes"]] == ["betas"]
+                await _rspc(http, base, "search.saved.delete", sid, lib_id)
+                savs = await _rspc(http, base, "search.saved.list", None, lib_id)
+                assert savs["nodes"] == []
+
                 # settings surface the panel binds to
                 ns = await _rspc(http, base, "nodeState")
                 assert "thumbnailer_background_percentage" in ns
