@@ -62,9 +62,12 @@ def build_mixed_corpus(root: str, n: int) -> None:
             size = rng.randrange(100 * 1024 + 1, 600 * 1024)
         off = rng.randrange(0, len(payload) - 1)
         with open(os.path.join(root, f"f{i:06d}.bin"), "wb") as f:
-            remaining = size
-            f.write(i.to_bytes(8, "little"))  # unique prefix → unique cas_id
-            remaining -= min(8, size)
+            # unique prefix → unique cas_id, COUNTED inside the drawn
+            # size so on-disk size matches the size class exactly (and
+            # size==0 really exercises the no-hash path)
+            prefix = i.to_bytes(8, "little")[:size]
+            f.write(prefix)
+            remaining = size - len(prefix)
             while remaining > 0:
                 take = min(remaining, len(payload) - off)
                 f.write(payload[off:off + take])

@@ -366,12 +366,14 @@ def cmd_labeler(args: argparse.Namespace) -> int:
                     classes = [ln.strip() for ln in f if ln.strip()]
             if args.src:
                 info = provision.import_artifact(
-                    args.src, labeler_dir, classes=classes
+                    args.src, labeler_dir, classes=classes,
+                    sha256=args.sha256,
                 )
             else:
                 url = args.url or provision.DEFAULT_MODEL_URL
                 print(f"downloading {url}…", file=sys.stderr, flush=True)
-                info = provision.fetch(url, labeler_dir, classes=classes)
+                info = provision.fetch(url, labeler_dir, classes=classes,
+                                       sha256=args.sha256)
         except Exception as e:  # noqa: BLE001 - CLI contract: JSON + rc 1
             print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
             return 1
@@ -521,6 +523,10 @@ def build_parser() -> argparse.ArgumentParser:
     lp.add_argument(
         "--url", default=None,
         help="ONNX download URL (default: the official YOLOv8n release asset)",
+    )
+    lp.add_argument(
+        "--sha256", default=None,
+        help="pin the download's sha256; mismatch aborts before install",
     )
     lp.add_argument(
         "--classes",

@@ -20,6 +20,7 @@ COCO vocabulary.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
@@ -74,11 +75,27 @@ def _validate_checkpoint(path: str) -> dict:
     }
 
 
+def _check_digest(path: str, sha256: str) -> None:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    if h.hexdigest().lower() != sha256.lower():
+        raise ProvisionError(
+            f"sha256 mismatch: artifact is {h.hexdigest()}, "
+            f"pinned {sha256.lower()} — refusing to install"
+        )
+
+
 def import_artifact(
-    src: str, labeler_dir: str, classes: list[str] | None = None
+    src: str, labeler_dir: str, classes: list[str] | None = None,
+    sha256: str | None = None,
 ) -> dict:
     """Validate `src` (.onnx or .npz) and install it as THE labeler
-    artifact. Returns an info dict (kind, path, classes, …)."""
+    artifact. Returns an info dict (kind, path, classes, …). A `sha256`
+    pin is checked before any validation or install."""
+    if sha256 is not None:
+        _check_digest(src, sha256)
     os.makedirs(labeler_dir, exist_ok=True)
     if src.endswith(".npz"):
         if classes:
@@ -123,9 +140,15 @@ def import_artifact(
 
 
 def fetch(url: str, labeler_dir: str, classes: list[str] | None = None,
-          timeout: float = 120.0) -> dict:
+          timeout: float = 120.0, sha256: str | None = None) -> dict:
     """Download an ONNX model (the reference's provisioning path) and
-    install it via `import_artifact`."""
+    install it via `import_artifact`.
+
+    `sha256` pins the artifact's digest: the download is rejected before
+    validation if it doesn't match, mirroring the reference's
+    version-pinned CDN flow (yolov8.rs pins by versioned path). Smoke
+    inference alone proves the file WORKS, not that it is the file you
+    meant to install — pin digests for any unauthenticated mirror."""
     os.makedirs(labeler_dir, exist_ok=True)
     tmp = tempfile.NamedTemporaryFile(suffix=".onnx", delete=False)
     try:
@@ -139,7 +162,8 @@ def fetch(url: str, labeler_dir: str, classes: list[str] | None = None,
                 "with `sdx labeler provision --from <model.onnx>` or train a "
                 "checkpoint with `sdx labeler train`"
             ) from e
-        return import_artifact(tmp.name, labeler_dir, classes=classes)
+        return import_artifact(tmp.name, labeler_dir, classes=classes,
+                               sha256=sha256)
     finally:
         tmp.close()
         os.unlink(tmp.name)

@@ -20,9 +20,11 @@ its metadata:
   node → relay   {"sig":hex}                  → {"ok":true}
   node → relay   {"cmd":"query"}              → {"peers":[{identity,meta}]}
   node → relay   {"cmd":"ping"}               → {"ok":true}
-  relay → node   {"event":"incoming","conn":N}
+  relay → node   {"event":"incoming","conn":tok}
   dialer → relay {"cmd":"dial","target":b58}  → {"ok":true} then raw pipe
-  node → relay   {"cmd":"accept","conn":N}    → {"ok":true} then raw pipe
+  node → relay   {"cmd":"accept","conn":tok}  → {"ok":true} then raw pipe
+`tok` is an unguessable 128-bit token known only to the listener the
+incoming event was sent to, so a third party cannot race the accept.
 Dialing needs no relay-level auth: the end-to-end handshake pins the
 expected identity, so a misrouted pipe just fails to authenticate.
 """
@@ -30,7 +32,6 @@ expected identity, so a misrouted pipe just fails to authenticate.
 from __future__ import annotations
 
 import asyncio
-import itertools
 import json
 import logging
 import secrets
@@ -93,9 +94,13 @@ class RelayServer:
     def __init__(self) -> None:
         self._listeners: dict[str, asyncio.StreamWriter] = {}
         self._meta: dict[str, dict[str, Any]] = {}
-        self._pending: dict[int, tuple[asyncio.StreamReader, asyncio.StreamWriter,
+        # conn ids are unguessable tokens: the accept claim arrives on a
+        # fresh TCP connection, so a guessable id would let any client
+        # race the legitimate listener and steal the pending pipe
+        # (killing the dial — availability, not confidentiality, since
+        # the end-to-end handshake still prevents impersonation)
+        self._pending: dict[str, tuple[asyncio.StreamReader, asyncio.StreamWriter,
                                        "asyncio.Future[None]"]] = {}
-        self._conn_ids = itertools.count(1)
         self._server: asyncio.base_events.Server | None = None
         self.port: int | None = None
 
@@ -203,7 +208,7 @@ class RelayServer:
             await writer.drain()
             writer.close()
             return
-        conn_id = next(self._conn_ids)
+        conn_id = secrets.token_hex(16)
         accepted: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[conn_id] = (reader, writer, accepted)
         try:
@@ -221,7 +226,7 @@ class RelayServer:
         # on success the accept side owns the splice; nothing more here
 
     async def _serve_accept(self, reader, writer, msg) -> None:
-        entry = self._pending.pop(int(msg.get("conn", -1)), None)
+        entry = self._pending.pop(str(msg.get("conn", "")), None)
         if entry is None:
             write_frame(writer, {"ok": False, "error": "unknown conn"})
             await writer.drain()
@@ -390,7 +395,7 @@ class RelayClient:
 
     # --- streams --------------------------------------------------------
 
-    async def _accept(self, conn_id: int) -> None:
+    async def _accept(self, conn_id: str) -> None:
         """Dial back to the relay, claim the conn, run the SERVER side
         of the Noise handshake through the pipe."""
         try:

@@ -56,6 +56,7 @@ class WindowPipeline(Generic[T]):
         self.stats = PipelineStats()
         self._queue: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
+        self._done = False
         self._fetch = fetch
         self._error: BaseException | None = None
         self._thread = threading.Thread(
@@ -95,7 +96,15 @@ class WindowPipeline(Generic[T]):
         """Next window in order; None at end of stream (raises if the
         producer died) or after close(). The time the consumer spent
         blocked is recorded as a prefetch miss; instant handoffs count
-        as hits."""
+        as hits. Once the end-of-stream sentinel has been consumed every
+        further take() returns None immediately — the producer thread
+        has exited and there is only one sentinel, so without this latch
+        an extra take() (steps outnumbering windows, e.g. the orphan set
+        shrank mid-run) would spin forever."""
+        if self._done:
+            if self._error is not None:
+                raise self._error
+            return None
         t0 = time.perf_counter()
         while True:
             try:
@@ -114,8 +123,10 @@ class WindowPipeline(Generic[T]):
                 self.stats.prefetch_hits += 1
             else:
                 self.stats.prefetch_misses += 1
-        if window is None and self._error is not None:
-            raise self._error
+        if window is None:
+            self._done = True
+            if self._error is not None:
+                raise self._error
         return window
 
     def close(self) -> None:

@@ -372,3 +372,31 @@ def test_provision_rejects_garbage_and_mismatched_classes(tmp_path):
         provision.import_artifact(
             "whatever.npz", str(tmp_path / "dir3"), classes=["a"]
         )
+
+
+def test_provision_fetch_sha256_pin(tmp_path):
+    """Advisor r3: a pinned digest gates the install BEFORE validation;
+    a matching pin lets the artifact proceed to the normal validator."""
+    import hashlib
+
+    from spacedrive_tpu.models import provision
+
+    src = tmp_path / "artifact.onnx"
+    src.write_bytes(b"definitely not the pinned bytes")
+    url = "file://" + str(src)
+
+    with pytest.raises(provision.ProvisionError, match="sha256 mismatch"):
+        provision.fetch(url, str(tmp_path / "dir"), sha256="ab" * 32)
+    assert not os.path.exists(tmp_path / "dir" / "model.onnx")
+
+    # matching pin passes the gate — the next failure is the VALIDATOR
+    # complaining about the garbage payload, not the digest check
+    good_pin = hashlib.sha256(src.read_bytes()).hexdigest().upper()  # case-insensitive
+    with pytest.raises(Exception) as exc:
+        provision.fetch(url, str(tmp_path / "dir"), sha256=good_pin)
+    assert "sha256 mismatch" not in str(exc.value)
+
+    # the local-import path honours the pin too (not just downloads)
+    with pytest.raises(provision.ProvisionError, match="sha256 mismatch"):
+        provision.import_artifact(str(src), str(tmp_path / "dir"),
+                                  sha256="cd" * 32)

@@ -161,3 +161,33 @@ def test_window_pipeline_depth_order_and_errors():
     pipe.close()
     assert time.perf_counter() - t0 < 2
     assert not pipe._thread.is_alive()
+
+
+def test_window_pipeline_take_after_exhaustion_returns_none_fast():
+    """Advisor r3 (medium): the single end-of-stream sentinel must latch.
+
+    If the consumer pops more steps than there are windows (the orphan
+    set shrank between COUNT and the run), extra take() calls after the
+    sentinel must return None immediately — not spin on an empty queue
+    behind a dead producer."""
+    from spacedrive_tpu.parallel import WindowPipeline
+
+    pipe = WindowPipeline(lambda k: None if k >= 2 else (k + 1, k), 0, depth=2)
+    assert pipe.take() == 0
+    assert pipe.take() == 1
+    assert pipe.take() is None  # consumes THE sentinel
+    for _ in range(3):  # every further take must return instantly
+        t0 = time.perf_counter()
+        assert pipe.take() is None
+        assert time.perf_counter() - t0 < 0.05
+    pipe.close()
+
+    # error case latches too (and keeps raising)
+    def bad(k):
+        raise RuntimeError("boom")
+
+    pipe = WindowPipeline(bad, 0, depth=1)
+    for _ in range(2):
+        with pytest.raises(RuntimeError, match="boom"):
+            pipe.take()
+    pipe.close()
