@@ -46,8 +46,12 @@ def log(msg: str) -> None:
 
 
 def median_spread(samples: list[float]) -> tuple[float, float, float]:
+    """(median, lo, hi); even counts average the middle pair so a
+    2-sample run doesn't systematically record its slower sample."""
     s = sorted(samples)
-    return s[len(s) // 2], s[0], s[-1]
+    mid = len(s) // 2
+    med = s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2
+    return med, s[0], s[-1]
 
 
 def main() -> None:
@@ -108,7 +112,10 @@ def main() -> None:
     for i in range(chain_k):
         a = arr.copy()
         a[:, 0] = i  # defeat any result caching
-        distinct.append(jax.device_put(a))
+        # u32 view = production's host-side reinterpret (hash_batch does
+        # this for numpy callers); same bytes on the wire, and the
+        # device skips the byte-pack pass (PROFILE.md)
+        distinct.append(jax.device_put(a.view(np.uint32)))
     jax.block_until_ready(distinct[-1])
 
     def chain(k: int) -> None:
@@ -128,7 +135,7 @@ def main() -> None:
 
     def refresh_all(rep: int) -> None:
         for i in range(chain_k):
-            distinct[i] = freshen(distinct[i], np.uint8((rep * chain_k + i) % 251))
+            distinct[i] = freshen(distinct[i], np.uint32((rep * chain_k + i) % 251))
         jax.block_until_ready(distinct[-1])
 
     chain(chain_k)  # warm/compile

@@ -227,13 +227,18 @@ def probe_link(wait_budget: float | None = None) -> float:
 
 
 def timed_runs(corpus_dir: str, tmp: str, tag: str, phase: str,
-               backend_pairs) -> dict:
+               backend_pairs, probes: dict | None = None) -> dict:
     """Run the scan N times per backend (per backend_pairs) on fresh
     nodes; returns per-backend the run closest to the median `phase`
     timing, with that timing REPLACED by the median and the [lo, med,
-    hi] spread attached."""
+    hi] spread attached. `probes` is filled with pre/post link probes
+    taken IMMEDIATELY around the device-backend reps (not around the
+    whole config — the CPU reps that follow can take minutes, and a
+    spike during them must not condemn valid device figures)."""
     out = {}
     for name, use_device, backend, reps in backend_pairs:
+        if name == "device" and probes is not None:
+            probes["pre"] = round(probe_link(0), 3)
         runs = []
         for r in range(max(1, reps)):
             data_dir = os.path.join(tmp, f"node-{tag}-{name}-{r}")
@@ -251,20 +256,22 @@ def timed_runs(corpus_dir: str, tmp: str, tag: str, phase: str,
         chosen[f"{phase}_spread"] = [round(lo, 2), round(med, 2),
                                      round(hi, 2)]
         out[name] = chosen
+        if name == "device" and probes is not None:
+            probes["post"] = round(probe_link(0), 3)
     return out
 
 
 def probed(config_fn, *args) -> dict:
-    """Bracket a config's device measurements with link probes and
-    annotate the result: the device figures inside are trustworthy only
-    if the link was healthy both before and after."""
-    pre = round(probe_link(0), 3)
-    result = config_fn(*args)
-    post = round(probe_link(0), 3)
-    result["link_probe_gbps"] = {"pre": pre, "post": post}
-    if min(pre, post) < CONGESTION_GBPS:
+    """Run a config with link probes bracketing its DEVICE measurements
+    (the config fn fills `probes` via timed_runs or its own timing
+    loop) and annotate the result: device figures are trustworthy only
+    if the link was healthy both immediately before and after them."""
+    probes: dict = {}
+    result = config_fn(*args, probes)
+    result["link_probe_gbps"] = probes
+    if probes and min(probes.values()) < CONGESTION_GBPS:
         result["blocked"] = "congested-link"
-        log(f"  CONFIG BLOCKED: link probe {min(pre, post):.2f} GB/s < "
+        log(f"  CONFIG BLOCKED: link probe {min(probes.values()):.2f} GB/s < "
             f"{CONGESTION_GBPS} — device figures measure the tunnel, "
             "not the framework")
     return result
@@ -273,7 +280,7 @@ def probed(config_fn, *args) -> dict:
 # --- configs ---------------------------------------------------------------
 
 
-def config_1(tmp: str, n_files: int, repeats: int) -> dict:
+def config_1(tmp: str, n_files: int, repeats: int, probes: dict) -> dict:
     log(f"config 1: identifier pass, {n_files} mixed files…")
     corpus = os.path.join(tmp, "corpus1")
     t0 = time.perf_counter()
@@ -282,7 +289,7 @@ def config_1(tmp: str, n_files: int, repeats: int) -> dict:
     runs = timed_runs(corpus, tmp, "c1", "identifier_s", [
         ("device", True, "tpu", repeats),
         ("cpu", False, "cpu", max(1, repeats - 1)),
-    ])
+    ], probes)
     dev_fps = runs["device"]["files"] / runs["device"]["identifier_s"]
     cpu_fps = runs["cpu"]["files"] / runs["cpu"]["identifier_s"]
     return {
@@ -300,14 +307,14 @@ def config_1(tmp: str, n_files: int, repeats: int) -> dict:
     }
 
 
-def config_3(tmp: str, n_images: int, repeats: int) -> dict:
+def config_3(tmp: str, n_images: int, repeats: int, probes: dict) -> dict:
     log(f"config 3: thumbnail pass, {n_images} JPEGs…")
     corpus = os.path.join(tmp, "corpus3")
     build_image_corpus(corpus, n_images)
     runs = timed_runs(corpus, tmp, "c3", "media_s", [
         ("device", True, "tpu", repeats),
         ("cpu", False, "cpu", max(1, repeats - 1)),
-    ])
+    ], probes)
     dev = runs["device"]["thumbnails"] / runs["device"]["media_s"]
     cpu = runs["cpu"]["thumbnails"] / runs["cpu"]["media_s"]
     return {
@@ -321,14 +328,14 @@ def config_3(tmp: str, n_images: int, repeats: int) -> dict:
     }
 
 
-def config_4(tmp: str, n_clips: int, repeats: int) -> dict:
+def config_4(tmp: str, n_clips: int, repeats: int, probes: dict) -> dict:
     log(f"config 4: video thumbnails, {n_clips} clips…")
     corpus = os.path.join(tmp, "corpus4")
     build_video_corpus(corpus, n_clips)
     runs = timed_runs(corpus, tmp, "c4", "media_s", [
         ("device", True, "tpu", repeats),
         ("cpu", False, "cpu", max(1, repeats - 1)),
-    ])
+    ], probes)
     dev = runs["device"]["thumbnails"] / runs["device"]["media_s"]
     cpu = runs["cpu"]["thumbnails"] / runs["cpu"]["media_s"]
     return {
@@ -342,7 +349,7 @@ def config_4(tmp: str, n_clips: int, repeats: int) -> dict:
     }
 
 
-def config_5(tmp: str, n_images: int, repeats: int) -> dict:
+def config_5(tmp: str, n_images: int, repeats: int, probes: dict) -> dict:
     """Dedup: device pHash + all-pairs Hamming vs numpy oracle, over a
     corpus with planted near-duplicates."""
     from PIL import Image
@@ -396,6 +403,7 @@ def config_5(tmp: str, n_images: int, repeats: int) -> dict:
     # packed-bitmap readback — never materializes N² on the host);
     # median of `repeats` timed passes after the compile pass
     dev_pairs = set(phash_jax.near_pairs(hashes, 10))  # warm/compile
+    probes["pre"] = round(probe_link(0), 3)
     dev_times = []
     for _ in range(max(1, repeats)):
         t0 = time.perf_counter()
@@ -403,6 +411,7 @@ def config_5(tmp: str, n_images: int, repeats: int) -> dict:
         dev_times.append(time.perf_counter() - t0)
         assert got == dev_pairs
     device_s, dev_lo, dev_hi = median_spread(dev_times)
+    probes["post"] = round(probe_link(0), 3)
 
     packed = np.frombuffer(b"".join(hashes), dtype=">u8")
     popcnt = np.array([bin(i).count("1") for i in range(256)], np.uint16)
@@ -519,22 +528,18 @@ def regression_notes(new: dict, prev: dict | None) -> list[str]:
     return notes
 
 
-def health_score(doc: dict) -> tuple[int, float]:
-    """(probe-validated config count, min probe) — higher is better.
-    Only configs that actually carry per-config probes count as
-    validated: a legacy artifact (pre-probe format, e.g. recorded
-    entirely inside a congestion window with no annotations) scores
-    zero and never out-ranks a probe-validated recording."""
+def health_score(doc: dict) -> int:
+    """Count of probe-validated (unblocked) configs — higher is
+    better; ties go to the NEWER run (fresh data must be able to
+    replace a stale artifact, or the regression guard can never land
+    a real regression in the canonical file). Only configs that carry
+    per-config probes count: a legacy artifact (pre-probe format)
+    scores zero and never out-ranks a probe-validated recording."""
     present = [doc.get(c) for c in CONFIG_METRICS if doc.get(c)]
-    ok = sum(
+    return sum(
         1 for c in present
         if c.get("link_probe_gbps") and not c.get("blocked")
     )
-    probes = [
-        p for c in present
-        for p in (c.get("link_probe_gbps") or {}).values()
-    ]
-    return (ok, min(probes) if probes else 0.0)
 
 
 def main() -> None:

@@ -105,28 +105,34 @@ def _parent_cvs(left: jax.Array, right: jax.Array, flags: jax.Array) -> jax.Arra
     return jnp.stack(out, axis=-1)
 
 
-def _chunk_cvs(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> tuple[jax.Array, jax.Array]:
+def _as_words(msgs: jax.Array, max_chunks: int) -> jax.Array:
+    """uint32[B, C*256] message words (natural LE order) from either a
+    uint8[B, C*1024] byte array (device bitcast — the words ARE the
+    little-endian byte stream) or an already-viewed uint32 array (the
+    host path: `np.view(np.uint32)` is a zero-copy reinterpret, so
+    numpy callers skip the device pass entirely)."""
+    if msgs.dtype == jnp.uint32:
+        return msgs
+    b_dim = msgs.shape[0]
+    return jax.lax.bitcast_convert_type(
+        msgs.reshape(b_dim, max_chunks, 16, 16, 4), _U
+    ).reshape(b_dim, max_chunks * 256)
+
+
+def _chunk_cvs(words: jax.Array, lengths: jax.Array, max_chunks: int) -> tuple[jax.Array, jax.Array]:
     """All chunk chaining values.
 
-    msgs: uint8[B, max_chunks*1024]; lengths: int32[B].
+    words: uint32[B, max_chunks*256] natural-order LE message words
+    (see `_as_words`); lengths: int32[B].
     Returns (cvs: uint32[B, C, 8], n_chunks: int32[B]). Single-chunk
     files get their ROOT flag here.
     """
-    b_dim, padded = msgs.shape
+    b_dim, wpad = words.shape
     c_dim = max_chunks
-    assert padded == c_dim * CHUNK_LEN
+    assert wpad == c_dim * 256
 
     lengths = lengths.astype(jnp.int32)
     n_chunks = jnp.maximum(1, (lengths + CHUNK_LEN - 1) // CHUNK_LEN)  # [B]
-
-    # uint8 bytes -> LE uint32 words via bitcast (the message words ARE
-    # the little-endian byte stream — no gather/shift packing needed;
-    # the 4-gather version measured ~25 ms/batch slower on a v5e), laid
-    # out [block, word, B*C] so each step reads 16 contiguous [N] rows.
-    words = jax.lax.bitcast_convert_type(
-        msgs.reshape(b_dim, c_dim, 16, 16, 4), _U
-    )  # [B, C, 16, 16]
-    words = words.transpose(2, 3, 0, 1).reshape(16, 16, b_dim * c_dim)  # [blk, word, N]
 
     n = b_dim * c_dim
     chunk_idx = jnp.repeat(jnp.arange(c_dim, dtype=jnp.int32)[None, :], b_dim, axis=0).reshape(n)
@@ -140,12 +146,14 @@ def _chunk_cvs(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> tuple[ja
     mode = _pallas_mode_static.get("mode")
     if mode is not None:
         # Pallas kernel for the hot stage (ops/blake3_pallas.py): it
-        # derives block_len/flags/active from the compact per-lane
-        # vectors in VMEM, so only [N]-sized arrays cross HBM
+        # reads the natural [N, 256] layout (contiguous HBM — the
+        # word-major transpose happens per-tile in VMEM) and derives
+        # block_len/flags/active from the compact per-lane vectors, so
+        # beyond the message words only [N]-sized arrays cross HBM
         from . import blake3_pallas
 
         h_fin8 = blake3_pallas.chunk_cvs(
-            words,
+            words.reshape(n, 256),
             chunk_len.astype(_U)[None, :],
             is_root_chunk.astype(_U)[None, :],
             t_lo[None, :],
@@ -153,6 +161,10 @@ def _chunk_cvs(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> tuple[ja
         )  # [8, N]
         cvs = h_fin8.T.reshape(b_dim, c_dim, 8)
         return cvs, n_chunks
+
+    # XLA fallback: word-major [blk, word, N] layout so each scan step
+    # reads 16 contiguous [N] rows
+    wm = words.reshape(b_dim, c_dim, 16, 16).transpose(2, 3, 0, 1).reshape(16, 16, n)
 
     n_blocks = jnp.maximum(1, (chunk_len + BLOCK_LEN - 1) // BLOCK_LEN)
     blk = jnp.arange(16, dtype=jnp.int32)[:, None]  # [16, 1]
@@ -175,7 +187,7 @@ def _chunk_cvs(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> tuple[ja
         h_new = [jnp.where(act, out[i], h[i]) for i in range(8)]
         return h_new, None
 
-    h_fin, _ = jax.lax.scan(step, h0, (words, block_len.astype(_U), flags, active))
+    h_fin, _ = jax.lax.scan(step, h0, (wm, block_len.astype(_U), flags, active))
     cvs = jnp.stack(h_fin, axis=-1).reshape(b_dim, c_dim, 8)
     return cvs, n_chunks
 
@@ -236,7 +248,7 @@ def _tree_reduce(cvs: jax.Array, n_chunks: jax.Array) -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=("max_chunks",))
 def _hash_batch_impl(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> jax.Array:
-    cvs, n_chunks = _chunk_cvs(msgs, lengths, max_chunks)
+    cvs, n_chunks = _chunk_cvs(_as_words(msgs, max_chunks), lengths, max_chunks)
     return _tree_reduce(cvs, n_chunks)
 
 
@@ -274,15 +286,27 @@ def _resolve_pallas_mode() -> str | None:
 
 
 def hash_batch(msgs, lengths, max_chunks: int | None = None) -> jax.Array:
-    """Hash B messages. msgs: uint8[B, C*1024] (zero-padded), lengths:
-    int32[B] actual byte counts. Returns uint32[B, 8] — the first 32
-    digest bytes as LE words (all the framework ever needs: cas_id is 8
-    bytes, validator checksum 32). The chunk stage runs as a Pallas
-    kernel on real TPUs (ops/blake3_pallas.py), XLA otherwise; any
-    Pallas failure permanently falls back to the XLA path."""
-    msgs = jnp.asarray(msgs, jnp.uint8)
+    """Hash B messages. msgs: uint8[B, C*1024] (zero-padded) or its
+    uint32[B, C*256] LE-word view; lengths: int32[B] actual byte
+    counts. Returns uint32[B, 8] — the first 32 digest bytes as LE
+    words (all the framework ever needs: cas_id is 8 bytes, validator
+    checksum 32). Numpy byte arrays are reinterpreted as uint32 on the
+    HOST (a zero-copy view — same transfer bytes, and the device skips
+    the byte-pack pass entirely; see PROFILE.md). The chunk stage runs
+    as a Pallas kernel on real TPUs (ops/blake3_pallas.py), XLA
+    otherwise; any Pallas failure permanently falls back to the XLA
+    path."""
+    import numpy as np
+
+    if not hasattr(msgs, "dtype"):  # lists / bytes-likes
+        msgs = np.asarray(msgs, np.uint8)
+    if isinstance(msgs, np.ndarray) and msgs.dtype == np.uint8:
+        msgs = np.ascontiguousarray(msgs).view(np.uint32)
+    if msgs.dtype not in (jnp.uint8, jnp.uint32):
+        msgs = jnp.asarray(msgs, jnp.uint8)
     if max_chunks is None:
-        max_chunks = msgs.shape[1] // CHUNK_LEN
+        words_per_chunk = 256 if msgs.dtype == jnp.uint32 else CHUNK_LEN
+        max_chunks = msgs.shape[1] // words_per_chunk
     lengths = jnp.asarray(lengths, jnp.int32)
     mode = _resolve_pallas_mode()
     if mode is not None:

@@ -3,16 +3,28 @@
 The chunk stage is ~94% of the hash FLOPs (16 blocks × 7 rounds of the
 compression permutation per 1 KiB chunk; the tree merge above it is
 O(log C)). This kernel runs that stage as one Pallas program over lane
-tiles: every buffer lives in VMEM laid out `[..., LANES]` so the VPU's
-8×128 registers vectorize across chunk lanes, with HOST-precomputed
-message schedules (perm^r applied to static indices — no in-kernel
-gathers). On real TPUs BOTH loops — the 16-block walk and the 7
-rounds — are fully unrolled: a `fori_loop` carrying the `[8, LANES]`
-state costs a Mosaic layout round-trip per block and measured 5.5×
-slower on a v5e (31 ms vs 5.6 ms marginal for a 4096×57-chunk batch;
-chained-dispatch timing, distinct inputs). Interpret mode (tests)
-keeps the block walk ROLLED instead — the unrolled body is a ~5k-op
-graph whose CPU compile takes minutes (see _build_kernel).
+tiles, reading the message words in their NATURAL layout `[N, 256]`
+(chunk-major — exactly the bytes as they sit in HBM after a free host
+uint32 view) and transposing each `[L, 256]` tile to `[256, L]` inside
+VMEM so the VPU's 8×128 registers vectorize across chunk lanes. Message
+schedules are host-precomputed (perm^r applied to static indices — no
+in-kernel gathers).
+
+Round 4 finding (device trace, PROFILE.md): the previous design fed the
+kernel `[16, 16, N]` word-major data, which forced XLA to materialize a
+~235 MB HBM transpose + byte-pack around a 0.8 ms kernel — ~13 ms of
+data movement per 4096×57-chunk batch. Moving the transpose INSIDE the
+kernel (VMEM, per-tile) and bitcasting on the HOST (numpy view — zero
+copy) cut the dispatch from ~13.7 ms to ~5.4 ms measured on a v5e
+(chained-marginal timing, distinct inputs); the in-VMEM transpose costs
+~3.9 ms of the 5.4 and is the remaining optimization frontier.
+
+On real TPUs BOTH loops — the 16-block walk and the 7 rounds — are
+fully unrolled: a `fori_loop` carrying the `[8, L]` state costs a
+Mosaic layout round-trip per block and measured 5.5× slower on a v5e.
+Interpret mode (tests) keeps the block walk ROLLED instead — the
+unrolled body is a ~5k-op graph whose CPU compile takes minutes
+(see _build_kernel).
 
 Bit-exactness contract is identical to ops/blake3_jax.py (golden-tested
 against the reference vectors); `ops/blake3_jax.hash_batch` calls this
@@ -30,7 +42,7 @@ import numpy as np
 
 from .blake3_ref import BLOCK_LEN, CHUNK_END, CHUNK_START, IV, MSG_PERMUTATION, ROOT
 
-LANES = 2048  # big-batch lane tile: [16,16,2048] words ≈ 2 MiB VMEM (scoped limit 16 MiB)
+LANES = 2048  # big-batch lane tile: [2048, 256] words ≈ 2 MiB VMEM (scoped limit 16 MiB)
 LANES_SMALL = 512  # small batches / interpret mode: avoid the pad-to-tile floor
 _ROUNDS = 7
 
@@ -69,6 +81,12 @@ def _build_kernel(unroll: bool = True):
     def kernel(words_ref, chunk_len_ref, is_root_ref, t_ref, out_ref):
         lanes = out_ref.shape[1]
         zeros = jnp.zeros((lanes,), U)
+        # one in-VMEM transpose per tile: [L, 256] natural (contiguous
+        # HBM reads) -> [256, L] so each message word is a lane vector.
+        # Cheaper than the XLA HBM transpose it replaces (see module
+        # docstring), and int32 idioms throughout — Mosaic has no
+        # unsigned vector max (arith.maxui).
+        wt = jnp.transpose(words_ref[...], (1, 0))
         # per-block block_len/flags/active derive from the compact
         # per-lane chunk_len IN-KERNEL: shipping them as [16, N] arrays
         # cost ~4 ms/batch of HBM traffic + XLA prologue on a v5e
@@ -79,7 +97,7 @@ def _build_kernel(unroll: bool = True):
 
         def block_step(b, h):
             """One 64-byte block over all lanes; `b` may be traced."""
-            m = [words_ref[b, j] for j in range(16)]
+            m = [wt[b * 16 + j] for j in range(16)]
             blen = jnp.clip(chunk_len - b * BLOCK_LEN, 0, BLOCK_LEN).astype(U)
             last = n_blocks == (b + 1)
             flags = jnp.where(last, U(CHUNK_END), U(0))
@@ -140,16 +158,16 @@ def _chunk_cvs_call(interpret: bool, lanes: int):
 
     @functools.partial(jax.jit, static_argnames=())
     def run(words, chunk_len, is_root, t_lo):
-        """words [16,16,N]; chunk_len/is_root/t_lo [1,N] (N a multiple
-        of `lanes`) -> cvs [8, N] uint32."""
-        n = words.shape[2]
+        """words [N, 256] natural chunk-major; chunk_len/is_root/t_lo
+        [1, N] (N a multiple of `lanes`) -> cvs [8, N] uint32."""
+        n = words.shape[0]
         grid = (n // lanes,)
         return pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((8, n), jnp.uint32),
             grid=grid,
             in_specs=[
-                pl.BlockSpec((16, 16, lanes), lambda i: (0, 0, i), **mem),
+                pl.BlockSpec((lanes, 256), lambda i: (i, 0), **mem),
                 pl.BlockSpec((1, lanes), lambda i: (0, i), **mem),
                 pl.BlockSpec((1, lanes), lambda i: (0, i), **mem),
                 pl.BlockSpec((1, lanes), lambda i: (0, i), **mem),
@@ -183,18 +201,19 @@ def pallas_mode() -> str | None:
 
 def chunk_cvs(words, chunk_len, is_root, t_lo, *, interpret: bool):
     """Pad the lane dim to the chosen tile and run the kernel; returns
-    [8, N]. Inputs beyond `words` are compact per-lane vectors [1, N]
-    (block_len/flags/active derive in-kernel). Big batches use the wide
-    tile (fewer grid steps); small batches and interpret mode use the
-    small one so the pad-to-tile floor stays cheap."""
+    [8, N]. `words` is [N, 256] natural layout; the other inputs are
+    compact per-lane vectors [1, N] (block_len/flags/active derive
+    in-kernel). Big batches use the wide tile (fewer grid steps); small
+    batches and interpret mode use the small one so the pad-to-tile
+    floor stays cheap."""
     import jax.numpy as jnp
 
-    n = words.shape[2]
+    n = words.shape[0]
     lanes = LANES_SMALL if (interpret or n < 4 * LANES) else LANES
     pad = (-n) % lanes
     if pad:
-        words = jnp.pad(words, ((0, 0), (0, 0), (0, pad)))
         # pad lanes hash as zero-length chunks; their CVs are sliced off
+        words = jnp.pad(words, ((0, pad), (0, 0)))
         chunk_len = jnp.pad(chunk_len, ((0, 0), (0, pad)))
         is_root = jnp.pad(is_root, ((0, 0), (0, pad)))
         t_lo = jnp.pad(t_lo, ((0, 0), (0, pad)))
