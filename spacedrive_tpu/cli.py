@@ -128,8 +128,13 @@ async def cmd_relay(args: argparse.Namespace) -> int:
     HTTP + the P2P rendezvous (authenticated listen/dial splicing) —
     the deployable form of what the reference's closed cloud provides."""
     from .cloud.relay import CloudRelay
+    from .p2p.relay import RelayLimits
 
-    relay = CloudRelay()
+    relay = CloudRelay(p2p_limits=RelayLimits(
+        max_pipes_per_target=args.max_pipes_per_target,
+        max_pipes_total=args.max_pipes,
+        pipe_rate_bytes_per_s=args.pipe_rate,
+    ))
     port = await relay.start(host=args.host, port=args.port,
                              p2p_port=args.p2p_port)
     print(f"relay: sync API on http://{args.host}:{port}/api  "
@@ -138,7 +143,10 @@ async def cmd_relay(args: argparse.Namespace) -> int:
           f"(point nodes' p2p.relay at {args.host}:{relay.p2p_port})")
     try:
         while True:
-            await asyncio.sleep(3600)
+            await asyncio.sleep(args.stats_interval or 3600)
+            if args.stats_interval:
+                s = relay.p2p_relay.stats.snapshot()
+                print(f"relay stats: {json.dumps(s)}", flush=True)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
@@ -551,6 +559,14 @@ def build_parser() -> argparse.ArgumentParser:
     rl.add_argument("--host", default="0.0.0.0")
     rl.add_argument("--port", type=int, default=8490)
     rl.add_argument("--p2p-port", type=int, default=8491)
+    rl.add_argument("--max-pipes-per-target", type=int, default=8,
+                    help="concurrent relayed pipes per listening identity")
+    rl.add_argument("--max-pipes", type=int, default=256,
+                    help="concurrent relayed pipes across the relay")
+    rl.add_argument("--pipe-rate", type=int, default=None, metavar="BYTES_PER_S",
+                    help="per-direction byte-rate cap per pipe (default unlimited)")
+    rl.add_argument("--stats-interval", type=float, default=60.0,
+                    help="seconds between stats log lines (0 disables)")
 
     sub.add_parser("bench", help="run the headline benchmark")
     return p

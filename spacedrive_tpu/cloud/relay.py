@@ -30,7 +30,7 @@ from aiohttp import web
 
 
 class CloudRelay:
-    def __init__(self) -> None:
+    def __init__(self, p2p_limits=None) -> None:
         self.libraries: dict[str, dict[str, Any]] = {}
         self._collection_ids = itertools.count(1)
         self.app = web.Application()
@@ -54,7 +54,7 @@ class CloudRelay:
         # files-over-P2P for non-LAN peers, not just sync
         from ..p2p.relay import RelayServer
 
-        self.p2p_relay = RelayServer()
+        self.p2p_relay = RelayServer(limits=p2p_limits)
         self.p2p_port: int | None = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0,

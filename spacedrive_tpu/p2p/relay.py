@@ -23,8 +23,16 @@ its metadata:
   relay → node   {"event":"incoming","conn":tok}
   dialer → relay {"cmd":"dial","target":b58}  → {"ok":true} then raw pipe
   node → relay   {"cmd":"accept","conn":tok}  → {"ok":true} then raw pipe
+  any → relay    {"cmd":"stats"}              → {"ok":true,"stats":{…}}
 `tok` is an unguessable 128-bit token known only to the listener the
 incoming event was sent to, so a third party cannot race the accept.
+
+Resource accounting (libp2p circuit-v2's relay limits play this role in
+the reference): per-target pipe caps, a global pipe cap, and an optional
+per-pipe-direction byte-rate cap enforced in the splice loop, so one
+greedy peer can neither hoard all pipes nor saturate the relay's
+bandwidth and starve other pipes. Counters ride the `stats` command and
+`sdx relay` logs them.
 Dialing needs no relay-level auth: the end-to-end handshake pins the
 expected identity, so a misrouted pipe just fails to authenticate.
 """
@@ -36,6 +44,8 @@ import json
 import logging
 import secrets
 import struct
+import time
+from dataclasses import dataclass
 from typing import Any, Awaitable, Callable
 
 from .identity import Identity, RemoteIdentity
@@ -66,17 +76,54 @@ def write_frame(writer: asyncio.StreamWriter, msg: dict[str, Any]) -> None:
     writer.write(struct.pack(">I", len(data)) + data)
 
 
-async def _splice(a_r, a_w, b_r, b_w) -> None:
-    """Copy bytes both ways until either side closes."""
+@dataclass
+class RelayLimits:
+    """Resource caps for a deployed relay (circuit-v2's role). `None`
+    rate = unlimited; pipes caps always apply."""
+    max_pipes_per_target: int = 8
+    max_pipes_total: int = 256
+    pipe_rate_bytes_per_s: int | None = None
+
+
+@dataclass
+class RelayStats:
+    pipes_opened: int = 0
+    pipes_active: int = 0
+    pipes_refused_target_cap: int = 0
+    pipes_refused_total_cap: int = 0
+    bytes_relayed: int = 0
+    listener_evictions: int = 0
+
+    def snapshot(self) -> dict[str, int]:
+        return {k: int(v) for k, v in self.__dict__.items()}
+
+
+async def _splice(a_r, a_w, b_r, b_w, stats: RelayStats | None = None,
+                  rate: int | None = None) -> None:
+    """Copy bytes both ways until either side closes. `rate` caps each
+    DIRECTION with a token bucket (burst = 1 s of budget) so one
+    saturating pipe cannot monopolize the relay's uplink; accounting
+    lands in `stats`."""
 
     async def pump(r, w):
+        allowance = float(rate) if rate else 0.0
+        last = time.monotonic()
         try:
             while True:
                 chunk = await r.read(PIPE_CHUNK)
                 if not chunk:
                     break
+                if rate:
+                    now = time.monotonic()
+                    allowance = min(float(rate), allowance + (now - last) * rate)
+                    last = now
+                    allowance -= len(chunk)
+                    if allowance < 0:
+                        await asyncio.sleep(-allowance / rate)
                 w.write(chunk)
                 await w.drain()
+                if stats is not None:
+                    stats.bytes_relayed += len(chunk)
         except (ConnectionError, asyncio.CancelledError, OSError):
             pass
         finally:
@@ -91,7 +138,16 @@ async def _splice(a_r, a_w, b_r, b_w) -> None:
 class RelayServer:
     """The rendezvous half that rides on the cloud relay process."""
 
-    def __init__(self) -> None:
+    def __init__(self, limits: RelayLimits | None = None) -> None:
+        self.limits = limits or RelayLimits()
+        self.stats = RelayStats()
+        # caps are enforced on RESERVATIONS (made at dial time, before
+        # any listener work is queued), not on active splices — else a
+        # burst of concurrent dials all passes the check before the
+        # first accept lands and the caps do nothing (TOCTOU)
+        self._reserved_total = 0
+        self._reserved_by_target: dict[str, int] = {}
+        self._pipes: set[asyncio.StreamWriter] = set()  # active splice ends
         self._listeners: dict[str, asyncio.StreamWriter] = {}
         self._meta: dict[str, dict[str, Any]] = {}
         # conn ids are unguessable tokens: the accept claim arrives on a
@@ -116,11 +172,16 @@ class RelayServer:
         for w in list(self._listeners.values()):
             w.close()
         self._listeners.clear()
-        for _r, w, fut in self._pending.values():
+        for _r, w, fut, _t in self._pending.values():
             if not fut.done():
                 fut.cancel()
             w.close()
         self._pending.clear()
+        # force-close active splices: their handlers must return or
+        # (3.12+) Server.wait_closed() below blocks forever
+        for w in list(self._pipes):
+            w.close()
+        self._pipes.clear()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -141,6 +202,11 @@ class RelayServer:
                 await self._serve_dial(reader, writer, msg)
             elif cmd == "accept":
                 await self._serve_accept(reader, writer, msg)
+            elif cmd == "stats":
+                write_frame(writer, {"ok": True, "stats": self.stats.snapshot(),
+                                     "listeners": len(self._listeners)})
+                await writer.drain()
+                writer.close()
             else:
                 write_frame(writer, {"ok": False, "error": "unknown cmd"})
                 writer.close()
@@ -180,8 +246,12 @@ class RelayServer:
             while True:
                 # a control connection silent past the contract window
                 # is half-open — evict the ghost listener
-                req = await asyncio.wait_for(read_frame(reader),
-                                             CONTROL_IDLE_TIMEOUT)
+                try:
+                    req = await asyncio.wait_for(read_frame(reader),
+                                                 CONTROL_IDLE_TIMEOUT)
+                except asyncio.TimeoutError:
+                    self.stats.listener_evictions += 1
+                    raise
                 c = req.get("cmd")
                 if c == "query":
                     write_frame(writer, {"event": "peers", "peers": [
@@ -208,22 +278,56 @@ class RelayServer:
             await writer.drain()
             writer.close()
             return
+        # resource caps BEFORE work is queued: reservations are taken
+        # HERE (synchronously, no await between check and reserve) so a
+        # burst of concurrent dials can't all pass the check before the
+        # first accept lands
+        if self._reserved_total >= self.limits.max_pipes_total:
+            self.stats.pipes_refused_total_cap += 1
+            write_frame(writer, {"ok": False, "error": "relay at capacity"})
+            await writer.drain()
+            writer.close()
+            return
+        if (self._reserved_by_target.get(target, 0)
+                >= self.limits.max_pipes_per_target):
+            self.stats.pipes_refused_target_cap += 1
+            write_frame(writer, {"ok": False, "error": "target pipe cap"})
+            await writer.drain()
+            writer.close()
+            return
+        self._reserve(target)
         conn_id = secrets.token_hex(16)
         accepted: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[conn_id] = (reader, writer, accepted)
+        self._pending[conn_id] = (reader, writer, accepted, target)
         try:
             write_frame(host_w, {"event": "incoming", "conn": conn_id})
             await host_w.drain()
             await asyncio.wait_for(accepted, DIAL_TIMEOUT)
         except Exception:
             self._pending.pop(conn_id, None)
+            self._release(target)
             write_frame(writer, {"ok": False, "error": "accept timeout"})
             try:
                 await writer.drain()
             except Exception:
                 pass
             writer.close()
-        # on success the accept side owns the splice; nothing more here
+        # on success the accept side owns the splice (and releases the
+        # reservation when it ends); nothing more here
+
+    def _reserve(self, target: str) -> None:
+        self._reserved_total += 1
+        self._reserved_by_target[target] = (
+            self._reserved_by_target.get(target, 0) + 1
+        )
+
+    def _release(self, target: str) -> None:
+        self._reserved_total = max(0, self._reserved_total - 1)
+        left = self._reserved_by_target.get(target, 1) - 1
+        if left <= 0:
+            self._reserved_by_target.pop(target, None)
+        else:
+            self._reserved_by_target[target] = left
 
     async def _serve_accept(self, reader, writer, msg) -> None:
         entry = self._pending.pop(str(msg.get("conn", "")), None)
@@ -232,21 +336,32 @@ class RelayServer:
             await writer.drain()
             writer.close()
             return
-        dial_r, dial_w, accepted = entry
+        dial_r, dial_w, accepted, target = entry
         # resolve the future FIRST: the dial side's wait_for may cancel
         # it during any await below, and set_result on a cancelled
         # future raises InvalidStateError
         if accepted.cancelled():
+            # the dial path released the reservation when it timed out
             write_frame(writer, {"ok": False, "error": "dial gone"})
             await writer.drain()
             writer.close()
             return
         accepted.set_result(None)
-        write_frame(writer, {"ok": True})
-        write_frame(dial_w, {"ok": True})
-        await writer.drain()
-        await dial_w.drain()
-        await _splice(dial_r, dial_w, reader, writer)
+        # from here the reservation is THIS handler's to release
+        self.stats.pipes_opened += 1
+        self.stats.pipes_active += 1
+        self._pipes.update((dial_w, writer))
+        try:
+            write_frame(writer, {"ok": True})
+            write_frame(dial_w, {"ok": True})
+            await writer.drain()
+            await dial_w.drain()
+            await _splice(dial_r, dial_w, reader, writer, stats=self.stats,
+                          rate=self.limits.pipe_rate_bytes_per_s)
+        finally:
+            self.stats.pipes_active -= 1
+            self._release(target)
+            self._pipes.difference_update((dial_w, writer))
 
 
 class RelayClient:

@@ -111,6 +111,10 @@ def test_relay_command_serves_rendezvous(tmp_path):
             host = "127.0.0.1"
             port = free_port()
             p2p_port = free_port()
+            max_pipes_per_target = 8
+            max_pipes = 256
+            pipe_rate = None
+            stats_interval = 0.0
 
         task = asyncio.ensure_future(cmd_relay(Args()))
         try:

@@ -935,3 +935,129 @@ def test_relay_listen_requires_identity_proof(tmp_path):
             await relay.shutdown()
 
     asyncio.run(run())
+
+
+def test_relay_resource_accounting():
+    """VERDICT r3 weak #6: a deployed relay enforces per-target pipe
+    caps and per-pipe rate caps, so one greedy peer can neither hoard
+    pipes nor starve another pipe of bandwidth; counters ride the
+    `stats` command (circuit-v2 resource-limit parity)."""
+
+    async def run():
+        from spacedrive_tpu.p2p.relay import (
+            _LISTEN_CONTEXT,
+            RelayLimits,
+            RelayServer,
+            read_frame,
+            write_frame,
+        )
+
+        RATE = 256 * 1024  # bytes/s per pipe direction
+        srv = RelayServer(limits=RelayLimits(
+            max_pipes_per_target=2, max_pipes_total=64,
+            pipe_rate_bytes_per_s=RATE,
+        ))
+        port = await srv.start()
+        ident = Identity()
+        b58 = str(ident.to_remote_identity())
+        sunk = {"bytes": 0}
+        tasks = []
+
+        async def handle(conn):
+            ar, aw = await asyncio.open_connection("127.0.0.1", port)
+            write_frame(aw, {"cmd": "accept", "conn": conn})
+            await aw.drain()
+            if not (await read_frame(ar)).get("ok"):
+                return
+            mode = await ar.readexactly(1)
+            while True:
+                chunk = await ar.read(65536)
+                if not chunk:
+                    break
+                if mode == b"S":  # sink-and-count
+                    sunk["bytes"] += len(chunk)
+                else:  # echo
+                    aw.write(chunk)
+                    await aw.drain()
+
+        registered = asyncio.Event()
+
+        async def listener():
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            write_frame(w, {"cmd": "listen", "identity": b58, "meta": {}})
+            await w.drain()
+            ch = await read_frame(r)
+            write_frame(w, {"sig": ident.sign(
+                _LISTEN_CONTEXT + bytes.fromhex(ch["challenge"])).hex()})
+            await w.drain()
+            assert (await read_frame(r)).get("ok")
+            registered.set()
+            while True:
+                msg = await read_frame(r)
+                if msg.get("event") == "incoming":
+                    tasks.append(asyncio.create_task(handle(msg["conn"])))
+
+        async def dial():
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            write_frame(w, {"cmd": "dial", "target": b58})
+            await w.drain()
+            return await read_frame(r), r, w
+
+        lt = asyncio.create_task(listener())
+        try:
+            await asyncio.wait_for(registered.wait(), 5)
+            # pipe 1: greedy — blasts 4 MiB as fast as the relay lets it
+            resp, gr, gw = await dial()
+            assert resp.get("ok"), resp
+            gw.write(b"S" + b"\x00" * (4 << 20))
+            greedy = asyncio.create_task(gw.drain())
+            tasks.append(greedy)
+            await asyncio.sleep(0.1)
+
+            # pipe 2: stays responsive WHILE the greedy pipe streams
+            resp, er, ew = await dial()
+            assert resp.get("ok"), resp
+            ew.write(b"E")
+            for _ in range(3):
+                t0 = asyncio.get_running_loop().time()
+                ew.write(b"ping-payload")
+                await ew.drain()
+                got = await asyncio.wait_for(er.readexactly(12), 2.0)
+                assert got == b"ping-payload"
+                assert asyncio.get_running_loop().time() - t0 < 1.5
+            assert not greedy.done() or sunk["bytes"] < (4 << 20)
+
+            # rate cap actually throttles: after ~1.2 s the greedy pipe
+            # has moved at most burst (1 s) + elapsed×RATE + one chunk
+            await asyncio.sleep(1.0)
+            assert sunk["bytes"] <= int(2.5 * RATE) + 65536, sunk["bytes"]
+
+            # per-target pipe cap: the third concurrent pipe is refused
+            resp3, _r3, w3 = await dial()
+            assert resp3 == {"ok": False, "error": "target pipe cap"}
+            w3.close()
+
+            # and a concurrent BURST can't sneak past the cap either
+            # (reservation happens at dial time, not accept time)
+            burst = await asyncio.gather(*(dial() for _ in range(4)))
+            for respN, _rN, wN in burst:
+                assert respN == {"ok": False, "error": "target pipe cap"}
+                wN.close()
+
+            # stats reflect it all
+            sr, sw = await asyncio.open_connection("127.0.0.1", port)
+            write_frame(sw, {"cmd": "stats"})
+            await sw.drain()
+            stats = (await read_frame(sr))["stats"]
+            sw.close()
+            assert stats["pipes_opened"] == 2
+            assert stats["pipes_active"] == 2
+            assert stats["pipes_refused_target_cap"] == 5  # 1 + burst of 4
+            assert stats["bytes_relayed"] > 0
+        finally:
+            lt.cancel()
+            for t in tasks:
+                t.cancel()
+            await srv.shutdown()
+
+    asyncio.run(run())
