@@ -10,6 +10,8 @@ import { openDropPanel, rejectPendingOffer, showDropOffer, wireDropPanel } from 
 import { addLocationModal, wireSettingsPanel } from "/static/js/settings.js";
 import { showMenu, wireContextMenu } from "/static/js/contextmenu.js";
 import { showOnboarding } from "/static/js/onboarding.js";
+import { openPreview, previewOpen, wireQuickPreview } from "/static/js/quickpreview.js";
+import { droppable } from "/static/js/dnd.js";
 
 const sock = new SdSocket();
 let unsubJobs = null;
@@ -76,6 +78,8 @@ async function refreshNav() {
                             mode:"browse"});
       clearSelection();
       loadContent(true); };
+    // sidebar locations are move targets (drop = move to its root)
+    droppable(item, () => ({location_id: n.id, path: "/"}));
     locDiv.appendChild(item);
   }
   state.allTags = tags.nodes;
@@ -161,6 +165,7 @@ wireJobsPanel();
 wireDropPanel();
 wireSettingsPanel();
 wireContextMenu();
+wireQuickPreview();
 
 // ---------- keyboard navigation ----------
 const VIEWS = ["grid", "list", "media"];
@@ -181,6 +186,14 @@ document.addEventListener("keydown", (e) => {
     case "k": moveSelection(-1, 0); break;
     case "Enter":
       if (state.selected?.is_dir) openDir(state.selected);
+      break;
+    case " ":
+      // space = QuickPreview of the selection (the preview's own
+      // capture handler owns the key while open)
+      if (state.selected && !previewOpen()) {
+        e.preventDefault();
+        openPreview(state.selected);
+      }
       break;
     case "Backspace": upDir(); break;
     case "v":

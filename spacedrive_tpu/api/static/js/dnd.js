@@ -1,0 +1,73 @@
+// Drag-and-drop file moves: drag the selection onto a folder card/row,
+// a breadcrumb segment, or a sidebar location → files.cutFiles (role
+// parity: ref:interface/app/$libraryId/Explorer/useExplorerDnd.tsx,
+// DragOverlay.tsx, ExplorerDroppable.tsx over core/src/object/fs/cut).
+
+import client from "/rspc/client.js";
+import { $, bus, state } from "/static/js/util.js";
+
+let drag = null; // {ids, location_id} — the in-flight drag payload
+
+/** make an item row/card draggable; dragging a selected item drags the
+ *  whole (same-location) selection, like the reference's drag overlay */
+export function draggable(elem, n) {
+  elem.draggable = true;
+  elem.addEventListener("dragstart", (e) => {
+    const multi = state.selectedIds.has(n.id) && state.selectedIds.size > 1;
+    const ids = multi
+      ? state.nodes
+          .filter((x) => state.selectedIds.has(x.id) &&
+                         x.location_id === n.location_id)
+          .map((x) => x.id)
+      : [n.id];
+    drag = { ids, location_id: n.location_id };
+    e.dataTransfer.effectAllowed = "move";
+    e.dataTransfer.setData("text/plain", String(n.id)); // firefox requires data
+  });
+  elem.addEventListener("dragend", () => { drag = null; });
+}
+
+/** register a drop target; `targetFn` returns {location_id, path} or
+ *  null when the current drag must not land here (e.g. a folder onto
+ *  itself) */
+export function droppable(elem, targetFn) {
+  elem.addEventListener("dragover", (e) => {
+    if (!drag || !targetFn()) return;
+    e.preventDefault();
+    e.dataTransfer.dropEffect = "move";
+    elem.classList.add("drop-ok");
+  });
+  elem.addEventListener("dragleave", () => elem.classList.remove("drop-ok"));
+  elem.addEventListener("drop", async (e) => {
+    e.preventDefault();
+    elem.classList.remove("drop-ok");
+    const target = drag && targetFn();
+    if (!target) return;
+    const src = drag;
+    drag = null;
+    try {
+      await client.files.cutFiles({
+        source_location_id: src.location_id,
+        target_location_id: target.location_id,
+        sources_file_path_ids: src.ids,
+        target_relative_path: target.path,
+      }, state.lib);
+      $("events").textContent = `moved ${src.ids.length} item(s)`;
+      bus.loadContent(true);
+    } catch (err) {
+      $("events").textContent = "✗ move: " + err.message;
+    }
+  });
+}
+
+/** drop target for a directory NODE in the listing */
+export function dirTarget(n) {
+  return () => {
+    // a folder can't be dropped into itself or its own selection
+    if (!drag || drag.ids.includes(n.id)) return null;
+    return {
+      location_id: n.location_id,
+      path: (n.materialized_path || "/") + n.name + "/",
+    };
+  };
+}

@@ -4,6 +4,7 @@
 
 import client from "/rspc/client.js";
 import { $, KIND_ICON, bus, el, fmtBytes, state, thumbUrl } from "/static/js/util.js";
+import { dirTarget, draggable, droppable } from "/static/js/dnd.js";
 
 export function setView(view) {
   state.view = view;
@@ -49,6 +50,7 @@ export function renderCrumbs() {
     const s = el("span", "seg", label);
     s.onclick = onclick;
     c.appendChild(s);
+    return s;
   };
   if (state.mode === "search") {
     c.appendChild(el("span", "", `search: “${state.search}”`));
@@ -71,16 +73,21 @@ export function renderCrumbs() {
     c.appendChild(el("span", "", "select a location"));
     return;
   }
-  seg("📂 " + (state.locNames[state.loc] || "location"), () => {
-    state.path = "/"; clearSelection(); loadContent(true);
-  });
+  const crumbDrop = (s, path) =>
+    droppable(s, () => ({ location_id: state.loc, path }));
+  crumbDrop(
+    seg("📂 " + (state.locNames[state.loc] || "location"), () => {
+      state.path = "/"; clearSelection(); loadContent(true);
+    }), "/");
   const parts = state.path.split("/").filter(Boolean);
   let acc = "/";
   for (const p of parts) {
     c.appendChild(el("span", "sep", "›"));
     acc += p + "/";
     const target = acc;
-    seg(p, () => { state.path = target; clearSelection(); loadContent(true); });
+    crumbDrop(
+      seg(p, () => { state.path = target; clearSelection(); loadContent(true); }),
+      target);
   }
 }
 
@@ -172,6 +179,8 @@ function renderCards(c, mediaOnly, nodes) {
     card.oncontextmenu = (e) => { e.preventDefault();
       if (!state.selectedIds.has(n.id)) bus.select(n);
       bus.showMenu(e.clientX, e.clientY, n); };
+    draggable(card, n);
+    if (n.is_dir) droppable(card, dirTarget(n));
     c.appendChild(card);
   }
 }
@@ -194,6 +203,8 @@ function renderListRows(table, nodes) {
     tr.oncontextmenu = (e) => { e.preventDefault();
       if (!state.selectedIds.has(n.id)) bus.select(n);
       bus.showMenu(e.clientX, e.clientY, n); };
+    draggable(tr, n);
+    if (n.is_dir) droppable(tr, dirTarget(n));
     table.appendChild(tr);
   }
 }

@@ -256,3 +256,104 @@ def test_explorer_six_flows(tmp_path, corpus=None):
             await node.shutdown()
 
     asyncio.run(run())
+
+
+def test_explorer_quickpreview_and_dnd(tmp_path):
+    """Round-4 brief #3: QuickPreview (space-bar full-size preview over
+    the range-served original) and drag-and-drop moves (drag selection
+    onto a folder/breadcrumb → files.cutFiles), pinned at the same two
+    halves as the six flows: served modules + the exact frames the JS
+    sends (ref:interface Explorer/QuickPreview/index.tsx,
+    useExplorerDnd.tsx)."""
+
+    async def run():
+        import aiohttp
+
+        node, base = await _fresh_server(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as http:
+                # --- module half -----------------------------------
+                async with http.get(f"{base}/static/js/app.js") as resp:
+                    app_js = await resp.text()
+                assert "/static/js/quickpreview.js" in app_js
+                assert "/static/js/dnd.js" in app_js
+                for mod in ("quickpreview.js", "dnd.js"):
+                    async with http.get(f"{base}/static/js/{mod}") as resp:
+                        assert resp.status == 200, mod
+                        js = await resp.text()
+                async with http.get(f"{base}/static/js/views.js") as resp:
+                    views_js = await resp.text()
+                # the listing actually registers drag sources + targets
+                assert "draggable(" in views_js and "droppable(" in views_js
+
+                # --- library with a text file + image + two dirs ----
+                created = await _rspc(http, base, "library.create",
+                                      {"name": "Preview"})
+                lib_id = created["uuid"]
+                root = tmp_path / "files"
+                (root / "sub").mkdir(parents=True)
+                body = "preview me " * 2000  # > 16 KiB of text
+                (root / "notes.txt").write_text(body)
+                from PIL import Image
+                Image.new("RGB", (40, 30), (200, 40, 40)).save(root / "pic.png")
+                loc = await _rspc(http, base, "locations.create",
+                                  {"path": str(root)}, lib_id)
+                loc_id = loc["id"] if isinstance(loc, dict) else loc
+                for _ in range(100):
+                    reports = await _rspc(http, base, "jobs.reports", None, lib_id)
+                    if reports and all(
+                        r["status"].startswith("COMPLETED") for r in reports
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+
+                # --- preview half: the exact requests quickpreview.js
+                # makes (text head via Range; image full via the same
+                # custom-uri route) ----------------------------------
+                url = f"{base}/spacedrive/file/{lib_id}/{loc_id}/notes.txt"
+                async with http.get(
+                    url, headers={"Range": "bytes=0-65535"}
+                ) as resp:
+                    assert resp.status == 206, resp.status
+                    head = await resp.text()
+                    assert head == body[:65536]
+                async with http.get(
+                    f"{base}/spacedrive/file/{lib_id}/{loc_id}/pic.png"
+                ) as resp:
+                    assert resp.status == 200
+                    assert resp.headers["Content-Type"] == "image/png"
+                    assert (await resp.read())[:8] == b"\x89PNG\r\n\x1a\n"
+
+                # --- dnd half: the exact mutation dnd.js sends ------
+                top = await _rspc(http, base, "search.paths",
+                                  {"filter": {"path": "/"}, "take": 50}, lib_id)
+                by_name = {n["name"]: n for n in top["nodes"]}
+                note = by_name["notes"]
+                await _rspc(http, base, "files.cutFiles", {
+                    "source_location_id": loc_id,
+                    "target_location_id": loc_id,
+                    "sources_file_path_ids": [note["id"]],
+                    "target_relative_path": "/sub/",
+                }, lib_id)
+                for _ in range(100):
+                    inside = await _rspc(
+                        http, base, "search.paths",
+                        {"filter": {"path": "/sub/"}, "take": 50}, lib_id)
+                    if {n["name"] for n in inside["nodes"]} == {"notes"}:
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    pytest.fail("dnd move never landed in /sub/")
+                assert (root / "sub" / "notes.txt").read_text() == body
+                assert not (root / "notes.txt").exists()
+                # the moved file still previews from its new path
+                async with http.get(
+                    f"{base}/spacedrive/file/{lib_id}/{loc_id}/sub/notes.txt",
+                    headers={"Range": "bytes=0-15"},
+                ) as resp:
+                    assert resp.status == 206
+                    assert await resp.text() == body[:16]
+        finally:
+            await node.shutdown()
+
+    asyncio.run(run())
