@@ -11,7 +11,7 @@ import { addLocationModal, wireSettingsPanel } from "/static/js/settings.js";
 import { showMenu, wireContextMenu } from "/static/js/contextmenu.js";
 import { showOnboarding } from "/static/js/onboarding.js";
 import { openPreview, previewOpen, wireQuickPreview } from "/static/js/quickpreview.js";
-import { droppable } from "/static/js/dnd.js";
+import { droppable, guardTarget } from "/static/js/dnd.js";
 
 const sock = new SdSocket();
 let unsubJobs = null;
@@ -79,7 +79,7 @@ async function refreshNav() {
       clearSelection();
       loadContent(true); };
     // sidebar locations are move targets (drop = move to its root)
-    droppable(item, () => ({location_id: n.id, path: "/"}));
+    droppable(item, () => guardTarget(n.id, "/"));
     locDiv.appendChild(item);
   }
   state.allTags = tags.nodes;

@@ -6,7 +6,9 @@
 import client from "/rspc/client.js";
 import { $, bus, state } from "/static/js/util.js";
 
-let drag = null; // {ids, location_id} — the in-flight drag payload
+let drag = null; // {ids, dirPaths, location_id} — the in-flight drag payload
+
+const dirPath = (n) => (n.materialized_path || "/") + n.name + "/";
 
 /** make an item row/card draggable; dragging a selected item drags the
  *  whole (same-location) selection, like the reference's drag overlay */
@@ -14,13 +16,16 @@ export function draggable(elem, n) {
   elem.draggable = true;
   elem.addEventListener("dragstart", (e) => {
     const multi = state.selectedIds.has(n.id) && state.selectedIds.size > 1;
-    const ids = multi
-      ? state.nodes
-          .filter((x) => state.selectedIds.has(x.id) &&
-                         x.location_id === n.location_id)
-          .map((x) => x.id)
-      : [n.id];
-    drag = { ids, location_id: n.location_id };
+    const chosen = multi
+      ? state.nodes.filter((x) => state.selectedIds.has(x.id) &&
+                                  x.location_id === n.location_id)
+      : [n];
+    drag = {
+      ids: chosen.map((x) => x.id),
+      // dragged DIR paths: a dir must never land in its own subtree
+      dirPaths: chosen.filter((x) => x.is_dir).map(dirPath),
+      location_id: n.location_id,
+    };
     e.dataTransfer.effectAllowed = "move";
     e.dataTransfer.setData("text/plain", String(n.id)); // firefox requires data
   });
@@ -60,14 +65,20 @@ export function droppable(elem, targetFn) {
   });
 }
 
+/** {location_id, path} if the current drag may land there, else null —
+ *  a folder can't be dropped into itself or any of its descendants
+ *  (recursive search listings render both in one view) */
+export function guardTarget(location_id, path) {
+  if (!drag) return null;
+  if (drag.location_id === location_id &&
+      drag.dirPaths.some((p) => path.startsWith(p))) return null;
+  return { location_id, path };
+}
+
 /** drop target for a directory NODE in the listing */
 export function dirTarget(n) {
   return () => {
-    // a folder can't be dropped into itself or its own selection
     if (!drag || drag.ids.includes(n.id)) return null;
-    return {
-      location_id: n.location_id,
-      path: (n.materialized_path || "/") + n.name + "/",
-    };
+    return guardTarget(n.location_id, dirPath(n));
   };
 }

@@ -3,14 +3,12 @@
 // ref:interface/app/$libraryId/Explorer/QuickPreview/index.tsx over
 // the range-served original, ref:core/src/custom_uri).
 
-import { $, KIND_ICON, bus, el, fmtBytes, state } from "/static/js/util.js";
+import { $, KIND_ICON, bus, el, fmtBytes, relPath, state } from "/static/js/util.js";
 
 export const fileUrl = (n) => {
   // per-segment encoding: "#"/"?" in filenames must not become
   // fragment/query separators (encodeURI leaves them bare)
-  const rel = (n.materialized_path || "/") + n.name +
-              (n.extension ? "." + n.extension : "");
-  const path = rel.split("/").map(encodeURIComponent).join("/");
+  const path = relPath(n).split("/").map(encodeURIComponent).join("/");
   return `/spacedrive/file/${state.lib}/${n.location_id}${path}`;
 };
 
@@ -58,6 +56,7 @@ async function render() {
     (n.size_in_bytes ? ` · ${fmtBytes(n.size_in_bytes)}` : "");
   const url = fileUrl(n);
   const kind = n.object_kind;
+  const ext = (n.extension || "").toLowerCase();  // stored verbatim
   if (kind === 5) {
     const img = el("img");
     img.src = url;
@@ -73,17 +72,18 @@ async function render() {
     a.controls = true;
     a.src = url;
     body.appendChild(a);
-  } else if (n.extension === "pdf") {
+  } else if (ext === "pdf") {
     // the browser's own viewer over the range-served original
     const f = el("iframe");
     f.src = url;
     body.appendChild(f);
-  } else if ([3, 9].includes(kind) || TEXT_EXTS.has(n.extension)) {
+  } else if ([3, 9].includes(kind) || TEXT_EXTS.has(ext)) {
     const pre = el("pre", "", "loading…");
     body.appendChild(pre);
     try {
       // head only — a 2 GB log must not be pulled into the page
       const resp = await fetch(url, { headers: { Range: "bytes=0-65535" } });
+      if (!resp.ok) throw new Error(`HTTP ${resp.status}`);
       const text = await resp.text();
       if (current === n)
         pre.textContent =

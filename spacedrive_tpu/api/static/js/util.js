@@ -35,11 +35,12 @@ export function fmtBytes(n) {
 export const thumbUrl = (n) =>
   `/spacedrive/thumbnail/${state.lib}/${n.cas_id.slice(0,3)}/${n.cas_id}.webp`;
 
-export const fullPath = (n) => {
-  const base = state.locPaths[n.location_id] || "";
-  return base + (n.materialized_path || "/") + n.name +
-         (n.extension ? "." + n.extension : "");
-};
+/** location-relative path of a row ("/dir/name.ext") */
+export const relPath = (n) =>
+  (n.materialized_path || "/") + n.name +
+  (n.extension ? "." + n.extension : "");
+
+export const fullPath = (n) => (state.locPaths[n.location_id] || "") + relPath(n);
 
 /** Simple modal helper: body builder receives the modal element and a
  *  close function; returns close. */

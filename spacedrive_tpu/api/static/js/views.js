@@ -4,7 +4,7 @@
 
 import client from "/rspc/client.js";
 import { $, KIND_ICON, bus, el, fmtBytes, state, thumbUrl } from "/static/js/util.js";
-import { dirTarget, draggable, droppable } from "/static/js/dnd.js";
+import { dirTarget, draggable, droppable, guardTarget } from "/static/js/dnd.js";
 
 export function setView(view) {
   state.view = view;
@@ -74,7 +74,7 @@ export function renderCrumbs() {
     return;
   }
   const crumbDrop = (s, path) =>
-    droppable(s, () => ({ location_id: state.loc, path }));
+    droppable(s, () => guardTarget(state.loc, path));
   crumbDrop(
     seg("📂 " + (state.locNames[state.loc] || "location"), () => {
       state.path = "/"; clearSelection(); loadContent(true);
