@@ -1,20 +1,22 @@
-"""Encrypted authenticated stream transport.
+"""Encrypted authenticated stream transport (Noise XX).
 
 Parity: ref:crates/p2p2/src/quic/transport.rs + stream.rs — the
-reference runs QUIC (TLS with identity-derived certs) on a patched
-libp2p, protocol `/sdp2p/1`, and hands out `UnicastStream`s. Here each
-unicast stream is one asyncio TCP connection secured by a Noise-style
-handshake:
+reference runs a patched libp2p whose secure channel is libp2p-noise
+(`Noise_XX_25519_ChaChaPoly_SHA256` + a signed identity payload) under
+protocol `/sdp2p/1`, and hands out `UnicastStream`s.  Here each unicast
+stream is one asyncio TCP connection secured by the same construction:
 
-  client → server: eph X25519 pub ‖ ed25519 identity pub
-  server → client: eph X25519 pub ‖ identity pub ‖ sig(transcript)
-  client → server: sig(transcript)
+  → clear:  protocol magic `/sdp2p/1` (also the Noise prologue)
+  → msg1:   XX `e`
+  ← msg2:   XX `e, ee, s, es`   payload: ident_pub ‖ sig(ctx ‖ s_pub)
+  → msg3:   XX `s, se`          payload: ident_pub ‖ sig(ctx ‖ s_pub)
 
-Both sides HKDF the X25519 shared secret into two ChaCha20-Poly1305
-directional keys; records are 4-byte-BE-length framed ciphertexts with
-64-bit counter nonces. Mutual identity authentication matches the
-reference's trust model (raw keypairs, no CA); the ephemeral DH gives
-forward secrecy like QUIC's TLS handshake.
+The Noise state machine lives in `noise.py` (written against the public
+spec, rev 34); each side's ed25519 identity is bound to its session
+X25519 static by the libp2p-noise signed payload.  Transport-phase
+records are Noise transport messages (≤64 KiB) framed with a 2-byte BE
+length, keys from Split(), counter nonces per spec §5.1.  Security
+argument and threat model: docs/security.md.
 """
 
 from __future__ import annotations
@@ -23,48 +25,46 @@ import asyncio
 import struct
 from typing import Awaitable, Callable
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
 
+from . import noise
 from .identity import Identity, RemoteIdentity
+from .noise import CipherState, HandshakeState, NoiseError
 
 PROTOCOL = b"/sdp2p/1"  # ref:quic/transport.rs:33
-MAX_RECORD = 1 << 20  # plaintext bytes per encrypted record
+MAX_RECORD = noise.MAX_PLAINTEXT  # plaintext bytes per encrypted record
 
 
 class HandshakeError(Exception):
     pass
 
 
-def _derive_keys(shared: bytes, transcript: bytes) -> tuple[bytes, bytes]:
-    okm = HKDF(
-        algorithm=hashes.SHA256(), length=64, salt=transcript, info=PROTOCOL
-    ).derive(shared)
-    return okm[:32], okm[32:]
+async def _send_msg(writer: asyncio.StreamWriter, msg: bytes) -> None:
+    writer.write(struct.pack(">H", len(msg)) + msg)
+    await writer.drain()
+
+
+async def _recv_msg(reader: asyncio.StreamReader) -> bytes:
+    (length,) = struct.unpack(">H", await reader.readexactly(2))
+    return await reader.readexactly(length)
 
 
 class EncryptedStream:
-    """One bidirectional encrypted stream (ref:stream.rs `UnicastStream`)."""
+    """One bidirectional encrypted stream (ref:stream.rs `UnicastStream`)
+    in the Noise transport phase."""
 
     def __init__(
         self,
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
-        send_key: bytes,
-        recv_key: bytes,
+        send: CipherState,
+        recv: CipherState,
         remote_identity: RemoteIdentity,
     ):
         self._reader = reader
         self._writer = writer
-        self._send = ChaCha20Poly1305(send_key)
-        self._recv = ChaCha20Poly1305(recv_key)
-        self._send_ctr = 0
-        self._recv_ctr = 0
+        self._send = send
+        self._recv = recv
         self._recv_buf = bytearray()
         self.remote_identity = remote_identity
         self._closed = False
@@ -75,22 +75,17 @@ class EncryptedStream:
         view = memoryview(data)
         for off in range(0, max(len(view), 1), MAX_RECORD):
             chunk = bytes(view[off : off + MAX_RECORD])
-            nonce = struct.pack(">IQ", 0, self._send_ctr)
-            self._send_ctr += 1
-            ct = self._send.encrypt(nonce, chunk, None)
-            self._writer.write(struct.pack(">I", len(ct)) + ct)
+            ct = self._send.encrypt_with_ad(b"", chunk)
+            self._writer.write(struct.pack(">H", len(ct)) + ct)
         await self._writer.drain()
 
     async def read_exact(self, n: int) -> bytes:
         while len(self._recv_buf) < n:
-            hdr = await self._reader.readexactly(4)
-            (length,) = struct.unpack(">I", hdr)
-            if length > MAX_RECORD + 16:
-                raise ValueError("oversized record")
-            ct = await self._reader.readexactly(length)
-            nonce = struct.pack(">IQ", 0, self._recv_ctr)
-            self._recv_ctr += 1
-            self._recv_buf += self._recv.decrypt(nonce, ct, None)
+            ct = await _recv_msg(self._reader)
+            try:
+                self._recv_buf += self._recv.decrypt_with_ad(b"", ct)
+            except NoiseError as exc:
+                raise ValueError("record decrypt failed") from exc
         out = bytes(self._recv_buf[:n])
         del self._recv_buf[:n]
         return out
@@ -113,35 +108,36 @@ class EncryptedStream:
             return None
 
 
+def _split_for_role(hs: HandshakeState) -> tuple[CipherState, CipherState]:
+    """(send, recv) cipher states for this side's role."""
+    c_i2r, c_r2i = hs.split()
+    return (c_i2r, c_r2i) if hs.initiator else (c_r2i, c_i2r)
+
+
 async def _client_handshake(
     reader: asyncio.StreamReader,
     writer: asyncio.StreamWriter,
     identity: Identity,
     expect: RemoteIdentity | None,
 ) -> EncryptedStream:
-    eph = X25519PrivateKey.generate()
-    eph_pub = eph.public_key().public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    )
-    my_ident = identity.to_remote_identity().to_bytes()
-    writer.write(PROTOCOL + eph_pub + my_ident)
-    await writer.drain()
+    static = X25519PrivateKey.generate()
+    hs = HandshakeState(initiator=True, s=static, prologue=PROTOCOL)
+    try:
+        writer.write(PROTOCOL)
+        await _send_msg(writer, hs.write_message(b""))
 
-    srv = await reader.readexactly(32 + 32 + 64)
-    srv_eph, srv_ident_raw, srv_sig = srv[:32], srv[32:64], srv[64:]
-    srv_ident = RemoteIdentity(srv_ident_raw)
-    transcript = PROTOCOL + eph_pub + my_ident + srv_eph + srv_ident_raw
-    if not srv_ident.verify(srv_sig, transcript + b"server"):
-        raise HandshakeError("server signature invalid")
-    if expect is not None and srv_ident != expect:
-        raise HandshakeError(f"unexpected peer identity {srv_ident}")
+        payload = hs.read_message(await _recv_msg(reader))
+        srv_ident = noise.verify_identity_payload(payload, hs.rs)
+        if expect is not None and srv_ident != expect:
+            raise HandshakeError(f"unexpected peer identity {srv_ident}")
 
-    writer.write(identity.sign(transcript + b"client"))
-    await writer.drain()
+        my_payload = noise.identity_payload(identity, hs.local_static_pub)
+        await _send_msg(writer, hs.write_message(my_payload))
+    except NoiseError as exc:
+        raise HandshakeError(str(exc)) from exc
 
-    shared = eph.exchange(X25519PublicKey.from_public_bytes(srv_eph))
-    c2s, s2c = _derive_keys(shared, transcript)
-    return EncryptedStream(reader, writer, c2s, s2c, srv_ident)
+    send, recv = _split_for_role(hs)
+    return EncryptedStream(reader, writer, send, recv, srv_ident)
 
 
 async def _server_handshake(
@@ -149,29 +145,24 @@ async def _server_handshake(
     writer: asyncio.StreamWriter,
     identity: Identity,
 ) -> EncryptedStream:
-    hello = await reader.readexactly(len(PROTOCOL) + 32 + 32)
-    if hello[: len(PROTOCOL)] != PROTOCOL:
+    magic = await reader.readexactly(len(PROTOCOL))
+    if magic != PROTOCOL:
         raise HandshakeError("bad protocol magic")
-    cli_eph = hello[len(PROTOCOL) : len(PROTOCOL) + 32]
-    cli_ident_raw = hello[len(PROTOCOL) + 32 :]
-    cli_ident = RemoteIdentity(cli_ident_raw)
+    static = X25519PrivateKey.generate()
+    hs = HandshakeState(initiator=False, s=static, prologue=PROTOCOL)
+    try:
+        hs.read_message(await _recv_msg(reader))
 
-    eph = X25519PrivateKey.generate()
-    eph_pub = eph.public_key().public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    )
-    my_ident = identity.to_remote_identity().to_bytes()
-    transcript = PROTOCOL + cli_eph + cli_ident_raw + eph_pub + my_ident
-    writer.write(eph_pub + my_ident + identity.sign(transcript + b"server"))
-    await writer.drain()
+        my_payload = noise.identity_payload(identity, hs.local_static_pub)
+        await _send_msg(writer, hs.write_message(my_payload))
 
-    cli_sig = await reader.readexactly(64)
-    if not cli_ident.verify(cli_sig, transcript + b"client"):
-        raise HandshakeError("client signature invalid")
+        payload = hs.read_message(await _recv_msg(reader))
+        cli_ident = noise.verify_identity_payload(payload, hs.rs)
+    except NoiseError as exc:
+        raise HandshakeError(str(exc)) from exc
 
-    shared = eph.exchange(X25519PublicKey.from_public_bytes(cli_eph))
-    c2s, s2c = _derive_keys(shared, transcript)
-    return EncryptedStream(reader, writer, s2c, c2s, cli_ident)
+    send, recv = _split_for_role(hs)
+    return EncryptedStream(reader, writer, send, recv, cli_ident)
 
 
 class Listener:
