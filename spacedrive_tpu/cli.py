@@ -372,7 +372,14 @@ def cmd_labeler(args: argparse.Namespace) -> int:
             if args.classes:
                 with open(args.classes) as f:
                     classes = [ln.strip() for ln in f if ln.strip()]
-            if args.src:
+            if args.bundled:
+                if args.src or args.url or args.sha256 or args.classes:
+                    raise ValueError(
+                        "--bundled installs the pinned in-package artifact; "
+                        "it cannot combine with --from/--url/--sha256/--classes"
+                    )
+                info = provision.install_bundled(labeler_dir)
+            elif args.src:
                 info = provision.import_artifact(
                     args.src, labeler_dir, classes=classes,
                     sha256=args.sha256,
@@ -527,6 +534,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--from", dest="src",
         help="local .onnx classifier or .npz checkpoint to import "
              "(default: download --url)",
+    )
+    lp.add_argument(
+        "--bundled", action="store_true",
+        help="install the in-package offline artifact (trained digits "
+             "classifier, sha256-pinned) — works air-gapped",
     )
     lp.add_argument(
         "--url", default=None,

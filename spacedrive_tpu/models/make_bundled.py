@@ -1,0 +1,72 @@
+"""Build the bundled offline labeler artifact.
+
+The reference cannot label anything until it downloads YOLOv8 from a
+CDN (ref:crates/ai/src/image_labeler/model/yolov8.rs:45-88); an
+air-gapped install therefore never labels. This framework ships a
+small trained checkpoint IN the package (`models/bundled/`) so
+`sdx labeler provision --bundled` works with zero egress.
+
+The artifact is a LabelerNet trained on sklearn's bundled digits
+dataset (1,797 real 8×8 handwritten-digit scans — the only real image
+dataset available without network in this build environment). It is a
+modest model with an honest scope: ten `digit N` classes, ~97% eval
+top-1 — enough to make the full provision→index→label pipeline real
+offline, and the exact same artifact contract (`weights.npz`) any
+user-trained or downloaded model uses.
+
+Run `python -m spacedrive_tpu.models.make_bundled` to rebuild; it
+retrains with a fixed seed, overwrites the artifact, and rewrites
+`MANIFEST.json` (sha256 pin + metrics + provenance). Provisioning
+verifies the pin before install.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .provision import sha256_file
+
+BUNDLED_DIR = os.path.join(os.path.dirname(__file__), "bundled")
+ARTIFACT = os.path.join(BUNDLED_DIR, "labeler_digits.npz")
+MANIFEST = os.path.join(BUNDLED_DIR, "MANIFEST.json")
+
+
+def build(steps: int = 600, use_device: bool = False) -> dict:
+    from . import checkpoint
+    from .train import TrainConfig, array_batches, digits_demo_dataset, train
+
+    cfg = TrainConfig(
+        image_size=32, widths=(8, 16, 32, 32, 32), depths=(1, 1, 1, 1),
+        batch_size=64, steps=steps, use_device=use_device, seed=0,
+    )
+    (tr_x, tr_y), (ev_x, ev_y), classes = digits_demo_dataset(cfg.image_size)
+    params, _model, metrics = train(
+        array_batches(tr_x, tr_y, cfg.batch_size, seed=cfg.seed),
+        classes, cfg, eval_set=(ev_x, ev_y),
+        progress=lambda step, loss: print(f"step {step}  loss {loss:.4f}"),
+    )
+    os.makedirs(BUNDLED_DIR, exist_ok=True)
+    checkpoint.save(
+        ARTIFACT, params, classes=classes, image_size=cfg.image_size,
+        widths=cfg.widths, depths=cfg.depths,
+        extra={"metrics": metrics,
+               "trained_on": "sklearn digits (1,797 8x8 scans)"},
+    )
+    manifest = {
+        "artifact": os.path.basename(ARTIFACT),
+        "sha256": sha256_file(ARTIFACT),
+        "bytes": os.path.getsize(ARTIFACT),
+        "classes": classes,
+        "image_size": cfg.image_size,
+        "steps": steps,
+        "metrics": metrics,
+        "rebuild": "python -m spacedrive_tpu.models.make_bundled",
+    }
+    with open(MANIFEST, "w") as f:
+        json.dump(manifest, f, indent=2)
+    return manifest
+
+
+if __name__ == "__main__":
+    print(json.dumps(build(), indent=2))

@@ -75,14 +75,19 @@ def _validate_checkpoint(path: str) -> dict:
     }
 
 
-def _check_digest(path: str, sha256: str) -> None:
+def sha256_file(path: str) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
         for block in iter(lambda: f.read(1 << 20), b""):
             h.update(block)
-    if h.hexdigest().lower() != sha256.lower():
+    return h.hexdigest()
+
+
+def _check_digest(path: str, sha256: str) -> None:
+    actual = sha256_file(path)
+    if actual.lower() != sha256.lower():
         raise ProvisionError(
-            f"sha256 mismatch: artifact is {h.hexdigest()}, "
+            f"sha256 mismatch: artifact is {actual}, "
             f"pinned {sha256.lower()} — refusing to install"
         )
 
@@ -137,6 +142,29 @@ def import_artifact(
         elif os.path.exists(cls_dest):
             os.unlink(cls_dest)  # stale names from a previous model
     return {"kind": "onnx", "path": dest, **info}
+
+
+def install_bundled(labeler_dir: str) -> dict:
+    """Install the in-package offline artifact (`models/bundled/`) —
+    a trained digits LabelerNet — verified against its MANIFEST.json
+    sha256 pin. Zero egress: this is the air-gapped answer to the
+    reference's CDN download (yolov8.rs:45-88)."""
+    from .make_bundled import ARTIFACT, MANIFEST
+
+    if not (os.path.exists(ARTIFACT) and os.path.exists(MANIFEST)):
+        raise ProvisionError(
+            "bundled artifact missing from the package; rebuild with "
+            "`python -m spacedrive_tpu.models.make_bundled`"
+        )
+    with open(MANIFEST) as f:
+        manifest = json.load(f)
+    info = import_artifact(ARTIFACT, labeler_dir, sha256=manifest["sha256"])
+    info["bundled"] = {
+        "sha256": manifest["sha256"],
+        "metrics": manifest.get("metrics", {}),
+        "classes": manifest.get("classes", []),
+    }
+    return info
 
 
 def fetch(url: str, labeler_dir: str, classes: list[str] | None = None,

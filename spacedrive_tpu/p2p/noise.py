@@ -236,21 +236,22 @@ class HandshakeState:
         if self._finished or self._my_turn_to_write():
             raise NoiseError("out-of-order read_message")
         buf = memoryview(message)
-        try:
-            for token in self._XX[self._msg_idx]:
-                if token == "e":
-                    self.re = bytes(buf[:DHLEN])
-                    buf = buf[DHLEN:]
-                    self.ss.mix_hash(self.re)
-                elif token == "s":
-                    n = DHLEN + (TAGLEN if self.ss.cipher.has_key() else 0)
-                    self.rs = self.ss.decrypt_and_hash(bytes(buf[:n]))
-                    buf = buf[n:]
-                else:
-                    self._mix_dh(token)
-            payload = self.ss.decrypt_and_hash(bytes(buf))
-        except (IndexError, ValueError) as exc:
-            raise NoiseError("truncated handshake message") from exc
+        for token in self._XX[self._msg_idx]:
+            if token == "e":
+                if len(buf) < DHLEN:
+                    raise NoiseError("truncated handshake message")
+                self.re = bytes(buf[:DHLEN])
+                buf = buf[DHLEN:]
+                self.ss.mix_hash(self.re)
+            elif token == "s":
+                n = DHLEN + (TAGLEN if self.ss.cipher.has_key() else 0)
+                if len(buf) < n:
+                    raise NoiseError("truncated handshake message")
+                self.rs = self.ss.decrypt_and_hash(bytes(buf[:n]))
+                buf = buf[n:]
+            else:
+                self._mix_dh(token)
+        payload = self.ss.decrypt_and_hash(bytes(buf))
         self._advance()
         return payload
 

@@ -232,5 +232,13 @@ def test_cacophony_vectors():
             rd.read_message(ct)
         if "handshake_hash" in vec:
             assert i.handshake_hash.hex() == vec["handshake_hash"]
+        # transport-phase messages exercise Split() key order and the
+        # directional counter nonces; senders keep alternating (msg3 is
+        # the responder, msg4 the initiator, …)
+        c_i2r, c_r2i = i.split()
+        for idx, msg in enumerate(vec["messages"][3:], start=3):
+            sender = c_r2i if idx % 2 else c_i2r
+            ct = sender.encrypt_with_ad(b"", bytes.fromhex(msg["payload"]))
+            assert ct.hex() == msg["ciphertext"], f"transport message {idx}"
         ran += 1
     assert ran > 0, "no XX/25519/ChaChaPoly/SHA256 vectors in corpus"
