@@ -10,6 +10,7 @@ import { openDropPanel, rejectPendingOffer, showDropOffer, wireDropPanel } from 
 import { addLocationModal, wireSettingsPanel } from "/static/js/settings.js";
 import { showMenu, wireContextMenu } from "/static/js/contextmenu.js";
 import { showOnboarding } from "/static/js/onboarding.js";
+import { confirmDialog, initTooltips, promptDialog, toast } from "/static/js/ui.js";
 import { openPreview, previewOpen, wireQuickPreview } from "/static/js/quickpreview.js";
 import { droppable, guardTarget } from "/static/js/dnd.js";
 
@@ -105,7 +106,10 @@ async function refreshNav() {
       loadContent(true); };
     item.oncontextmenu = async (e) => {
       e.preventDefault();
-      if (confirm(`delete saved search “${s.name || s.search}”?`)) {
+      const ok = await confirmDialog("Delete saved search?",
+        `“${s.name || s.search}” will be removed from the sidebar.`,
+        {danger: true, actionLabel: "delete"});
+      if (ok) {
         await client.search.saved.delete(s.id, state.lib);
         refreshNav();
       }
@@ -154,9 +158,13 @@ $("btn-save-search").onclick = async () => {
     clearSelection();
     loadContent(true);
   }
-  const name = prompt("save this search as…", text);
+  const name = await promptDialog("Save search", {
+    value: text, message: "bookmark this query in the sidebar",
+    actionLabel: "save",
+  });
   if (!name) return;
   await client.search.saved.create({name, search: text}, state.lib);
+  toast("search saved", {kind: "ok"});
   refreshNav();
 };
 $("btn-addloc").onclick = () => addLocationModal();
@@ -166,6 +174,7 @@ wireDropPanel();
 wireSettingsPanel();
 wireContextMenu();
 wireQuickPreview();
+initTooltips();
 
 // ---------- keyboard navigation ----------
 const VIEWS = ["grid", "list", "media"];
@@ -201,10 +210,10 @@ document.addEventListener("keydown", (e) => {
       break;
     case "Escape":
       // a pending spacedrop offer must be answered, not dismissed
+      // (other dialogs handle their own Escape in openDialog)
       if (rejectPendingOffer()) break;
       document.querySelectorAll(".panel.open")
         .forEach(p => p.classList.remove("open"));
-      $("modal-back").classList.remove("open");
       closeInspector();
       break;
   }

@@ -1,36 +1,39 @@
 // Right-click context menu: rename, delete, copy/cut/paste, validate
 // (role parity: ref:interface Explorer ContextMenu over the files.*
-// jobs — core/src/object/fs).
+// jobs — core/src/object/fs). Menu/dialog/toast primitives come from
+// the ui kit (ui.js), matching ref:packages/ui/src/ContextMenu.tsx.
 
 import client from "/rspc/client.js";
-import { $, bus, el, fullPath, modal, state } from "/static/js/util.js";
+import { $, bus, el, fullPath, state } from "/static/js/util.js";
+import {
+  confirmDialog, initMenus, openMenu, promptDialog, toast,
+} from "/static/js/ui.js";
 
 let clipboard = null;  // {op, ids, location_id, lib} — lib-scoped:
 // file_path ids are per-library, so a stale clipboard must never
 // paste across a library switch
-let menuEl = null;
 
-function closeMenu() {
-  menuEl?.remove();
-  menuEl = null;
-}
-
-function item(label, onclick, danger = false) {
-  const it = el("div", "ctx-item" + (danger ? " danger" : ""), label);
-  it.onclick = async () => {
-    closeMenu();
-    try {
-      await onclick();
-    } catch (e) {
-      $("events").textContent = "✗ " + e.message;
-    }
+function pasteItem() {
+  if (clipboard && clipboard.lib !== state.lib) clipboard = null;
+  if (!clipboard || !state.loc || state.mode !== "browse") return null;
+  return {
+    label: "Paste into this folder",
+    onClick: async () => {
+      const arg = {
+        source_location_id: clipboard.location_id,
+        target_location_id: state.loc,
+        sources_file_path_ids: clipboard.ids,
+        target_relative_path: state.path,
+      };
+      await (clipboard.op === "cut"
+        ? client.files.cutFiles(arg, state.lib)
+        : client.files.copyFiles(arg, state.lib));
+      if (clipboard.op === "cut") clipboard = null;
+    },
   };
-  return it;
 }
 
 export function showMenu(x, y, n) {
-  closeMenu();
-  menuEl = el("div", "ctxmenu");
   const refresh = () => bus.loadContent(true);
   // when the clicked item is part of a multi-selection, batch ops
   // cover the whole selection (same location only — the jobs are
@@ -43,119 +46,78 @@ export function showMenu(x, y, n) {
   const chosen = chosenAll.filter(x => x.location_id === n.location_id);
   const many = chosen.length > 1;
   const label = (verb) => many ? `${verb} ${chosen.length} items` : verb;
+  const displayName = n.name + (n.extension ? "." + n.extension : "");
 
-  menuEl.appendChild(item("Rename…", async () => {
-    const name = prompt(
-      "new name", n.name + (n.extension ? "." + n.extension : "")
-    );
-    if (!name) return;
-    await client.files.renameFile({id: n.id, new_name: name}, state.lib);
-    refresh();
-  }));
-
-  menuEl.appendChild(item(label("Copy"), () => {
-    clipboard = {op: "copy", ids: chosen.map(x => x.id),
-                 location_id: n.location_id, lib: state.lib};
-    $("events").textContent = `copied ${chosen.length} item(s)`;
-  }));
-  menuEl.appendChild(item(label("Cut"), () => {
-    clipboard = {op: "cut", ids: chosen.map(x => x.id),
-                 location_id: n.location_id, lib: state.lib};
-    $("events").textContent = `cut ${chosen.length} item(s)`;
-  }));
-  if (clipboard && clipboard.lib !== state.lib) clipboard = null;
-  if (clipboard && state.loc && state.mode === "browse") {
-    menuEl.appendChild(item("Paste into this folder", async () => {
-      const arg = {
-        source_location_id: clipboard.location_id,
-        target_location_id: state.loc,
-        sources_file_path_ids: clipboard.ids,
-        target_relative_path: state.path,
-      };
-      await (clipboard.op === "cut"
-        ? client.files.cutFiles(arg, state.lib)
-        : client.files.copyFiles(arg, state.lib));
-      if (clipboard.op === "cut") clipboard = null;
-    }));
-  }
-
-  if (!n.is_dir) {
+  openMenu(x, y, [
+    {
+      label: "Rename…",
+      onClick: async () => {
+        const name = await promptDialog("Rename", {
+          value: displayName, actionLabel: "rename",
+        });
+        if (!name) return;
+        await client.files.renameFile({id: n.id, new_name: name}, state.lib);
+        refresh();
+      },
+    },
+    {
+      label: label("Copy"),
+      onClick: () => {
+        clipboard = {op: "copy", ids: chosen.map(x => x.id),
+                     location_id: n.location_id, lib: state.lib};
+        toast(`copied ${chosen.length} item(s)`);
+      },
+    },
+    {
+      label: label("Cut"),
+      onClick: () => {
+        clipboard = {op: "cut", ids: chosen.map(x => x.id),
+                     location_id: n.location_id, lib: state.lib};
+        toast(`cut ${chosen.length} item(s)`);
+      },
+    },
+    pasteItem(),
+    {separator: true},
     // scoped to the file's folder — a bare location_id would checksum
     // the whole location from a per-file menu item
-    menuEl.appendChild(item("Validate folder checksums", () =>
-      client.files.validate({
+    n.is_dir ? null : {
+      label: "Validate folder checksums",
+      onClick: () => client.files.validate({
         location_id: n.location_id,
         sub_path: n.materialized_path || "/",
-      }, state.lib)));
-  }
-  menuEl.appendChild(item(
-    chosenAll.length > 1 ? `📡 Spacedrop ${chosenAll.length} items`
-                         : "📡 Spacedrop",
-    () => bus.openDropPanel(chosenAll.map(fullPath))));
-
-  menuEl.appendChild(item(label("Delete"), () => modal("Delete?", (m, close) => {
-    m.appendChild(el("p", "meta",
-      (many ? `${chosen.length} items` :
-       `“${n.name}${n.extension ? "." + n.extension : ""}”`)
-      + " will be moved out of the library and removed from disk."));
-    const actions = el("div", "modal-actions");
-    const cancel = el("button", "", "cancel");
-    cancel.onclick = close;
-    const go = el("button", "danger", "delete");
-    go.onclick = async () => {
-      close();
-      try {
+      }, state.lib),
+    },
+    {
+      label: chosenAll.length > 1
+        ? `📡 Spacedrop ${chosenAll.length} items` : "📡 Spacedrop",
+      onClick: () => bus.openDropPanel(chosenAll.map(fullPath)),
+    },
+    {separator: true},
+    {
+      label: label("Delete"),
+      danger: true,
+      onClick: async () => {
+        const what = many ? `${chosen.length} items` : `“${displayName}”`;
+        const ok = await confirmDialog("Delete?",
+          what + " will be moved out of the library and removed from disk.",
+          {danger: true, actionLabel: "delete"});
+        if (!ok) return;
         await client.files.deleteFiles(
           {location_id: n.location_id,
            file_path_ids: chosen.map(x => x.id)}, state.lib);
-      } catch (e) {
-        $("events").textContent = "✗ delete: " + e.message;
-      }
-    };
-    actions.appendChild(cancel);
-    actions.appendChild(go);
-    m.appendChild(actions);
-  }), true));
-
-  menuEl.style.left = Math.min(x, innerWidth - 190) + "px";
-  menuEl.style.top = Math.min(y, innerHeight - 240) + "px";
-  document.body.appendChild(menuEl);
+      },
+    },
+  ]);
 }
 
 /** Menu for empty space: paste into the current folder. */
 export function showBackgroundMenu(x, y) {
-  if (clipboard && clipboard.lib !== state.lib) clipboard = null;
-  if (!clipboard || !state.loc || state.mode !== "browse") return;
-  closeMenu();
-  menuEl = el("div", "ctxmenu");
-  menuEl.appendChild(item("Paste into this folder", async () => {
-    const arg = {
-      source_location_id: clipboard.location_id,
-      target_location_id: state.loc,
-      sources_file_path_ids: clipboard.ids,
-      target_relative_path: state.path,
-    };
-    await (clipboard.op === "cut"
-      ? client.files.cutFiles(arg, state.lib)
-      : client.files.copyFiles(arg, state.lib));
-    if (clipboard.op === "cut") clipboard = null;
-  }));
-  menuEl.style.left = Math.min(x, innerWidth - 190) + "px";
-  menuEl.style.top = Math.min(y, innerHeight - 240) + "px";
-  document.body.appendChild(menuEl);
+  const paste = pasteItem();
+  if (paste) openMenu(x, y, [paste]);
 }
 
 export function wireContextMenu() {
-  document.addEventListener("click", closeMenu);
-  // capture phase: Escape dismisses ONLY the menu when one is open —
-  // it must not fall through to the global handler (inspector/panels/
-  // pending-spacedrop rejection)
-  document.addEventListener("keydown", (e) => {
-    if (e.key === "Escape" && menuEl) {
-      e.stopPropagation();
-      closeMenu();
-    }
-  }, true);
+  initMenus();  // click-outside + capture-phase Escape dismissal
   $("content").addEventListener("contextmenu", (e) => {
     if (e.target.closest(".card, tr[data-fp]")) return;  // item menus
     e.preventDefault();

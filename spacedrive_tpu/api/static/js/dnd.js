@@ -4,7 +4,8 @@
 // DragOverlay.tsx, ExplorerDroppable.tsx over core/src/object/fs/cut).
 
 import client from "/rspc/client.js";
-import { $, bus, state } from "/static/js/util.js";
+import { bus, state } from "/static/js/util.js";
+import { toast } from "/static/js/ui.js";
 
 let drag = null; // {ids, dirPaths, location_id} — the in-flight drag payload
 
@@ -57,10 +58,10 @@ export function droppable(elem, targetFn) {
         sources_file_path_ids: src.ids,
         target_relative_path: target.path,
       }, state.lib);
-      $("events").textContent = `moved ${src.ids.length} item(s)`;
+      toast(`moved ${src.ids.length} item(s)`, {kind: "ok"});
       bus.loadContent(true);
     } catch (err) {
-      $("events").textContent = "✗ move: " + err.message;
+      toast("✗ move: " + err.message, {kind: "error"});
     }
   });
 }

@@ -1,29 +1,42 @@
-// Settings panel: node, library, locations, features, volumes
-// (role parity: ref:interface/app/$libraryId/settings screens).
+// Settings panel: node, library, locations, volumes — a tabbed panel
+// built on the ui kit (role parity: ref:interface/app/$libraryId/
+// settings screens over ref:packages/ui Tabs/Dialog/Toast).
 
 import client from "/rspc/client.js";
-import { $, bus, el, fmtBytes, modal, state } from "/static/js/util.js";
+import { $, bus, el, fmtBytes, state } from "/static/js/util.js";
+import {
+  confirmDialog, openDialog, tabs, toast,
+} from "/static/js/ui.js";
 
-export async function renderSettings() {
+let activeTab = "node";
+
+export function renderSettings() {
   const p = $("settings-panel");
   p.innerHTML = "";
   p.appendChild(el("h2", "", "Settings"));
+  tabs(p, [
+    {id: "node", label: "Node", render: renderNodeTab},
+    {id: "library", label: "Library", render: renderLibraryTab},
+    {id: "locations", label: "Locations", render: renderLocationsTab},
+    {id: "volumes", label: "Volumes", render: renderVolumesTab},
+  ], {initial: activeTab, onSelect: (id) => { activeTab = id; }});
+}
 
+async function renderNodeTab(body) {
   const ns = await client.nodeState();
-
-  // --- node -----------------------------------------------------------
-  p.appendChild(el("h4", "", "This node"));
+  body.appendChild(el("h4", "", "This node"));
   const nameRow = el("div", "row");
   const nameIn = el("input");
   nameIn.value = ns.name || "";
   const nameBtn = el("button", "mini", "rename");
   nameBtn.onclick = async () => {
     await client.nodes.edit({name: nameIn.value});
+    toast("node renamed", {kind: "ok"});
     bus.refreshHeader?.();
   };
   nameRow.appendChild(nameIn);
   nameRow.appendChild(nameBtn);
-  p.appendChild(nameRow);
+  body.appendChild(nameRow);
 
   const bgRow = el("div", "row");
   bgRow.appendChild(el("span", "", "background thumbnailing %"));
@@ -35,8 +48,9 @@ export async function renderSettings() {
   bgIn.onchange = () => client.nodes.updateThumbnailerPreferences(
     {background_processing_percentage: +bgIn.value});
   bgRow.appendChild(bgIn);
-  p.appendChild(bgRow);
+  body.appendChild(bgRow);
 
+  body.appendChild(el("h4", "", "Features"));
   for (const feat of ["filesOverP2P", "cloudSync"]) {
     const row = el("div", "row");
     row.appendChild(el("span", "", feat));
@@ -46,53 +60,45 @@ export async function renderSettings() {
     cb.onchange = () =>
       client.toggleFeatureFlag({feature: feat, enabled: cb.checked});
     row.appendChild(cb);
-    p.appendChild(row);
+    body.appendChild(row);
   }
+}
 
-  // --- library --------------------------------------------------------
-  p.appendChild(el("h4", "", "Library"));
+async function renderLibraryTab(body) {
   const libs = await client.library.list();
   const cur = libs.find(l => l.uuid === state.lib);
-  if (cur) {
-    const rn = el("div", "row");
-    const libIn = el("input");
-    libIn.value = cur.config.name;
-    const rb = el("button", "mini", "rename");
-    rb.onclick = async () => {
-      await client.library.edit({id: state.lib, name: libIn.value});
-      bus.reloadLibraries?.();
-    };
-    rn.appendChild(libIn);
-    rn.appendChild(rb);
-    p.appendChild(rn);
+  if (!cur) return;
+  const rn = el("div", "row");
+  const libIn = el("input");
+  libIn.value = cur.config.name;
+  const rb = el("button", "mini", "rename");
+  rb.onclick = async () => {
+    await client.library.edit({id: state.lib, name: libIn.value});
+    toast("library renamed", {kind: "ok"});
+    bus.reloadLibraries?.();
+  };
+  rn.appendChild(libIn);
+  rn.appendChild(rb);
+  body.appendChild(rn);
 
-    const act = el("div", "row");
-    const newBtn = el("button", "mini", "+ new library");
-    newBtn.onclick = () => createLibraryModal();
-    const delBtn = el("button", "mini danger", "delete library");
-    delBtn.onclick = () => modal("Delete library?", (m, close) => {
-      m.appendChild(el("p", "meta",
-        `“${cur.config.name}” and its index will be removed (files on `
-        + "disk are untouched)."));
-      const actions = el("div", "modal-actions");
-      const cancel = el("button", "", "cancel");
-      cancel.onclick = close;
-      const go = el("button", "danger", "delete");
-      go.onclick = async () => {
-        await client.library.delete({id: state.lib});
-        close();
-        bus.reloadLibraries?.();
-      };
-      actions.appendChild(cancel); actions.appendChild(go);
-      m.appendChild(actions);
-    });
-    act.appendChild(newBtn);
-    act.appendChild(delBtn);
-    p.appendChild(act);
-  }
+  const act = el("div", "row");
+  const newBtn = el("button", "mini", "+ new library");
+  newBtn.onclick = () => createLibraryModal();
+  const delBtn = el("button", "mini danger", "delete library");
+  delBtn.onclick = async () => {
+    const ok = await confirmDialog("Delete library?",
+      `“${cur.config.name}” and its index will be removed (files on `
+      + "disk are untouched).", {danger: true, actionLabel: "delete"});
+    if (!ok) return;
+    await client.library.delete({id: state.lib});
+    bus.reloadLibraries?.();
+  };
+  act.appendChild(newBtn);
+  act.appendChild(delBtn);
+  body.appendChild(act);
+}
 
-  // --- locations ------------------------------------------------------
-  p.appendChild(el("h4", "", "Locations"));
+async function renderLocationsTab(body) {
   const locs = await client.locations.list(null, state.lib);
   for (const n of locs.nodes) {
     const row = el("div", "loc-row");
@@ -100,12 +106,15 @@ export async function renderSettings() {
     row.appendChild(el("div", "meta", n.path));
     const act = el("div", "actions");
     const rescan = el("button", "mini", "rescan");
+    rescan.setAttribute("data-tip", "re-walk this location and re-identify changes");
     rescan.onclick = async () => {
       await client.locations.fullRescan(
         {location_id: n.id, reidentify_objects: false}, state.lib);
       rescan.textContent = "rescanning…";
+      toast("rescan started", {kind: "ok"});
     };
     const del = el("button", "mini danger", "remove");
+    del.setAttribute("data-tip", "stop indexing; files on disk are untouched");
     del.onclick = async () => {
       await client.locations.delete(n.id, state.lib);
       renderSettings();
@@ -114,26 +123,26 @@ export async function renderSettings() {
     act.appendChild(rescan);
     act.appendChild(del);
     row.appendChild(act);
-    p.appendChild(row);
+    body.appendChild(row);
   }
   const addBtn = el("button", "", "+ add location");
   addBtn.onclick = () => addLocationModal();
-  p.appendChild(addBtn);
+  body.appendChild(addBtn);
+}
 
-  // --- volumes --------------------------------------------------------
-  p.appendChild(el("h4", "", "Volumes"));
+async function renderVolumesTab(body) {
   const vols = await client.volumes.list();
   for (const v of vols) {
     const row = el("div", "row");
     row.appendChild(el("span", "", `${v.name || v.mount_point}`));
     row.appendChild(el("span", "meta",
       `${fmtBytes(v.available_capacity)} free of ${fmtBytes(v.total_capacity)}`));
-    p.appendChild(row);
+    body.appendChild(row);
   }
 }
 
 export function addLocationModal() {
-  modal("Add location", (m, close) => {
+  openDialog("Add location", (m, close) => {
     m.appendChild(el("p", "meta",
       "absolute path of a directory to index and watch"));
     const path = el("input");
@@ -154,6 +163,7 @@ export function addLocationModal() {
         await client.locations.create(
           {path: path.value, name: name.value || null}, state.lib);
         close();
+        toast("location added — indexing", {kind: "ok"});
         bus.refreshNav?.();
       } catch (e) {
         err.textContent = e.message;
@@ -166,7 +176,7 @@ export function addLocationModal() {
 }
 
 export function createLibraryModal() {
-  modal("New library", (m, close) => {
+  openDialog("New library", (m, close) => {
     const name = el("input");
     name.placeholder = "library name";
     m.appendChild(name);

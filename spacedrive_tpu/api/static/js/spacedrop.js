@@ -3,6 +3,7 @@
 
 import client from "/rspc/client.js";
 import { $, el, fmtBytes } from "/static/js/util.js";
+import { openDialog, toast } from "/static/js/ui.js";
 
 let dropQueue = [];  // file paths staged for sending
 
@@ -34,9 +35,11 @@ export async function openDropPanel(paths) {
         await client.p2p.spacedrop(
           {identity: peer.identity, file_paths: dropQueue});
         $("drop-status").textContent = "✓ sent";
+        toast("spacedrop sent", {kind: "ok"});
         dropQueue = [];
       } catch (e) {
         $("drop-status").textContent = "✗ " + e.message;
+        toast("✗ spacedrop: " + e.message, {kind: "error"});
       }
     };
     row.appendChild(send);
@@ -46,51 +49,51 @@ export async function openDropPanel(paths) {
     peers.appendChild(el("div", "meta", "no peers discovered yet"));
 }
 
-let pendingOffer = null;  // offer id awaiting accept/reject
+let pendingOffer = null;  // {id, close} — offer awaiting accept/reject
 
-/** Escape on a pending offer = explicit reject (a dismissed modal
+/** Escape on a pending offer = explicit reject (a dismissed dialog
  *  would strand the sender). Returns true if an offer was handled. */
 export function rejectPendingOffer() {
   if (pendingOffer == null) return false;
-  const id = pendingOffer;
+  const {id, close} = pendingOffer;
   pendingOffer = null;
   client.p2p.rejectSpacedrop(id).catch(() => {});
-  $("modal-back").classList.remove("open");
+  close();
   return true;
 }
 
 export function showDropOffer(ev) {
-  const back = $("modal-back");
-  const modal = $("modal");
-  pendingOffer = ev.id;
-  modal.innerHTML = "";
-  modal.appendChild(el("h2", "", "Incoming Spacedrop"));
-  modal.appendChild(el("div", "meta", `from ${ev.peer.slice(0, 24)}…`));
-  const list = el("div");
-  list.style.margin = "8px 0";
-  for (const f of ev.files) list.appendChild(el("div", "", "• " + f));
-  modal.appendChild(list);
-  modal.appendChild(el("div", "meta", fmtBytes(ev.total_size)));
-  const dir = el("input");
-  dir.placeholder = "target directory (blank = default)";
-  modal.appendChild(dir);
-  const actions = el("div", "modal-actions");
-  const reject = el("button", "danger", "reject");
-  reject.onclick = async () => {
-    pendingOffer = null;
-    await client.p2p.rejectSpacedrop(ev.id);
-    back.classList.remove("open");
-  };
-  const accept = el("button", "primary", "accept");
-  accept.onclick = async () => {
-    pendingOffer = null;
-    await client.p2p.acceptSpacedrop(
-      {id: ev.id, target_dir: dir.value || null});
-    back.classList.remove("open");
-  };
-  actions.appendChild(reject); actions.appendChild(accept);
-  modal.appendChild(actions);
-  back.classList.add("open");
+  // sticky: the dialog's own Escape/backdrop dismissal is disabled —
+  // the global Escape handler routes to rejectPendingOffer instead
+  const close = openDialog("Incoming Spacedrop", (m, closeDlg) => {
+    m.appendChild(el("div", "meta", `from ${ev.peer.slice(0, 24)}…`));
+    const list = el("div");
+    list.style.margin = "8px 0";
+    for (const f of ev.files) list.appendChild(el("div", "", "• " + f));
+    m.appendChild(list);
+    m.appendChild(el("div", "meta", fmtBytes(ev.total_size)));
+    const dir = el("input");
+    dir.placeholder = "target directory (blank = default)";
+    m.appendChild(dir);
+    const actions = el("div", "modal-actions");
+    const reject = el("button", "danger", "reject");
+    reject.onclick = async () => {
+      pendingOffer = null;
+      await client.p2p.rejectSpacedrop(ev.id);
+      closeDlg();
+    };
+    const accept = el("button", "primary", "accept");
+    accept.onclick = async () => {
+      pendingOffer = null;
+      await client.p2p.acceptSpacedrop(
+        {id: ev.id, target_dir: dir.value || null});
+      closeDlg();
+      toast("spacedrop accepted — receiving", {kind: "ok"});
+    };
+    actions.appendChild(reject); actions.appendChild(accept);
+    m.appendChild(actions);
+  }, {sticky: true});
+  pendingOffer = {id: ev.id, close};
 }
 
 export function wireDropPanel() {

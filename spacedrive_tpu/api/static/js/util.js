@@ -1,5 +1,7 @@
 // Shared state + tiny DOM helpers (role parity: packages/client stores).
 
+import { openDialog } from "/static/js/ui.js";
+
 export const KIND_ICON = {0:"📄",1:"📑",2:"📁",3:"📝",4:"📦",5:"🖼️",6:"🎵",
                           7:"🎬",8:"🗜️",9:"⚙️",10:"🔗",11:"🔒",12:"🔑",
                           13:"🔗",14:"🌐"};
@@ -42,15 +44,10 @@ export const relPath = (n) =>
 
 export const fullPath = (n) => (state.locPaths[n.location_id] || "") + relPath(n);
 
-/** Simple modal helper: body builder receives the modal element and a
- *  close function; returns close. */
+/** Modal helper — thin wrapper over the ui kit's Dialog so every
+ *  existing call site gets focus trapping + Escape + focus restore.
+ *  (util ⇄ ui is a call-time-only ES-module cycle — both sides touch
+ *  the other's exports inside functions, never at eval time.) */
 export function modal(title, build) {
-  const back = $("modal-back");
-  const m = $("modal");
-  m.innerHTML = "";
-  m.appendChild(el("h2", "", title));
-  const close = () => back.classList.remove("open");
-  build(m, close);
-  back.classList.add("open");
-  return close;
+  return openDialog(title, build);
 }

@@ -73,6 +73,24 @@ def test_explorer_assets_and_client_shape(tmp_path):
                 ) as resp:
                     assert resp.status in (400, 404)
 
+                # the component kit (ref:packages/ui analogue) is served
+                # and consumed by the app modules, not re-implemented
+                # ad hoc per module
+                async with http.get(f"{base}/static/js/ui.js") as resp:
+                    assert resp.status == 200
+                    ui_js = await resp.text()
+                for prim in ("openDialog", "confirmDialog", "promptDialog",
+                             "openMenu", "toast", "initTooltips", "tabs"):
+                    assert f"export function {prim}" in ui_js, prim
+                consumers = 0
+                for mod in mods:
+                    async with http.get(f"{base}{mod}") as resp:
+                        src = await resp.text()
+                    if '/static/js/ui.js"' in src:
+                        consumers += 1
+                assert consumers >= 3, (
+                    f"only {consumers} modules import the ui kit")
+
                 # the generated client covers every namespace the UI calls
                 async with http.get(f"{base}/rspc/client.js") as resp:
                     js = await resp.text()
