@@ -118,6 +118,51 @@ def _library(r: Router) -> None:
         update_statistics(library.db, node.thumbnailer.data_dir)
         return get_statistics(library.db)
 
+    @r.query("library.kindStatistics", library=True)
+    def kind_statistics(node, library):
+        """Per-ObjectKind object counts + byte totals for the overview
+        page (ref:core/src/api/libraries.rs:132 `kindStatistics`; the
+        reference leaves total_bytes at "0" — ours is real)."""
+        from ..db.database import blob_u64
+        from ..files.kind import ObjectKind
+
+        counts = {
+            row["kind"]: row["count"]
+            for row in library.db.query(
+                "SELECT kind, COUNT(*) AS count FROM object "
+                "WHERE kind IS NOT NULL GROUP BY kind"
+            )
+        }
+        # sizes live only as LE u64 blobs (schema parity) — aggregate
+        # host-side; one pass over file_path, same cost class as
+        # update_statistics
+        totals: dict[int, int] = {}
+        for row in library.db.query(
+            "SELECT o.kind AS kind, fp.size_in_bytes_bytes AS size "
+            "FROM file_path fp JOIN object o ON o.id = fp.object_id "
+            "WHERE o.kind IS NOT NULL"
+        ):
+            totals[row["kind"]] = (
+                totals.get(row["kind"], 0) + (blob_u64(row["size"]) or 0)
+            )
+
+        def kind_name(k: int) -> str:
+            try:
+                return ObjectKind(k).name
+            except ValueError:
+                return f"Kind{k}"
+
+        return {
+            "statistics": sorted(
+                (
+                    {"kind": k, "name": kind_name(k), "count": c,
+                     "total_bytes": str(totals.get(k, 0))}
+                    for k, c in counts.items()
+                ),
+                key=lambda s: -s["count"],
+            )
+        }
+
     @r.mutation("library.create")
     async def create(node, arg):
         lib = await node.create_library(
@@ -328,6 +373,41 @@ def _files(r: Router) -> None:
     @r.mutation("files.setFavorite", library=True)
     def set_favorite(node, library, arg):
         _object_update(node, library, int(arg["id"]), favorite=int(bool(arg["favorite"])))
+
+    @r.mutation("files.updateAccessTime", library=True)
+    def update_access_time(node, library, arg):
+        """Stamp object.date_accessed = now for the given file_path ids
+        (ref:core/src/api/files.rs:298 `updateAccessTime`; the explorer
+        calls it on open/preview and the recents route orders by it).
+        One timestamp, one transaction, one invalidation for the whole
+        batch; ids without an identified object are skipped — access
+        stamping is best-effort, like the reference's find_many."""
+        from datetime import datetime, timezone
+
+        now = datetime.now(timezone.utc).isoformat()
+        object_ids: list[int] = []
+        for fp_id in arg["ids"]:
+            row = library.db.find_one("file_path", id=int(fp_id))
+            if row and row["object_id"]:
+                object_ids.append(row["object_id"])
+        if not object_ids:
+            return None
+        ops = []
+        for oid in object_ids:
+            if pub := _object_pub(library, oid):
+                ops.append(library.sync.shared_update(
+                    "object", pub, "date_accessed", now))
+
+        def writes(conn):
+            conn.execute(
+                "UPDATE object SET date_accessed = ? "
+                f"WHERE id IN ({','.join('?' * len(object_ids))})",
+                (now, *object_ids),
+            )
+
+        library.sync.write_ops(ops, db_writes=writes)
+        invalidate_query(node, "search.objects", library)
+        return None
         return None
 
     @r.mutation("files.renameFile", library=True)

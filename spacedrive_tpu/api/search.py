@@ -36,6 +36,8 @@ _FILE_PATH_ORDER = {
     "dateCreated": "fp.date_created",
     "dateModified": "fp.date_modified",
     "dateIndexed": "fp.date_indexed",
+    # ISO-8601 text sorts chronologically; NULLs (never accessed) last
+    "dateAccessed": "COALESCE(o.date_accessed, '')",
 }
 
 _OBJECT_ORDER = {
@@ -94,6 +96,11 @@ def search_paths(library: Any, arg: dict[str, Any] | None) -> dict[str, Any]:
     if (fav := f.get("favorite")) is not None:
         conds.append("COALESCE(o.favorite, 0) = ?")
         params.append(int(bool(fav)))
+    if (acc := f.get("accessed")) is not None:
+        # recents route: only rows that were ever opened
+        conds.append(
+            "o.date_accessed IS NOT NULL" if acc else "o.date_accessed IS NULL"
+        )
     if (md := f.get("mediaDate")):
         # EXIF capture-time range over media_data.epoch_time
         # (ref:api/search object filters joining media_data)
@@ -117,7 +124,7 @@ def search_paths(library: Any, arg: dict[str, Any] | None) -> dict[str, Any]:
     where = ("WHERE " + " AND ".join(conds)) if conds else ""
     rows = library.db.query(
         f"SELECT fp.*, o.kind AS object_kind, o.favorite AS object_favorite, "
-        f"o.note AS object_note, "
+        f"o.note AS object_note, o.date_accessed AS object_date_accessed, "
         f"{order_field} AS __order "
         "FROM file_path fp LEFT JOIN object o ON o.id = fp.object_id "
         f"{where} ORDER BY {order_field} {direction}, fp.id ASC LIMIT ?",

@@ -21,6 +21,7 @@ let unsubJobs = null;
 bus.select = select;
 bus.openDropPanel = openDropPanel;
 bus.loadContent = loadContent;
+bus.clearSelection = clearSelection;
 bus.reloadLibraries = loadLibraries;
 bus.refreshNav = () => state.lib && refreshNav();
 bus.refreshHeader = async () => {
@@ -50,8 +51,9 @@ export async function loadLibraries() {
 }
 
 async function selectLibrary(id) {
+  // overview is the landing page, like the reference's $libraryId index
   Object.assign(state, { lib:id, loc:null, tag:null, search:"", cursor:null,
-                         path:"/", mode:"browse", selected:null,
+                         path:"/", mode:"overview", selected:null,
                          selectedIds:new Set() });
   if (unsubJobs) unsubJobs();
   unsubJobs = sock.subscribe("jobs.progress", onJobProgress, {libraryId:id});
@@ -59,7 +61,27 @@ async function selectLibrary(id) {
   loadContent(true);
 }
 
+function renderRoutes() {
+  // overview / favorites / recents (ref:interface/app/$libraryId/
+  // {overview,favorites.tsx,recents.tsx} sidebar routes)
+  const routes = $("routes");
+  routes.innerHTML = "";
+  const route = (label, mode) => {
+    const item = el("div", "item", label);
+    if (state.mode === mode) item.classList.add("active");
+    item.onclick = () => { setActive(item);
+      Object.assign(state, {mode, loc: null, tag: null, cursor: null});
+      clearSelection();
+      loadContent(true); };
+    routes.appendChild(item);
+  };
+  route("🏠 Overview", "overview");
+  route("★ Favorites", "favorites");
+  route("🕘 Recents", "recents");
+}
+
 async function refreshNav() {
+  renderRoutes();
   const [locs, tags, stats, saved] = await Promise.all([
     client.locations.list(null, state.lib),
     client.tags.list(null, state.lib),

@@ -3,6 +3,7 @@
 // ref:interface/app/$libraryId/Explorer/QuickPreview/index.tsx over
 // the range-served original, ref:core/src/custom_uri).
 
+import client from "/rspc/client.js";
 import { $, KIND_ICON, bus, el, fmtBytes, relPath, state } from "/static/js/util.js";
 
 export const fileUrl = (n) => {
@@ -26,6 +27,14 @@ export function openPreview(n) {
   current = n;
   render();
   $("preview-back").classList.add("open");
+  stampAccess(n);
+}
+
+/** opening a preview counts as opening the file — feeds the recents
+ *  route (ref:core/src/api/files.rs:298 updateAccessTime) */
+function stampAccess(n) {
+  n.object_date_accessed = new Date().toISOString();
+  client.files.updateAccessTime({ids: [n.id]}, state.lib).catch(() => {});
 }
 
 export function closePreview() {
@@ -44,6 +53,7 @@ export function stepPreview(delta) {
     current = next;
     bus.select(next);
     render();
+    stampAccess(next);
   }
 }
 

@@ -49,20 +49,32 @@ export async function openDropPanel(paths) {
     peers.appendChild(el("div", "meta", "no peers discovered yet"));
 }
 
-let pendingOffer = null;  // {id, close} — offer awaiting accept/reject
+let pendingOffer = null;  // {id, close} — offer currently dialogued
+let offerQueue = [];      // further offers wait their turn — one
+// sticky dialog at a time, so Escape always maps to THE visible offer
 
 /** Escape on a pending offer = explicit reject (a dismissed dialog
  *  would strand the sender). Returns true if an offer was handled. */
 export function rejectPendingOffer() {
   if (pendingOffer == null) return false;
   const {id, close} = pendingOffer;
-  pendingOffer = null;
+  settleOffer(id);
   client.p2p.rejectSpacedrop(id).catch(() => {});
   close();
   return true;
 }
 
+/** Clear pending state for offer `id` (and only it) and surface the
+ *  next queued offer, if any. */
+function settleOffer(id) {
+  if (pendingOffer?.id !== id) return;
+  pendingOffer = null;
+  const next = offerQueue.shift();
+  if (next) showDropOffer(next);
+}
+
 export function showDropOffer(ev) {
+  if (pendingOffer) { offerQueue.push(ev); return; }
   // sticky: the dialog's own Escape/backdrop dismissal is disabled —
   // the global Escape handler routes to rejectPendingOffer instead
   const close = openDialog("Incoming Spacedrop", (m, closeDlg) => {
@@ -78,16 +90,16 @@ export function showDropOffer(ev) {
     const actions = el("div", "modal-actions");
     const reject = el("button", "danger", "reject");
     reject.onclick = async () => {
-      pendingOffer = null;
-      await client.p2p.rejectSpacedrop(ev.id);
       closeDlg();
+      settleOffer(ev.id);
+      await client.p2p.rejectSpacedrop(ev.id);
     };
     const accept = el("button", "primary", "accept");
     accept.onclick = async () => {
-      pendingOffer = null;
+      closeDlg();
+      settleOffer(ev.id);
       await client.p2p.acceptSpacedrop(
         {id: ev.id, target_dir: dir.value || null});
-      closeDlg();
       toast("spacedrop accepted — receiving", {kind: "ok"});
     };
     actions.appendChild(reject); actions.appendChild(accept);

@@ -5,6 +5,7 @@
 import client from "/rspc/client.js";
 import { $, KIND_ICON, bus, el, fmtBytes, state, thumbUrl } from "/static/js/util.js";
 import { dirTarget, draggable, droppable, guardTarget } from "/static/js/dnd.js";
+import { loadOverview } from "/static/js/overview.js";
 
 export function setView(view) {
   state.view = view;
@@ -18,13 +19,32 @@ let loadSeq = 0;  // drop stale responses when loads overlap
 
 export async function loadContent(reset) {
   if (state.mode === "duplicates") return loadDuplicates();
+  if (state.mode === "overview") {
+    // invalidate any in-flight listing and drop its rows: a stale
+    // response must not paint over the landing page, and keyboard
+    // selection must not walk invisible nodes
+    ++loadSeq;
+    state.nodes = [];
+    state.cursor = null;
+    renderCrumbs();
+    return loadOverview();
+  }
   if (reset) { state.cursor = null; state.nodes = []; }
   const seq = ++loadSeq;
   const before = state.nodes.length;
   const filter = {};
+  const extra = {};
   if (state.mode === "search") {
     if (state.search) filter.search = state.search;
     if (state.loc) filter.locationId = state.loc;
+  } else if (state.mode === "favorites") {
+    filter.favorite = true;           // ref:favorites.tsx fixed filter
+  } else if (state.mode === "recents") {
+    filter.accessed = true;           // ref:recents.tsx dateAccessed filter
+    extra.orderBy = "dateAccessed";
+    extra.orderDir = "desc";
+  } else if (state.mode === "kind") {
+    filter.kinds = [state.kindFilter];
   } else {
     if (state.loc) {
       filter.locationId = state.loc;
@@ -32,9 +52,9 @@ export async function loadContent(reset) {
     }
   }
   if (state.tag) filter.tags = [state.tag];
-  if (state.view === "media") filter.kinds = [5, 7];
+  if (state.view === "media" && state.mode !== "kind") filter.kinds = [5, 7];
   const page = await client.search.paths(
-    {filter, take: 60, cursor: state.cursor}, state.lib);
+    {filter, take: 60, cursor: state.cursor, ...extra}, state.lib);
   if (seq !== loadSeq) return;  // a newer load superseded this one
   state.cursor = page.cursor;
   state.nodes = state.nodes.concat(page.nodes);
@@ -63,6 +83,27 @@ export function renderCrumbs() {
   }
   if (state.mode === "duplicates") {
     c.appendChild(el("span", "", "duplicate groups (cas_id exact match)"));
+    return;
+  }
+  if (state.mode === "overview") {
+    c.appendChild(el("span", "", "library overview"));
+    return;
+  }
+  if (state.mode === "favorites") {
+    c.appendChild(el("span", "", "★ favorites"));
+    return;
+  }
+  if (state.mode === "recents") {
+    c.appendChild(el("span", "", "🕘 recently opened"));
+    return;
+  }
+  if (state.mode === "kind") {
+    c.appendChild(el("span", "", `kind: ${state.kindName || state.kindFilter}`));
+    const back = el("button", "mini", "← overview");
+    back.style.marginLeft = "8px";
+    back.onclick = () => { state.mode = "overview"; clearSelection();
+      loadContent(true); };
+    c.appendChild(back);
     return;
   }
   if (state.tag) {
@@ -137,7 +178,10 @@ function appendFrom(start) {
     }
     renderListRows(listBody, state.nodes.slice(start));
   } else {
-    renderCards(c, state.view === "media", state.nodes.slice(start));
+    // kind mode already filters server-side; the media-view client
+    // filter would blank non-media kinds
+    renderCards(c, state.view === "media" && state.mode !== "kind",
+                state.nodes.slice(start));
   }
   if (state.cursor) {
     const btn = el("button", "", "load more");

@@ -311,6 +311,59 @@ def test_api_full_flow(tmp_path, corpus):
 # --- HTTP host ------------------------------------------------------------
 
 
+def test_overview_favorites_recents_api(tmp_path, corpus):
+    """The overview/favorites/recents routes' backing procedures
+    (ref:core/src/api/libraries.rs kindStatistics, files.rs
+    updateAccessTime, interface favorites.tsx/recents.tsx filters)."""
+
+    async def run():
+        node, lib, loc = await _scanned_node(tmp_path, corpus)
+        r = node.router
+        lid = str(lib.id)
+        try:
+            # kindStatistics: real counts + byte totals per kind
+            ks = await r.exec(node, "library.kindStatistics", library_id=lid)
+            stats = {s["name"]: s for s in ks["statistics"]}
+            assert stats["Text"]["count"] == 2  # alpha.txt, gamma.txt
+            assert int(stats["Text"]["total_bytes"]) == 1300
+            assert all(s["count"] > 0 for s in ks["statistics"])
+
+            # favorites over search.paths (the favorites route's query)
+            fp = lib.db.find_one("file_path", name="alpha")
+            await r.exec(node, "files.setFavorite",
+                         {"id": fp["id"], "favorite": True}, library_id=lid)
+            favs = await r.exec(node, "search.paths",
+                                {"filter": {"favorite": True}}, library_id=lid)
+            assert [n["name"] for n in favs["nodes"]] == ["alpha"]
+
+            # recents: nothing accessed yet
+            rec = await r.exec(node, "search.paths",
+                               {"filter": {"accessed": True}}, library_id=lid)
+            assert rec["nodes"] == []
+
+            # open two files (in order), then query the recents route:
+            # accessed-only, most recent first
+            beta = lib.db.find_one("file_path", name="beta")
+            await r.exec(node, "files.updateAccessTime",
+                         {"ids": [fp["id"]]}, library_id=lid)
+            await asyncio.sleep(0.01)  # distinct ISO timestamps
+            # unknown ids are skipped, not fatal mid-batch
+            await r.exec(node, "files.updateAccessTime",
+                         {"ids": [999999, beta["id"]]}, library_id=lid)
+            rec = await r.exec(
+                node, "search.paths",
+                {"filter": {"accessed": True},
+                 "orderBy": "dateAccessed", "orderDir": "desc"},
+                library_id=lid,
+            )
+            assert [n["name"] for n in rec["nodes"]] == ["beta", "alpha"]
+            assert all(n["object_date_accessed"] for n in rec["nodes"])
+        finally:
+            await node.shutdown()
+
+    asyncio.run(run())
+
+
 def test_http_server_and_custom_uri(tmp_path, corpus):
     async def run():
         import aiohttp

@@ -98,7 +98,8 @@ def test_explorer_assets_and_client_shape(tmp_path):
                     "library.create", "locations.create", "search.paths",
                     "search.duplicates", "tags.assign", "jobs.reports",
                     "p2p.spacedrop", "nodes.edit", "volumes.list",
-                    "toggleFeatureFlag",
+                    "toggleFeatureFlag", "library.kindStatistics",
+                    "files.updateAccessTime",
                 ):
                     assert key in js, f"client.js missing {key}"
                 assert "jobs.progress" in js  # subscriptions listed
