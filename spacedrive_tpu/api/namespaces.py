@@ -406,8 +406,11 @@ def _files(r: Router) -> None:
             )
 
         library.sync.write_ops(ops, db_writes=writes)
+        # search.paths too: the recents/favorites routes render object
+        # fields joined onto file_path rows, and the explorer's live
+        # refresh only listens for path-level invalidations
         invalidate_query(node, "search.objects", library)
-        return None
+        invalidate_query(node, "search.paths", library)
         return None
 
     @r.mutation("files.renameFile", library=True)
@@ -501,6 +504,9 @@ def _object_update(node: Any, library: Any, file_path_id: int, **fields: Any) ->
         db_writes=writes,
     )
     invalidate_query(node, "search.objects", library)
+    # favorite/note render on file_path rows (favorites route, grid
+    # badges) and the explorer live-refreshes on path invalidations
+    invalidate_query(node, "search.paths", library)
 
 
 # --- ephemeralFiles ------------------------------------------------------

@@ -24,6 +24,24 @@ its metadata:
   dialer → relay {"cmd":"dial","target":b58}  → {"ok":true} then raw pipe
   node → relay   {"cmd":"accept","conn":tok}  → {"ok":true} then raw pipe
   any → relay    {"cmd":"stats"}              → {"ok":true,"stats":{…}}
+
+Hole-punch coordination (DCUtR's role, see punch.py) rides the SAME
+authenticated listener channels, so observed addresses are only ever
+disclosed to registered identities; routing is stateless (the dialer
+mints `conn` and both messages carry the routing target):
+  A → relay      {"cmd":"punch","conn":tok,"target":B,"token":obs}
+  relay → B      {"event":"punch","conn":tok,"from":A,"addr":[h,p]}
+  B → relay      {"cmd":"punch_ack","conn":tok,"target":A,"token":obs}
+  relay → A      {"event":"punch_addr","conn":tok,"ok":true,"addr":[h,p]}
+The relay answers STUN-style observe datagrams on its UDP port
+(advertised as `udp_port` in the listen OK) and REMEMBERS each observe
+token → source address briefly; punch messages carry the token, and the
+relay substitutes the address IT WITNESSED. Peers therefore can only
+ever direct each other's probes at a UDP socket the claimant actually
+controls — never at an arbitrary third party. Residual disclosure (any
+registered identity can learn a peer's NAT mapping by asking) matches
+the reference's posture, where libp2p identify/DCUtR exchange observed
+addresses with any connected peer; nodes can opt out with punch=False.
 `tok` is an unguessable 128-bit token known only to the listener the
 incoming event was sent to, so a third party cannot race the accept.
 
@@ -155,17 +173,53 @@ class RelayServer:
         # race the legitimate listener and steal the pending pipe
         # (killing the dial — availability, not confidentiality, since
         # the end-to-end handshake still prevents impersonation)
+        # conn → (dial reader, dial writer, accepted future, target)
         self._pending: dict[str, tuple[asyncio.StreamReader, asyncio.StreamWriter,
-                                       "asyncio.Future[None]"]] = {}
+                                       "asyncio.Future[None]", str]] = {}
         self._server: asyncio.base_events.Server | None = None
         self.port: int | None = None
+        self._udp: "UdpEndpoint | None" = None
+        self.udp_port: int | None = None
+        # observe token → (witnessed addr, monotonic time); punch
+        # routing resolves addrs from here so they are relay-verified
+        self._observed: dict[str, tuple[tuple[str, int], float]] = {}
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._server = await asyncio.start_server(self._handle, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
+        # STUN-style observe endpoint for hole punching (punch.py)
+        from .punch import OBSERVE_MAGIC, observe_reply
+        from .udp import UdpEndpoint
+
+        self._udp = UdpEndpoint()
+        _, self.udp_port = await self._udp.bind(host, 0)
+
+        def on_dgram(data: bytes, addr: tuple[str, int]) -> None:
+            if not data.startswith(OBSERVE_MAGIC):
+                return
+            try:
+                token = json.loads(data[len(OBSERVE_MAGIC):]).get("token")
+            except ValueError:
+                return
+            if isinstance(token, str) and len(token) <= 64 \
+                    and self._udp is not None:
+                now = time.monotonic()
+                if len(self._observed) >= 4096:  # bounded: evict stale
+                    self._observed = {
+                        t: v for t, v in self._observed.items()
+                        if now - v[1] < 60.0
+                    }
+                if len(self._observed) < 4096:
+                    self._observed[token] = (tuple(addr), now)
+                self._udp.sendto(observe_reply(token, addr), addr)
+
+        self._udp.set_receiver(on_dgram)
         return self.port
 
     async def shutdown(self) -> None:
+        if self._udp is not None:
+            self._udp.close()
+            self._udp = None
         # close the control connections FIRST: on Python 3.12+
         # Server.wait_closed() blocks until every connection handler
         # returns, and listener handlers loop until their socket dies
@@ -240,7 +294,7 @@ class RelayServer:
             old.close()  # the authenticated newcomer supersedes
         self._listeners[ident] = writer
         self._meta[ident] = msg.get("meta", {})
-        write_frame(writer, {"ok": True})
+        write_frame(writer, {"ok": True, "udp_port": self.udp_port})
         await writer.drain()
         try:
             while True:
@@ -263,6 +317,50 @@ class RelayServer:
                     write_frame(writer, {"ok": True})
                 elif c == "ping":
                     write_frame(writer, {"ok": True})
+                elif c == "punch":
+                    # `from` is OUR authenticated ident, never claimed;
+                    # the addr is the one the relay WITNESSED for the
+                    # carried observe token — senders cannot point
+                    # probes at third parties
+                    addr = self._witnessed(req.get("token"))
+                    target_w = self._listeners.get(req.get("target"))
+                    if target_w is None or addr is None:
+                        write_frame(writer, {
+                            "event": "punch_addr",
+                            "conn": req.get("conn"), "ok": False,
+                            "error": "target not registered"
+                                     if addr else "unknown observe token",
+                        })
+                    else:
+                        # a dead TARGET channel must not tear down THIS
+                        # (innocent) sender's registration
+                        try:
+                            write_frame(target_w, {
+                                "event": "punch", "conn": req.get("conn"),
+                                "from": ident, "addr": list(addr),
+                            })
+                            await target_w.drain()
+                        except (ConnectionError, OSError):
+                            write_frame(writer, {
+                                "event": "punch_addr",
+                                "conn": req.get("conn"), "ok": False,
+                                "error": "target unreachable",
+                            })
+                elif c == "punch_ack":
+                    # stateless reply routing: back to the dialer named
+                    # in `target` (only registered identities reach here)
+                    addr = self._witnessed(req.get("token"))
+                    dialer_w = self._listeners.get(req.get("target"))
+                    if dialer_w is not None and addr is not None:
+                        try:
+                            write_frame(dialer_w, {
+                                "event": "punch_addr",
+                                "conn": req.get("conn"), "ok": True,
+                                "addr": list(addr),
+                            })
+                            await dialer_w.drain()
+                        except (ConnectionError, OSError):
+                            pass  # dialer died; punch simply won't happen
                 await writer.drain()
         finally:
             if self._listeners.get(ident) is writer:
@@ -314,6 +412,15 @@ class RelayServer:
             writer.close()
         # on success the accept side owns the splice (and releases the
         # reservation when it ends); nothing more here
+
+    def _witnessed(self, token: Any) -> tuple[str, int] | None:
+        """Address this relay saw for an observe token (fresh only)."""
+        if not isinstance(token, str):
+            return None
+        entry = self._observed.pop(token, None)
+        if entry is None or time.monotonic() - entry[1] > 60.0:
+            return None
+        return entry[0]
 
     def _reserve(self, target: str) -> None:
         self._reserved_total += 1
@@ -371,7 +478,9 @@ class RelayClient:
 
     def __init__(self, p2p: Any, relay_addr: tuple[str, int],
                  on_stream: Callable[[EncryptedStream], Awaitable[None]],
-                 query_interval: float = 5.0):
+                 query_interval: float = 5.0,
+                 udp_factory: Callable[[], Any] | None = None,
+                 punch: bool = True):
         self.p2p = p2p
         self.addr = relay_addr
         self.identity: Identity = p2p.identity
@@ -382,6 +491,13 @@ class RelayClient:
         self._task: asyncio.Task | None = None
         self._accepts: set[asyncio.Task] = set()  # keep strong refs
         self._stopped = asyncio.Event()
+        # hole punching (punch.py); udp_factory is the NAT-simulation
+        # seam — tests hand in translating endpoints
+        self._punch_enabled = punch
+        self._udp_factory = udp_factory
+        self._relay_udp: tuple[str, int] | None = None
+        self._ctrl: asyncio.StreamWriter | None = None
+        self._punch_waits: dict[str, asyncio.Future] = {}
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -440,18 +556,30 @@ class RelayClient:
             resp = await asyncio.wait_for(read_frame(reader), 30)
             if not resp.get("ok"):
                 raise ConnectionError(f"relay auth failed: {resp}")
+            if resp.get("udp_port"):
+                self._relay_udp = (self.addr[0], int(resp["udp_port"]))
+            self._ctrl = writer
 
             # dedicated read loop: incoming dials are answered the
             # moment the relay announces them, never a poll-cycle later
             async def reads():
                 while True:
                     msg = await read_frame(reader)
-                    if msg.get("event") == "incoming":
+                    event = msg.get("event")
+                    if event == "incoming":
                         task = asyncio.create_task(self._accept(msg["conn"]))
                         self._accepts.add(task)
                         task.add_done_callback(self._accepts.discard)
-                    elif msg.get("event") == "peers":
+                    elif event == "peers":
                         self._ingest_peers(msg.get("peers", []))
+                    elif event == "punch":
+                        task = asyncio.create_task(self._punch_accept(msg))
+                        self._accepts.add(task)
+                        task.add_done_callback(self._accepts.discard)
+                    elif event == "punch_addr":
+                        fut = self._punch_waits.pop(msg.get("conn", ""), None)
+                        if fut is not None and not fut.done():
+                            fut.set_result(msg)
                     # {"ok":true} replies to refreshes need no action
 
             read_task = asyncio.create_task(reads())
@@ -480,6 +608,11 @@ class RelayClient:
                 except (asyncio.CancelledError, Exception):
                     pass
         finally:
+            self._ctrl = None
+            for fut in self._punch_waits.values():
+                if not fut.done():
+                    fut.cancel()
+            self._punch_waits.clear()
             writer.close()
 
     def _ingest_peers(self, peers: list[dict[str, Any]]) -> None:
@@ -534,6 +667,20 @@ class RelayClient:
 
     async def dial(self, identity: RemoteIdentity,
                    timeout: float = DIAL_TIMEOUT) -> EncryptedStream:
+        """Open a stream to `identity`: try a punched DIRECT UDP path
+        first (every byte then bypasses the relay), fall back to the
+        relayed TCP pipe — the reference's DCUtR-then-relay order
+        (ref:quic/transport.rs:212,344)."""
+        if self._punch_enabled and self._relay_udp and self._ctrl:
+            try:
+                return await self.punch_dial(identity, timeout=timeout)
+            except Exception as e:  # noqa: BLE001 - any punch failure → relay
+                logger.debug("punch to %s failed (%s); using relay",
+                             identity, e)
+        return await self.relay_dial_tcp(identity, timeout=timeout)
+
+    async def relay_dial_tcp(self, identity: RemoteIdentity,
+                             timeout: float = DIAL_TIMEOUT) -> EncryptedStream:
         """Open a relayed stream to `identity` (CLIENT handshake through
         the spliced pipe)."""
         reader, writer = await asyncio.open_connection(*self.addr)
@@ -550,3 +697,95 @@ class RelayClient:
         except BaseException:
             writer.close()
             raise
+
+    # --- hole punching (punch.py + udpstream.py) ------------------------
+
+    def _make_udp(self):
+        if self._udp_factory is not None:
+            return self._udp_factory()
+        from .udp import UdpEndpoint
+
+        return UdpEndpoint()
+
+    async def punch_dial(self, identity: RemoteIdentity,
+                         timeout: float = DIAL_TIMEOUT) -> EncryptedStream:
+        """Direct path: observe → exchange via control channel →
+        simultaneous open → Noise XX over the reliable UDP stream."""
+        from . import punch
+        from .udpstream import UdpStream
+
+        ctrl = self._ctrl
+        if ctrl is None or self._relay_udp is None:
+            raise punch.PunchError("no relay control channel")
+        ep = self._make_udp()
+        try:
+            await ep.bind()
+            _my_addr, token = await punch.observe(ep, self._relay_udp)
+            conn = secrets.token_hex(8)
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._punch_waits[conn] = fut
+            try:
+                write_frame(ctrl, {
+                    "cmd": "punch", "conn": conn,
+                    "target": str(identity), "token": token,
+                })
+                await ctrl.drain()
+                answer = await asyncio.wait_for(fut, punch.PUNCH_TIMEOUT + 2)
+            except asyncio.CancelledError:
+                if fut.cancelled():
+                    # the control channel dropped and cancelled our wait
+                    # — a punch failure, not a caller cancellation
+                    raise punch.PunchError("control channel lost") from None
+                raise
+            finally:
+                self._punch_waits.pop(conn, None)
+            if not answer.get("ok") or not answer.get("addr"):
+                raise punch.PunchError(
+                    f"peer unreachable for punch: {answer.get('error')}")
+            peer_addr = (answer["addr"][0], int(answer["addr"][1]))
+            await punch.simultaneous_open(ep, peer_addr)
+            stream = UdpStream(ep, peer_addr)
+            es = await asyncio.wait_for(
+                _client_handshake(stream.reader, stream, self.identity,
+                                  identity),
+                timeout,
+            )
+            es.direct = True  # diagnosable path selection
+            return es
+        except BaseException:
+            ep.close()
+            raise
+
+    async def _punch_accept(self, msg: dict[str, Any]) -> None:
+        """Answer a punch request: observe, return our address, open
+        simultaneously, then run the SERVER side of Noise over UDP."""
+        from . import punch
+        from .udpstream import UdpStream
+
+        ctrl = self._ctrl
+        if ctrl is None or self._relay_udp is None:
+            return
+        ep = self._make_udp()
+        try:
+            await ep.bind()
+            _my_addr, token = await punch.observe(ep, self._relay_udp)
+            write_frame(ctrl, {
+                "cmd": "punch_ack", "conn": msg.get("conn"),
+                "target": msg.get("from"), "token": token,
+            })
+            await ctrl.drain()
+            peer_addr = (msg["addr"][0], int(msg["addr"][1]))
+            await punch.simultaneous_open(ep, peer_addr)
+            stream = UdpStream(ep, peer_addr)
+            es = await asyncio.wait_for(
+                _server_handshake(stream.reader, stream, self.identity),
+                DIAL_TIMEOUT,
+            )
+        except Exception as e:  # noqa: BLE001 - inbound is best-effort
+            logger.debug("punch accept failed: %s", e)
+            ep.close()
+            return
+        try:
+            await self._on_stream(es)
+        finally:
+            await es.close()

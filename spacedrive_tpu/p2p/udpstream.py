@@ -1,0 +1,244 @@
+"""Reliable ordered byte stream over UDP — the punched-path transport.
+
+Parity: the reference's direct WAN paths are QUIC streams over punched
+UDP (ref:crates/p2p2/src/quic/transport.rs:212,344). A full QUIC is
+out of scope; this is the minimal ARQ that gives the Noise channel the
+ordered reliable bytes it needs:
+
+- segments of ≤``MSS`` bytes, 9-byte header ``!BII``
+  (type, seq, ack) — DATA / ACK / FIN;
+- sliding window (``WINDOW`` segments), cumulative ACKs, earliest-
+  unacked retransmission with exponential backoff, give-up after
+  ``MAX_RETRIES`` (the punched path then falls back to the relay);
+- in-order reassembly into an ``asyncio.StreamReader`` + a writer
+  facade, so `transport._client_handshake`/`_server_handshake` and
+  `EncryptedStream` run over a punched UDP path UNCHANGED — same
+  Noise XX, same identity binding, same record framing, just a
+  different byte carrier (docs/security.md's argument carries over).
+
+The security posture does not rest on this layer: every byte above it
+is AEAD-protected and an attacker who forges/reorders segments can only
+cause decrypt failures (= connection teardown), same as TCP injection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from collections import deque
+from typing import Any
+
+from .udp import UdpEndpoint
+
+_HDR = struct.Struct("!BII")
+DATA, ACK, FIN = 1, 2, 3
+MSS = 1150          # fits the 1280-byte IPv6 minimum MTU with headroom
+WINDOW = 128        # segments in flight (~144 KiB)
+RTO_INITIAL = 0.25
+RTO_MAX = 2.0
+MAX_RETRIES = 8
+RETX_BURST = 32     # unacked segments re-sent per timeout
+_REORDER_CAP = 4 * WINDOW  # out-of-order buffer bound (hostile peers)
+
+
+class UdpStreamError(ConnectionError):
+    pass
+
+
+class UdpStream:
+    """One reliable bidirectional stream bound to (endpoint, remote).
+
+    Exposes ``reader`` (a real asyncio.StreamReader) and itself as the
+    writer facade (``write``/``drain``/``close``/``wait_closed``/
+    ``get_extra_info``) — the exact surface the Noise transport uses.
+    """
+
+    def __init__(self, endpoint: UdpEndpoint, remote: tuple[str, int],
+                 *, owns_endpoint: bool = True):
+        self._ep = endpoint
+        self.remote = tuple(remote)
+        self._owns = owns_endpoint
+        self.reader = asyncio.StreamReader()
+        # sender state
+        self._next_seq = 0
+        self._unacked: dict[int, bytes] = {}  # seq → raw datagram
+        self._send_base = 0
+        self._window_free = asyncio.Event()
+        self._window_free.set()
+        self._retries = 0
+        self._dup_acks = 0
+        self._rto = RTO_INITIAL
+        self._timer: asyncio.TimerHandle | None = None
+        # receiver state
+        self._recv_next = 0
+        self._reorder: dict[int, tuple[int, bytes]] = {}  # seq → (type, payload)
+        self._fin_sent = False
+        self._fin_acked = asyncio.Event()
+        self._closed = False
+        self._pending_writes: deque[bytes] = deque()
+        self._sender_task: asyncio.Task | None = None
+        self._loop = asyncio.get_running_loop()
+        endpoint.set_receiver(self._on_datagram)
+
+    # --- datagram ingress ---------------------------------------------
+
+    def _on_datagram(self, data: bytes, addr: tuple[str, int]) -> None:
+        if tuple(addr) != self.remote or len(data) < _HDR.size:
+            return  # stray traffic on the punched socket
+        typ, seq, ack = _HDR.unpack_from(data)
+        payload = data[_HDR.size:]
+        if typ == ACK:
+            self._on_ack(ack)
+            return
+        if typ not in (DATA, FIN):
+            return
+        if seq >= self._recv_next and len(self._reorder) < _REORDER_CAP:
+            self._reorder.setdefault(seq, (typ, payload))
+            while self._recv_next in self._reorder:
+                t, p = self._reorder.pop(self._recv_next)
+                self._recv_next += 1
+                if t == FIN:
+                    self.reader.feed_eof()
+                elif p:
+                    self.reader.feed_data(p)
+        # cumulative ack (also for duplicates — the ack may have been lost)
+        self._ep.sendto(_HDR.pack(ACK, 0, self._recv_next), self.remote)
+
+    def _on_ack(self, ack: int) -> None:
+        advanced = False
+        for seq in list(self._unacked):
+            if seq < ack:
+                del self._unacked[seq]
+                advanced = True
+        if advanced:
+            self._send_base = ack
+            self._retries = 0
+            self._dup_acks = 0
+            self._rto = RTO_INITIAL
+            if len(self._unacked) < WINDOW:
+                self._window_free.set()
+            self._rearm_timer()
+        elif ack == self._send_base and self._unacked:
+            # duplicate cumulative ack: the hole at send_base was lost —
+            # fast-retransmit it without waiting out the RTO
+            self._dup_acks += 1
+            if self._dup_acks >= 3:
+                self._dup_acks = 0
+                self._ep.sendto(self._unacked[min(self._unacked)], self.remote)
+        if self._fin_sent and not self._unacked:
+            self._fin_acked.set()
+
+    # --- sender --------------------------------------------------------
+
+    def _transmit(self, typ: int, payload: bytes) -> None:
+        seq = self._next_seq
+        self._next_seq += 1
+        dgram = _HDR.pack(typ, seq, 0) + payload
+        self._unacked[seq] = dgram
+        if len(self._unacked) >= WINDOW:
+            self._window_free.clear()
+        self._ep.sendto(dgram, self.remote)
+        self._rearm_timer()
+
+    def _rearm_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if self._unacked and not self._closed:
+            self._timer = self._loop.call_later(self._rto, self._on_timeout)
+
+    def _on_timeout(self) -> None:
+        self._timer = None
+        if not self._unacked or self._closed:
+            return
+        self._retries += 1
+        if self._retries > MAX_RETRIES:
+            self._fail(UdpStreamError("udp stream: peer unreachable"))
+            return
+        self._rto = min(self._rto * 2, RTO_MAX)
+        # go-back-N: re-send a burst from the earliest hole — with lossy
+        # links (acks drop too) repairing one segment per RTO crawls
+        for seq in sorted(self._unacked)[:RETX_BURST]:
+            self._ep.sendto(self._unacked[seq], self.remote)
+        self._rearm_timer()
+
+    def _fail(self, exc: Exception) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.reader.set_exception(exc)
+        self._fin_acked.set()
+        # unblock anything parked on a full window (drain/_drain_pending/
+        # _graceful_close) — their loops re-check _closed and bail
+        self._window_free.set()
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if self._owns:
+            self._ep.close()
+
+    # --- writer facade (what transport.py expects) ---------------------
+
+    def write(self, data: bytes) -> None:
+        if self._closed or self._fin_sent:
+            raise UdpStreamError("udp stream closed")
+        view = memoryview(bytes(data))
+        for off in range(0, max(len(view), 1), MSS):
+            self._pending_writes.append(bytes(view[off:off + MSS]))
+        self._kick_sender()
+
+    def _kick_sender(self) -> None:
+        if self._sender_task is None or self._sender_task.done():
+            self._sender_task = self._loop.create_task(self._drain_pending())
+
+    async def _drain_pending(self) -> None:
+        while self._pending_writes and not self._closed:
+            await self._window_free.wait()
+            if self._closed:
+                return
+            if self._pending_writes:
+                self._transmit(DATA, self._pending_writes.popleft())
+
+    async def drain(self) -> None:
+        while self._pending_writes and not self._closed:
+            await asyncio.sleep(0)
+            await self._window_free.wait()
+        if self._closed and not self._fin_sent:
+            raise UdpStreamError("udp stream closed")
+
+    def close(self) -> None:
+        if self._closed or self._fin_sent:
+            return
+        self._fin_sent = True
+        self._loop.create_task(self._graceful_close())
+
+    async def _graceful_close(self) -> None:
+        try:
+            # flush queued writes, then a reliable FIN
+            while self._pending_writes and not self._closed:
+                await self._window_free.wait()
+                if self._pending_writes:
+                    self._transmit(DATA, self._pending_writes.popleft())
+            self._transmit(FIN, b"")
+            await asyncio.wait_for(self._fin_acked.wait(), 5.0)
+        except (asyncio.TimeoutError, Exception):
+            pass
+        finally:
+            self._closed = True
+            self._fin_acked.set()  # give-up still unblocks wait_closed()
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            if self._owns:
+                self._ep.close()
+
+    async def wait_closed(self) -> None:
+        if self._fin_sent:
+            await self._fin_acked.wait()
+
+    def get_extra_info(self, name: str, default: Any = None) -> Any:
+        if name == "peername":
+            return self.remote
+        if name == "sockname":
+            return self._ep.local_addr
+        return default

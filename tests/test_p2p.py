@@ -786,6 +786,8 @@ def test_spacedrop_over_wan_relay(tmp_path):
                 rc = RelayClient(
                     n.p2p.p2p, ("127.0.0.1", relay.p2p_port),
                     n.p2p.p2p._on_stream, query_interval=0.1,
+                    punch=False,  # this test pins the SPLICED-PIPE path;
+                    # punched direct paths are covered in test_punch.py
                 )
                 await rc.start()
                 clients.append(rc)

@@ -1,0 +1,273 @@
+"""NAT hole punching: reliable UDP stream, observe/exchange/open, and
+the punch-or-relay fallback — against SIMULATED NATs (real translating
+loopback sockets).
+
+Parity: ref:crates/p2p2/src/quic/transport.rs:212,344 — the reference's
+DCUtR-over-relay direct paths with relayed fallback. The NAT models:
+
+- **cone** (address-restricted): ONE public mapping per inside socket;
+  inbound allowed only from addresses the inside host has sent to.
+  Punchable: the relay observes the same mapping the peer will use.
+- **symmetric**: a DIFFERENT public mapping per destination; the
+  relay-observed address is useless to the peer, so punching must fail
+  and the dial must fall back to the relayed TCP pipe.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from spacedrive_tpu.p2p import punch
+from spacedrive_tpu.p2p.identity import Identity
+from spacedrive_tpu.p2p.p2p import P2P
+from spacedrive_tpu.p2p.relay import RelayClient, RelayServer
+from spacedrive_tpu.p2p.udp import UdpEndpoint
+from spacedrive_tpu.p2p.udpstream import UdpStream
+
+
+class NattedEndpoint:
+    """UdpEndpoint lookalike living behind a simulated NAT.
+
+    The 'inside' host is in-process; the NAT's PUBLIC side is a real
+    loopback socket (one for cone, one per destination for symmetric),
+    so every datagram the protocol sends really crosses a translated
+    socket with inbound filtering.
+    """
+
+    def __init__(self, kind: str = "cone", pool: int = 4):
+        assert kind in ("cone", "symmetric")
+        self.kind = kind
+        self._pool_size = pool
+        self._pubs: list[UdpEndpoint] = []       # symmetric: mapping pool
+        self._by_dest: dict[tuple, UdpEndpoint] = {}
+        self._allowed: dict[int, set[tuple]] = {}  # id(pub) → peers sent-to
+        self._receiver = None
+        self.local_addr = ("10.77.0.2", 40000)   # fake private address
+
+    async def bind(self, host: str = "0.0.0.0", port: int = 0):
+        n = 1 if self.kind == "cone" else self._pool_size
+        for _ in range(n):
+            pub = UdpEndpoint()
+            await pub.bind("127.0.0.1", 0)
+            self._allowed[id(pub)] = set()
+            pub.set_receiver(self._filtered(pub))
+            self._pubs.append(pub)
+        return self.local_addr
+
+    def _filtered(self, pub: UdpEndpoint):
+        def on_dgram(data: bytes, addr: tuple):
+            # restricted NAT: inbound only from peers this mapping
+            # has already sent to
+            if tuple(addr) not in self._allowed[id(pub)]:
+                return
+            if self._receiver is not None:
+                self._receiver(data, addr)
+        return on_dgram
+
+    def _mapping_for(self, addr: tuple) -> UdpEndpoint:
+        if self.kind == "cone":
+            return self._pubs[0]
+        pub = self._by_dest.get(addr)
+        if pub is None:
+            pub = self._pubs[len(self._by_dest) % len(self._pubs)]
+            self._by_dest[addr] = pub
+        return pub
+
+    def set_receiver(self, receiver):
+        self._receiver = receiver
+
+    def sendto(self, data: bytes, addr: tuple):
+        addr = tuple(addr)
+        pub = self._mapping_for(addr)
+        self._allowed[id(pub)].add(addr)
+        pub.sendto(data, addr)
+
+    def close(self):
+        for pub in self._pubs:
+            pub.close()
+        self._pubs.clear()
+
+
+# --- reliable UDP stream --------------------------------------------------
+
+
+class LossyEndpoint(UdpEndpoint):
+    """Deterministically drops every Nth datagram in each direction —
+    retransmission must recover the stream bit-for-bit."""
+
+    def __init__(self, drop_every: int = 5):
+        super().__init__()
+        self._n = 0
+        self._drop_every = drop_every
+
+    def sendto(self, data, addr):
+        self._n += 1
+        if self._n % self._drop_every == 0:
+            return  # eaten by the network
+        super().sendto(data, addr)
+
+
+def test_udpstream_reliable_under_loss():
+    async def run():
+        a, b = LossyEndpoint(5), LossyEndpoint(4)
+        addr_a = await a.bind("127.0.0.1")
+        addr_b = await b.bind("127.0.0.1")
+        sa = UdpStream(a, addr_b)
+        sb = UdpStream(b, addr_a)
+        payload = os.urandom(300_000)  # ~260 segments each way
+        sa.write(payload)
+        await sa.drain()
+        sb.write(payload[::-1])
+        await sb.drain()
+        got_b = await asyncio.wait_for(sb.reader.readexactly(len(payload)), 30)
+        got_a = await asyncio.wait_for(sa.reader.readexactly(len(payload)), 30)
+        assert got_b == payload
+        assert got_a == payload[::-1]
+        sa.close()
+        sb.close()
+        await sa.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_udpstream_fin_delivers_eof():
+    async def run():
+        a, b = UdpEndpoint(), UdpEndpoint()
+        addr_a = await a.bind("127.0.0.1")
+        addr_b = await b.bind("127.0.0.1")
+        sa, sb = UdpStream(a, addr_b), UdpStream(b, addr_a)
+        sa.write(b"tail")
+        sa.close()
+        assert await asyncio.wait_for(sb.reader.read(), 10) == b"tail"
+        sb.close()
+
+    asyncio.run(run())
+
+
+# --- observe (STUN role) --------------------------------------------------
+
+
+def test_observe_reports_nat_mapping():
+    async def run():
+        srv = RelayServer()
+        await srv.start()
+        nat = NattedEndpoint("cone")
+        await nat.bind()
+        try:
+            addr, token = await punch.observe(nat, ("127.0.0.1", srv.udp_port))
+            # the relay must see the NAT's PUBLIC mapping, not the
+            # (fake) private address
+            assert addr == nat._pubs[0].local_addr
+            assert addr != nat.local_addr
+            # and it remembers the witnessed mapping under the token,
+            # consumable exactly once (punch routing relies on this)
+            assert srv._witnessed(token) == addr
+            assert srv._witnessed(token) is None
+        finally:
+            nat.close()
+            await srv.shutdown()
+
+    asyncio.run(run())
+
+
+# --- end-to-end punch + fallback -----------------------------------------
+
+
+async def _relay_pair(nat_kind_a, nat_kind_b):
+    """Two P2P nodes registered on one relay, each behind its own NAT."""
+    srv = RelayServer()
+    port = await srv.start()
+    a, b = P2P("sdx"), P2P("sdx")
+    echoed = asyncio.Event()
+
+    async def on_stream(stream):
+        data = await stream.read_exact(7)
+        await stream.write(data[::-1])
+        echoed.set()
+
+    ra = RelayClient(a, ("127.0.0.1", port), on_stream, query_interval=0.1,
+                     udp_factory=lambda: NattedEndpoint(nat_kind_a))
+    rb = RelayClient(b, ("127.0.0.1", port), on_stream, query_interval=0.1,
+                     udp_factory=lambda: NattedEndpoint(nat_kind_b))
+    await ra.start()
+    await rb.start()
+    for _ in range(100):
+        if ra._ctrl is not None and rb._ctrl is not None and \
+                ra._relay_udp and rb._relay_udp:
+            break
+        await asyncio.sleep(0.05)
+    return srv, a, b, ra, rb, echoed
+
+
+def test_punch_direct_path_between_cone_nats():
+    """Both peers behind address-restricted cone NATs: the dial must
+    come out DIRECT (no relay pipe, zero relayed bytes) and still be
+    the same authenticated Noise channel."""
+
+    async def run():
+        srv, a, b, ra, rb, echoed = await _relay_pair("cone", "cone")
+        try:
+            stream = await ra.dial(b.identity.to_remote_identity(), timeout=20)
+            assert getattr(stream, "direct", False) is True
+            assert stream.remote_identity == b.identity.to_remote_identity()
+            await stream.write(b"punched")
+            assert await asyncio.wait_for(stream.read_exact(7), 10) \
+                == b"dehcnup"
+            await asyncio.wait_for(echoed.wait(), 5)
+            # the relay never spliced a pipe and never moved a byte
+            assert srv.stats.pipes_opened == 0
+            assert srv.stats.bytes_relayed == 0
+            await stream.close()
+        finally:
+            await ra.shutdown()
+            await rb.shutdown()
+            await srv.shutdown()
+
+    asyncio.run(run())
+
+
+def test_punch_falls_back_to_relay_on_symmetric_nat():
+    """A symmetric NAT on one side defeats punching (per-destination
+    mappings): the SAME dial call must succeed anyway via the relayed
+    TCP pipe."""
+
+    async def run():
+        srv, a, b, ra, rb, echoed = await _relay_pair("cone", "symmetric")
+        try:
+            stream = await ra.dial(b.identity.to_remote_identity(), timeout=20)
+            assert not getattr(stream, "direct", False)
+            assert stream.remote_identity == b.identity.to_remote_identity()
+            await stream.write(b"relayed")
+            assert await asyncio.wait_for(stream.read_exact(7), 10) \
+                == b"deyaler"
+            await asyncio.wait_for(echoed.wait(), 5)
+            assert srv.stats.pipes_opened == 1  # the fallback pipe
+            assert srv.stats.bytes_relayed > 0
+            await stream.close()
+        finally:
+            await ra.shutdown()
+            await rb.shutdown()
+            await srv.shutdown()
+
+    asyncio.run(run())
+
+
+def test_punch_disabled_uses_relay():
+    async def run():
+        srv, a, b, ra, rb, echoed = await _relay_pair("cone", "cone")
+        ra._punch_enabled = False
+        try:
+            stream = await ra.dial(b.identity.to_remote_identity(), timeout=20)
+            assert not getattr(stream, "direct", False)
+            await stream.write(b"noshort")
+            assert await asyncio.wait_for(stream.read_exact(7), 10) \
+                == b"trohson"
+            assert srv.stats.pipes_opened == 1
+            await stream.close()
+        finally:
+            await ra.shutdown()
+            await rb.shutdown()
+            await srv.shutdown()
+
+    asyncio.run(run())
