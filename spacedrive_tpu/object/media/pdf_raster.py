@@ -8,11 +8,15 @@ cairo's toy font API with the PDF text matrix, image/form XObjects
 placed through the CTM (the unit-square mapping), drawn onto a cairo
 ARGB32 surface through ctypes (the binding style of svg.py).
 
-Deliberate scope (thumbnails, not print fidelity): no embedded-font
-glyph rendering (standard faces via cairo_select_font_face), no
-shading/pattern color spaces (skipped), no blend modes or soft masks.
-Unsupported constructs degrade to "skip that operator", never to an
-exception — the caller falls back to the image/text strategies.
+Text renders with the EMBEDDED font program when the PDF carries one
+(FontFile/FontFile2/FontFile3 via freetype + cairo_show_glyphs —
+pdf_fonts.py; the common case for real documents, which subset-embed
+their faces), falling back to cairo toy faces otherwise.
+
+Deliberate scope (thumbnails, not print fidelity): no shading/pattern
+color spaces (skipped), no blend modes or soft masks. Unsupported
+constructs degrade to "skip that operator", never to an exception —
+the caller falls back to the image/text strategies.
 """
 
 from __future__ import annotations
@@ -180,6 +184,9 @@ class _Raster:
         self.leading = 0.0
         self.font_size = 12.0
         self.font_face = (b"sans-serif", _FONT_SLANT_NORMAL, _FONT_WEIGHT_NORMAL)
+        self.embedded = None        # EmbeddedFont for the current Tf
+        self.embedded_glyphs = 0    # glyphs drawn from embedded programs
+        self._font_cache: dict[str, Any] = {}  # Tf alias → EmbeddedFont|None
 
     # --- path + paint ---------------------------------------------------
 
@@ -215,10 +222,64 @@ class _Raster:
     # --- text -----------------------------------------------------------
 
     def _show_text(self, raw: bytes) -> None:
-        from .pdf import _printable
-
         if self.tm is None:
             return
+        if self.embedded is not None and self._show_embedded(raw):
+            return
+        self._show_toy(raw)
+
+    def _show_embedded(self, raw: bytes) -> bool:
+        """Draw a show op with the embedded font program's real glyphs;
+        returns False (→ toy fallback) when nothing maps."""
+        from .pdf_fonts import CairoGlyph
+
+        font = self.embedded
+        codes = font.codes(raw)
+        pairs = [(code, font.gid(code)) for code in codes]
+        if not any(gid for _c, gid in pairs):
+            return False  # font maps nothing here → toy fallback
+        c, cr = self.c, self.cr
+        m = _mat_mul(self.tm, self.gs.ctm)
+        x, y = _mat_apply(m, 0, 0)
+        scale = _mat_scale(m)
+        size = self.font_size * scale
+        if size < 1.0 or size > 2000:
+            return True  # suppressed, like the toy path's size guard
+        c.cairo_set_font_face(cr, font.cairo_face)
+        c.cairo_set_font_size(cr, size)
+        c.cairo_set_source_rgb(cr, *self.gs.fill)
+        glyphs = (CairoGlyph * len(pairs))()
+        n = 0
+        adv_text = 0.0  # text-space units for the tm update
+        for code, gid in pairs:
+            # gid 0 (e.g. subset fonts whose space has no outline)
+            # draws nothing but MUST still advance, or words collapse
+            probe = CairoGlyph(gid, x, y)
+            if gid:
+                glyphs[n] = probe
+                n += 1
+            w = font.width(code)
+            if w:
+                step = w / 1000.0 * self.font_size  # text space
+            elif gid:
+                ext = _TextExtents()
+                c.cairo_glyph_extents(cr, ctypes.byref(probe), 1,
+                                      ctypes.byref(ext))
+                step = ext.x_advance / max(scale, 1e-6)
+            else:
+                step = font.default_width / 1000.0 * self.font_size
+            adv_text += step
+            x += step * scale  # device-space horizontal advance
+        c.cairo_show_glyphs(cr, glyphs, n)
+        c.cairo_new_path(cr)
+        self.painted += 1
+        self.embedded_glyphs += n
+        self.tm = _mat_mul((1, 0, 0, 1, adv_text, 0), self.tm)
+        return True
+
+    def _show_toy(self, raw: bytes) -> None:
+        from .pdf import _printable
+
         txt = _printable(raw).strip("\x00")
         if not txt:
             return
@@ -251,11 +312,20 @@ class _Raster:
         # Tf's operand is the resource alias (/F1); the styling lives in
         # the font dict's BaseFont (e.g. Times-BoldItalic)
         base = str(name or "")
+        self.embedded = None
         try:
             fonts = self.doc.resolve(resources.get("Font")) or {}
             fdict = self.doc.resolve(fonts.get(str(name)))
             if isinstance(fdict, dict):
                 base = str(self.doc.resolve(fdict.get("BaseFont", base)))
+                # prefer the embedded program (cached per Tf alias +
+                # BaseFont — stable for a given page's resources)
+                from .pdf_fonts import load_embedded_font
+
+                key = f"{name}/{base}"
+                if key not in self._font_cache:
+                    self._font_cache[key] = load_embedded_font(self.doc, fdict)
+                self.embedded = self._font_cache[key]
         except Exception:
             pass
         base = base.lower()
@@ -545,9 +615,11 @@ def _num(v, default: float = 0.0) -> float:
         return default
 
 
-def rasterize_page(doc, page: dict, max_dim: int) -> np.ndarray | None:
+def rasterize_page(doc, page: dict, max_dim: int,
+                   stats: dict | None = None) -> np.ndarray | None:
     """Render page 1's content stream; None when cairo is missing, the
-    page has no content, or nothing got painted."""
+    page has no content, or nothing got painted. `stats`, when given,
+    receives interpreter counters (painted ops, embedded glyphs drawn)."""
     from .pdf import Stream, _apply_filters
 
     c = _cairo()
@@ -586,6 +658,7 @@ def rasterize_page(doc, page: dict, max_dim: int) -> np.ndarray | None:
         c.cairo_destroy(cr)
         c.cairo_surface_destroy(surface)
         return None
+    r = None
     try:
         # white page background
         c.cairo_set_source_rgb(cr, 1.0, 1.0, 1.0)
@@ -595,6 +668,9 @@ def rasterize_page(doc, page: dict, max_dim: int) -> np.ndarray | None:
         r = _Raster(doc, cr, base)
         res = doc.resolve(page.get("Resources")) or {}
         r.run(data, res)
+        if stats is not None:
+            stats["painted"] = r.painted
+            stats["embedded_glyphs"] = r.embedded_glyphs
         if r.painted == 0:
             return None
         c.cairo_surface_flush(surface)
@@ -605,6 +681,11 @@ def rasterize_page(doc, page: dict, max_dim: int) -> np.ndarray | None:
     finally:
         c.cairo_destroy(cr)
         c.cairo_surface_destroy(surface)
+        # native font faces AFTER the context that references them
+        if r is not None:
+            for font in r._font_cache.values():
+                if font is not None:
+                    font.release()
     # premultiplied native-endian ARGB → RGB over white
     b, g, rr, a = (px[..., i].astype(np.uint16) for i in range(4))
     inv = (255 - a)

@@ -672,11 +672,20 @@ class RelayClient:
         relayed TCP pipe — the reference's DCUtR-then-relay order
         (ref:quic/transport.rs:212,344)."""
         if self._punch_enabled and self._relay_udp and self._ctrl:
+            # the punch attempt (observe/exchange/open/handshake) runs
+            # under the caller's deadline, and the fallback gets only
+            # what remains (floored so it always has a fighting chance)
+            start = asyncio.get_running_loop().time()
             try:
-                return await self.punch_dial(identity, timeout=timeout)
+                return await asyncio.wait_for(
+                    self.punch_dial(identity, timeout=timeout), timeout
+                )
             except Exception as e:  # noqa: BLE001 - any punch failure → relay
                 logger.debug("punch to %s failed (%s); using relay",
                              identity, e)
+            timeout = max(
+                3.0, timeout - (asyncio.get_running_loop().time() - start)
+            )
         return await self.relay_dial_tcp(identity, timeout=timeout)
 
     async def relay_dial_tcp(self, identity: RemoteIdentity,

@@ -1,0 +1,187 @@
+"""Embedded-font PDF rendering (VERDICT r3 #9): real subset/embedded
+font programs draw real glyphs, custom /Differences encodings resolve,
+Type0/Identity-H composite fonts map CIDs to glyphs, and PDFs without
+an embedded program still fall back to toy faces.
+
+Parity: ref:crates/images/src/pdf.rs:82-83 (PDFium renders embedded
+fonts natively). Fixtures are hand-assembled PDFs embedding the
+system DejaVuSans TrueType (a real production font program).
+"""
+
+import zlib
+from pathlib import Path
+
+import pytest
+
+pytest.importorskip("numpy")
+
+DEJAVU = Path("/usr/share/fonts/truetype/dejavu/DejaVuSans.ttf")
+
+
+def _build_pdf(objs: list[bytes]) -> bytes:
+    out = bytearray(b"%PDF-1.4\n")
+    offsets = []
+    for i, o in enumerate(objs, 1):
+        offsets.append(len(out))
+        out += str(i).encode() + b" 0 obj\n" + o + b"\nendobj\n"
+    xref = len(out)
+    out += b"xref\n0 " + str(len(objs) + 1).encode() + b"\n0000000000 65535 f \n"
+    for off in offsets:
+        out += f"{off:010d} 00000 n \n".encode()
+    out += (b"trailer\n<< /Size " + str(len(objs) + 1).encode()
+            + b" /Root 1 0 R >>\nstartxref\n" + str(xref).encode()
+            + b"\n%%EOF\n")
+    return bytes(out)
+
+
+def _page_objs(content: bytes, font_obj: bytes,
+               extra: list[bytes] | None = None) -> list[bytes]:
+    stream = zlib.compress(content)
+    return [
+        b"<< /Type /Catalog /Pages 2 0 R >>",
+        b"<< /Type /Pages /Kids [3 0 R] /Count 1 >>",
+        b"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 400 200] "
+        b"/Contents 4 0 R /Resources << /Font << /F1 5 0 R >> >> >>",
+        b"<< /Length " + str(len(stream)).encode()
+        + b" /Filter /FlateDecode >>\nstream\n" + stream + b"\nendstream",
+        font_obj,
+        *(extra or []),
+    ]
+
+
+def _font_stream_obj(data: bytes) -> bytes:
+    z = zlib.compress(data)
+    return (b"<< /Length " + str(len(z)).encode()
+            + b" /Length1 " + str(len(data)).encode()
+            + b" /Filter /FlateDecode >>\nstream\n" + z + b"\nendstream")
+
+
+def _render(pdf: bytes, stats: dict):
+    from spacedrive_tpu.object.media import pdf_raster
+    from spacedrive_tpu.object.media.pdf import PdfDocument
+
+    doc = PdfDocument(pdf)
+    return pdf_raster.rasterize_page(doc, doc.first_page(), 256, stats=stats)
+
+
+def _requires_raster():
+    from spacedrive_tpu.object.media.pdf_fonts import _cairo_ft, _ft
+    from spacedrive_tpu.object.media.pdf_raster import raster_available
+
+    if not raster_available():
+        pytest.skip("cairo not available")
+    if _ft() is None or _cairo_ft() is None:
+        pytest.skip("freetype not available")
+    if not DEJAVU.exists():
+        pytest.skip("DejaVuSans.ttf not installed")
+
+
+def _ink(arr, x0, x1, y0, y1):
+    """Fraction of dark pixels inside a page-space box (400×200 page)."""
+    h, w = arr.shape[:2]
+    sx, sy = w / 400.0, h / 200.0
+    # page y runs bottom-up; rows top-down
+    region = arr[int((200 - y1) * sy):int((200 - y0) * sy),
+                 int(x0 * sx):int(x1 * sx), :3]
+    return float((region < 100).any(axis=-1).mean())
+
+
+def test_embedded_truetype_differences_encoding():
+    """The content shows CONTROL bytes (\\x01\\x02\\x03) that only the
+    /Differences map resolves (to A, B, C). The toy path strips
+    non-printables and draws NOTHING — ink proves the embedded program
+    + custom encoding rendered real glyphs."""
+    _requires_raster()
+    font_data = DEJAVU.read_bytes()
+    content = (b"BT /F1 48 Tf 1 0 0 1 40 80 Tm 0 0 0 rg "
+               b"(\x01\x02\x03) Tj ET")
+    font = (b"<< /Type /Font /Subtype /TrueType /BaseFont /DejaVuSans "
+            b"/FirstChar 1 /LastChar 3 /Widths [636 636 636] "
+            b"/Encoding << /Type /Encoding /Differences [1 /A /B /C] >> "
+            b"/FontDescriptor 6 0 R >>")
+    descriptor = (b"<< /Type /FontDescriptor /FontName /DejaVuSans "
+                  b"/Flags 32 /FontFile2 7 0 R >>")
+    pdf = _build_pdf(_page_objs(
+        content, font, [descriptor, _font_stream_obj(font_data)]))
+    stats: dict = {}
+    arr = _render(pdf, stats)
+    assert arr is not None
+    assert stats["embedded_glyphs"] == 3
+    assert _ink(arr, 40, 160, 70, 120) > 0.02  # "ABC" at 48pt
+
+    # the SAME page without the embedded program draws nothing: the
+    # toy fallback cannot interpret the custom-encoded control bytes
+    font_plain = (b"<< /Type /Font /Subtype /TrueType /BaseFont /DejaVuSans "
+                  b"/FirstChar 1 /LastChar 3 /Widths [636 636 636] "
+                  b"/Encoding << /Type /Encoding /Differences [1 /A /B /C] >> "
+                  b">>")
+    stats2: dict = {}
+    arr2 = _render(_build_pdf(_page_objs(content, font_plain)), stats2)
+    assert stats2.get("embedded_glyphs", 0) == 0
+    assert arr2 is None or _ink(arr2, 40, 160, 70, 120) == 0.0
+
+
+def test_embedded_simple_ascii_text():
+    """Plain ASCII through an embedded TrueType: glyphs come from the
+    embedded program (counter proves it) and land in the text box."""
+    _requires_raster()
+    content = b"BT /F1 36 Tf 1 0 0 1 30 90 Tm 0 0 0 rg (Hello) Tj ET"
+    font = (b"<< /Type /Font /Subtype /TrueType /BaseFont /DejaVuSans "
+            b"/FirstChar 72 /LastChar 111 /FontDescriptor 6 0 R >>")
+    descriptor = (b"<< /Type /FontDescriptor /FontName /DejaVuSans "
+                  b"/Flags 32 /FontFile2 7 0 R >>")
+    pdf = _build_pdf(_page_objs(
+        content, font, [descriptor, _font_stream_obj(DEJAVU.read_bytes())]))
+    stats: dict = {}
+    arr = _render(pdf, stats)
+    assert arr is not None
+    assert stats["embedded_glyphs"] == 5
+    assert _ink(arr, 28, 180, 80, 125) > 0.03
+
+
+def test_type0_identity_h_cids():
+    """Composite font, Identity-H: 2-byte CIDs are glyph ids. Render
+    glyphs by id and verify via the counter + ink."""
+    _requires_raster()
+    from fontTools.ttLib import TTFont
+
+    tt = TTFont(str(DEJAVU))
+    order = tt.getGlyphOrder()
+    cmap = tt.getBestCmap()
+    gids = [order.index(cmap[ord(ch)]) for ch in "Hi"]
+    codes = b"".join(bytes([g >> 8, g & 0xFF]) for g in gids)
+    content = (b"BT /F1 48 Tf 1 0 0 1 40 80 Tm 0 0 0 rg <"
+               + codes.hex().encode() + b"> Tj ET")
+    font = (b"<< /Type /Font /Subtype /Type0 /BaseFont /DejaVuSans "
+            b"/Encoding /Identity-H /DescendantFonts [6 0 R] >>")
+    descendant = (b"<< /Type /Font /Subtype /CIDFontType2 "
+                  b"/BaseFont /DejaVuSans /DW 1000 "
+                  b"/CIDToGIDMap /Identity /FontDescriptor 7 0 R >>")
+    descriptor = (b"<< /Type /FontDescriptor /FontName /DejaVuSans "
+                  b"/Flags 32 /FontFile2 8 0 R >>")
+    pdf = _build_pdf(_page_objs(
+        content, font,
+        [descendant, descriptor, _font_stream_obj(DEJAVU.read_bytes())]))
+    stats: dict = {}
+    arr = _render(pdf, stats)
+    assert arr is not None
+    assert stats["embedded_glyphs"] == 2
+    assert _ink(arr, 38, 140, 70, 125) > 0.02
+
+
+def test_corrupt_font_program_falls_back_to_toy():
+    """A syntactically valid FontFile2 stream full of garbage must not
+    crash the render — the toy path still typesets the ASCII."""
+    _requires_raster()
+    content = b"BT /F1 36 Tf 1 0 0 1 30 90 Tm 0 0 0 rg (Hello) Tj ET"
+    font = (b"<< /Type /Font /Subtype /TrueType /BaseFont /DejaVuSans "
+            b"/FontDescriptor 6 0 R >>")
+    descriptor = (b"<< /Type /FontDescriptor /FontName /DejaVuSans "
+                  b"/Flags 32 /FontFile2 7 0 R >>")
+    pdf = _build_pdf(_page_objs(
+        content, font, [descriptor, _font_stream_obj(b"\x00garbage" * 100)]))
+    stats: dict = {}
+    arr = _render(pdf, stats)
+    assert arr is not None
+    assert stats["embedded_glyphs"] == 0
+    assert _ink(arr, 28, 180, 80, 125) > 0.03  # toy-rendered "Hello"
