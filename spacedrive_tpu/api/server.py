@@ -94,7 +94,7 @@ class ApiServer:
         )
 
     async def _static(self, request: web.Request) -> web.StreamResponse:
-        """Explorer assets (traversal-guarded; .js/.css only)."""
+        """Explorer assets (traversal-guarded; .js/.css/.json only)."""
         root = os.path.abspath(os.path.join(os.path.dirname(__file__), "static"))
         rel = request.match_info["path"]
         full = os.path.abspath(os.path.join(root, rel))
@@ -106,6 +106,7 @@ class ApiServer:
             ".js": "application/javascript",
             ".css": "text/css",
             ".html": "text/html; charset=utf-8",
+            ".json": "application/json",  # i18n catalogs
         }.get(os.path.splitext(full)[1])
         if ctype is None:
             raise web.HTTPNotFound()

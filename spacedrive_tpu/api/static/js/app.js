@@ -11,6 +11,7 @@ import { addLocationModal, wireSettingsPanel } from "/static/js/settings.js";
 import { showMenu, wireContextMenu } from "/static/js/contextmenu.js";
 import { showOnboarding } from "/static/js/onboarding.js";
 import { confirmDialog, initTooltips, promptDialog, toast } from "/static/js/ui.js";
+import { initI18n, t } from "/static/js/i18n.js";
 import { openPreview, previewOpen, wireQuickPreview } from "/static/js/quickpreview.js";
 import { droppable, guardTarget } from "/static/js/dnd.js";
 
@@ -75,9 +76,9 @@ function renderRoutes() {
       loadContent(true); };
     routes.appendChild(item);
   };
-  route("🏠 Overview", "overview");
-  route("★ Favorites", "favorites");
-  route("🕘 Recents", "recents");
+  route("🏠 " + t("overview"), "overview");
+  route("★ " + t("favorites"), "favorites");
+  route("🕘 " + t("recents"), "recents");
 }
 
 async function refreshNav() {
@@ -128,9 +129,9 @@ async function refreshNav() {
       loadContent(true); };
     item.oncontextmenu = async (e) => {
       e.preventDefault();
-      const ok = await confirmDialog("Delete saved search?",
-        `“${s.name || s.search}” will be removed from the sidebar.`,
-        {danger: true, actionLabel: "delete"});
+      const ok = await confirmDialog(t("delete_search_title"),
+        t("delete_search_body", {name: s.name || s.search}),
+        {danger: true, actionLabel: t("delete")});
       if (ok) {
         await client.search.saved.delete(s.id, state.lib);
         refreshNav();
@@ -141,7 +142,7 @@ async function refreshNav() {
 
   const tools = $("tools");
   tools.innerHTML = "";
-  const dup = el("div", "item", "♊ Duplicates");
+  const dup = el("div", "item", "♊ " + t("duplicates"));
   dup.onclick = () => { setActive(dup);
     Object.assign(state, {mode:"duplicates", loc:null, tag:null});
     clearSelection();
@@ -180,13 +181,13 @@ $("btn-save-search").onclick = async () => {
     clearSelection();
     loadContent(true);
   }
-  const name = await promptDialog("Save search", {
-    value: text, message: "bookmark this query in the sidebar",
-    actionLabel: "save",
+  const name = await promptDialog(t("save_search_title"), {
+    value: text, message: t("save_search_body"),
+    actionLabel: t("save"),
   });
   if (!name) return;
   await client.search.saved.create({name, search: text}, state.lib);
-  toast("search saved", {kind: "ok"});
+  toast(t("search_saved_toast"), {kind: "ok"});
   refreshNav();
 };
 $("btn-addloc").onclick = () => addLocationModal();
@@ -261,6 +262,7 @@ sock.subscribe("invalidation.listen", (ev) => {
 });
 
 // ---------- boot ----------
+await initI18n();  // catalogs before first render (top-level await)
 setView(state.view);
 loadLibraries().catch(e => {
   $("stats").textContent = "error: " + e.message;

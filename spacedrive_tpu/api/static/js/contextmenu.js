@@ -8,6 +8,7 @@ import { $, bus, el, fullPath, state } from "/static/js/util.js";
 import {
   confirmDialog, initMenus, openMenu, promptDialog, toast,
 } from "/static/js/ui.js";
+import { t } from "/static/js/i18n.js";
 
 let clipboard = null;  // {op, ids, location_id, lib} — lib-scoped:
 // file_path ids are per-library, so a stale clipboard must never
@@ -17,7 +18,7 @@ function pasteItem() {
   if (clipboard && clipboard.lib !== state.lib) clipboard = null;
   if (!clipboard || !state.loc || state.mode !== "browse") return null;
   return {
-    label: "Paste into this folder",
+    label: t("menu_paste"),
     onClick: async () => {
       const arg = {
         source_location_id: clipboard.location_id,
@@ -45,15 +46,15 @@ export function showMenu(x, y, n) {
     ? state.nodes.filter(x => state.selectedIds.has(x.id)) : [n];
   const chosen = chosenAll.filter(x => x.location_id === n.location_id);
   const many = chosen.length > 1;
-  const label = (verb) => many ? `${verb} ${chosen.length} items` : verb;
+  const label = (verb) => many ? t("menu_n_items", {verb, n: chosen.length}) : verb;
   const displayName = n.name + (n.extension ? "." + n.extension : "");
 
   openMenu(x, y, [
     {
-      label: "Rename…",
+      label: t("menu_rename"),
       onClick: async () => {
-        const name = await promptDialog("Rename", {
-          value: displayName, actionLabel: "rename",
+        const name = await promptDialog(t("rename_title"), {
+          value: displayName, actionLabel: t("rename"),
         });
         if (!name) return;
         await client.files.renameFile({id: n.id, new_name: name}, state.lib);
@@ -61,19 +62,19 @@ export function showMenu(x, y, n) {
       },
     },
     {
-      label: label("Copy"),
+      label: label(t("menu_copy")),
       onClick: () => {
         clipboard = {op: "copy", ids: chosen.map(x => x.id),
                      location_id: n.location_id, lib: state.lib};
-        toast(`copied ${chosen.length} item(s)`);
+        toast(t("copied_items", {n: chosen.length}));
       },
     },
     {
-      label: label("Cut"),
+      label: label(t("menu_cut")),
       onClick: () => {
         clipboard = {op: "cut", ids: chosen.map(x => x.id),
                      location_id: n.location_id, lib: state.lib};
-        toast(`cut ${chosen.length} item(s)`);
+        toast(t("cut_items", {n: chosen.length}));
       },
     },
     pasteItem(),
@@ -81,7 +82,7 @@ export function showMenu(x, y, n) {
     // scoped to the file's folder — a bare location_id would checksum
     // the whole location from a per-file menu item
     n.is_dir ? null : {
-      label: "Validate folder checksums",
+      label: t("menu_validate"),
       onClick: () => client.files.validate({
         location_id: n.location_id,
         sub_path: n.materialized_path || "/",
@@ -94,13 +95,13 @@ export function showMenu(x, y, n) {
     },
     {separator: true},
     {
-      label: label("Delete"),
+      label: label(t("menu_delete")),
       danger: true,
       onClick: async () => {
-        const what = many ? `${chosen.length} items` : `“${displayName}”`;
-        const ok = await confirmDialog("Delete?",
-          what + " will be moved out of the library and removed from disk.",
-          {danger: true, actionLabel: "delete"});
+        const what = many ? t("n_items", {n: chosen.length}) : `“${displayName}”`;
+        const ok = await confirmDialog(t("delete_confirm_title"),
+          t("delete_confirm_body", {what}),
+          {danger: true, actionLabel: t("delete")});
         if (!ok) return;
         await client.files.deleteFiles(
           {location_id: n.location_id,

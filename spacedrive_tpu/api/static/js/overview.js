@@ -4,6 +4,7 @@
 
 import client from "/rspc/client.js";
 import { $, KIND_ICON, bus, el, fmtBytes, state } from "/static/js/util.js";
+import { t } from "/static/js/i18n.js";
 
 function statCard(label, value, tip) {
   const card = el("div", "stat-card");
@@ -26,20 +27,16 @@ export async function loadOverview() {
 
   // --- library stats row (ref:overview/LibraryStats.tsx) -------------
   const row = el("div", "stat-row");
-  row.appendChild(statCard("objects", String(stats.total_object_count ?? 0)));
-  row.appendChild(statCard("indexed", fmtBytes(+stats.total_bytes_used || 0),
-    "bytes of unique content in the library"));
-  row.appendChild(statCard("capacity", fmtBytes(+stats.total_bytes_capacity || 0),
-    "total capacity of volumes holding locations"));
-  row.appendChild(statCard("free", fmtBytes(+stats.total_bytes_free || 0)));
-  row.appendChild(statCard("database", fmtBytes(+stats.library_db_size || 0),
-    "size of this library's index database"));
-  row.appendChild(statCard("previews", fmtBytes(+stats.preview_media_bytes || 0),
-    "thumbnail store size"));
+  row.appendChild(statCard(t("objects"), String(stats.total_object_count ?? 0)));
+  row.appendChild(statCard(t("indexed"), fmtBytes(+stats.total_bytes_used || 0), t("indexed_tip")));
+  row.appendChild(statCard(t("capacity"), fmtBytes(+stats.total_bytes_capacity || 0), t("capacity_tip")));
+  row.appendChild(statCard(t("free"), fmtBytes(+stats.total_bytes_free || 0)));
+  row.appendChild(statCard(t("database"), fmtBytes(+stats.library_db_size || 0), t("database_tip")));
+  row.appendChild(statCard(t("previews"), fmtBytes(+stats.preview_media_bytes || 0), t("previews_tip")));
   c.appendChild(row);
 
   // --- per-kind breakdown (ref:overview/FileKindStats.tsx) -----------
-  c.appendChild(el("h4", "ov-head", "By kind"));
+  c.appendChild(el("h4", "ov-head", t("by_kind")));
   const kindRow = el("div", "kind-row");
   for (const k of kinds.statistics) {
     if (!k.count) continue;
@@ -58,11 +55,11 @@ export async function loadOverview() {
     kindRow.appendChild(card);
   }
   if (!kindRow.children.length)
-    kindRow.appendChild(el("div", "meta", "nothing indexed yet"));
+    kindRow.appendChild(el("div", "meta", t("nothing_indexed")));
   c.appendChild(kindRow);
 
   // --- locations (ref:overview/LocationCard.tsx) ---------------------
-  c.appendChild(el("h4", "ov-head", "Locations"));
+  c.appendChild(el("h4", "ov-head", t("locations")));
   const locRow = el("div", "kind-row");
   for (const n of locs.nodes) {
     const card = el("div", "kind-card loc");
@@ -79,7 +76,6 @@ export async function loadOverview() {
     locRow.appendChild(card);
   }
   if (!locRow.children.length)
-    locRow.appendChild(el("div", "meta",
-      "no locations yet — add one from the sidebar"));
+    locRow.appendChild(el("div", "meta", t("no_locations_yet")));
   c.appendChild(locRow);
 }

@@ -7,31 +7,32 @@ import { $, bus, el, fmtBytes, state } from "/static/js/util.js";
 import {
   confirmDialog, openDialog, tabs, toast,
 } from "/static/js/ui.js";
+import { LOCALES, locale, setLocale, t } from "/static/js/i18n.js";
 
 let activeTab = "node";
 
 export function renderSettings() {
   const p = $("settings-panel");
   p.innerHTML = "";
-  p.appendChild(el("h2", "", "Settings"));
+  p.appendChild(el("h2", "", t("settings")));
   tabs(p, [
-    {id: "node", label: "Node", render: renderNodeTab},
-    {id: "library", label: "Library", render: renderLibraryTab},
-    {id: "locations", label: "Locations", render: renderLocationsTab},
-    {id: "volumes", label: "Volumes", render: renderVolumesTab},
+    {id: "node", label: t("tab_node"), render: renderNodeTab},
+    {id: "library", label: t("tab_library"), render: renderLibraryTab},
+    {id: "locations", label: t("tab_locations"), render: renderLocationsTab},
+    {id: "volumes", label: t("tab_volumes"), render: renderVolumesTab},
   ], {initial: activeTab, onSelect: (id) => { activeTab = id; }});
 }
 
 async function renderNodeTab(body) {
   const ns = await client.nodeState();
-  body.appendChild(el("h4", "", "This node"));
+  body.appendChild(el("h4", "", t("this_node")));
   const nameRow = el("div", "row");
   const nameIn = el("input");
   nameIn.value = ns.name || "";
-  const nameBtn = el("button", "mini", "rename");
+  const nameBtn = el("button", "mini", t("rename"));
   nameBtn.onclick = async () => {
     await client.nodes.edit({name: nameIn.value});
-    toast("node renamed", {kind: "ok"});
+    toast(t("node_renamed"), {kind: "ok"});
     bus.refreshHeader?.();
   };
   nameRow.appendChild(nameIn);
@@ -39,7 +40,7 @@ async function renderNodeTab(body) {
   body.appendChild(nameRow);
 
   const bgRow = el("div", "row");
-  bgRow.appendChild(el("span", "", "background thumbnailing %"));
+  bgRow.appendChild(el("span", "", t("bg_thumb_pct")));
   const bgIn = el("input");
   bgIn.type = "number";
   bgIn.min = 1; bgIn.max = 100;
@@ -50,7 +51,21 @@ async function renderNodeTab(body) {
   bgRow.appendChild(bgIn);
   body.appendChild(bgRow);
 
-  body.appendChild(el("h4", "", "Features"));
+  body.appendChild(el("h4", "", t("language")));
+  const langRow = el("div", "row");
+  langRow.appendChild(el("span", "", t("language")));
+  const sel = el("select");
+  for (const [code, label] of Object.entries(LOCALES)) {
+    const o = el("option", "", label);
+    o.value = code;
+    sel.appendChild(o);
+  }
+  sel.value = locale();
+  sel.onchange = () => setLocale(sel.value);
+  langRow.appendChild(sel);
+  body.appendChild(langRow);
+
+  body.appendChild(el("h4", "", t("features")));
   for (const feat of ["filesOverP2P", "cloudSync"]) {
     const row = el("div", "row");
     row.appendChild(el("span", "", feat));
@@ -71,10 +86,10 @@ async function renderLibraryTab(body) {
   const rn = el("div", "row");
   const libIn = el("input");
   libIn.value = cur.config.name;
-  const rb = el("button", "mini", "rename");
+  const rb = el("button", "mini", t("rename"));
   rb.onclick = async () => {
     await client.library.edit({id: state.lib, name: libIn.value});
-    toast("library renamed", {kind: "ok"});
+    toast(t("library_renamed"), {kind: "ok"});
     bus.reloadLibraries?.();
   };
   rn.appendChild(libIn);
@@ -82,13 +97,13 @@ async function renderLibraryTab(body) {
   body.appendChild(rn);
 
   const act = el("div", "row");
-  const newBtn = el("button", "mini", "+ new library");
+  const newBtn = el("button", "mini", t("new_library"));
   newBtn.onclick = () => createLibraryModal();
-  const delBtn = el("button", "mini danger", "delete library");
+  const delBtn = el("button", "mini danger", t("delete_library"));
   delBtn.onclick = async () => {
-    const ok = await confirmDialog("Delete library?",
-      `“${cur.config.name}” and its index will be removed (files on `
-      + "disk are untouched).", {danger: true, actionLabel: "delete"});
+    const ok = await confirmDialog(t("delete_library_title"),
+      t("delete_library_body", {name: cur.config.name}),
+      {danger: true, actionLabel: t("delete")});
     if (!ok) return;
     await client.library.delete({id: state.lib});
     bus.reloadLibraries?.();
@@ -105,16 +120,16 @@ async function renderLocationsTab(body) {
     row.appendChild(el("b", "", n.name || n.path));
     row.appendChild(el("div", "meta", n.path));
     const act = el("div", "actions");
-    const rescan = el("button", "mini", "rescan");
-    rescan.setAttribute("data-tip", "re-walk this location and re-identify changes");
+    const rescan = el("button", "mini", t("rescan"));
+    rescan.setAttribute("data-tip", t("rescan_tip"));
     rescan.onclick = async () => {
       await client.locations.fullRescan(
         {location_id: n.id, reidentify_objects: false}, state.lib);
-      rescan.textContent = "rescanning…";
-      toast("rescan started", {kind: "ok"});
+      rescan.textContent = t("rescanning");
+      toast(t("rescan_started"), {kind: "ok"});
     };
-    const del = el("button", "mini danger", "remove");
-    del.setAttribute("data-tip", "stop indexing; files on disk are untouched");
+    const del = el("button", "mini danger", t("remove"));
+    del.setAttribute("data-tip", t("remove_tip"));
     del.onclick = async () => {
       await client.locations.delete(n.id, state.lib);
       renderSettings();
@@ -125,7 +140,7 @@ async function renderLocationsTab(body) {
     row.appendChild(act);
     body.appendChild(row);
   }
-  const addBtn = el("button", "", "+ add location");
+  const addBtn = el("button", "", t("add_location"));
   addBtn.onclick = () => addLocationModal();
   body.appendChild(addBtn);
 }
@@ -142,28 +157,27 @@ async function renderVolumesTab(body) {
 }
 
 export function addLocationModal() {
-  openDialog("Add location", (m, close) => {
-    m.appendChild(el("p", "meta",
-      "absolute path of a directory to index and watch"));
+  openDialog(t("add_location_title"), (m, close) => {
+    m.appendChild(el("p", "meta", t("add_location_body")));
     const path = el("input");
-    path.placeholder = "/path/to/files";
+    path.placeholder = t("add_location_path");
     m.appendChild(path);
     const name = el("input");
-    name.placeholder = "display name (optional)";
+    name.placeholder = t("add_location_name");
     m.appendChild(name);
     const err = el("div", "meta");
     err.style.color = "var(--err)";
     m.appendChild(err);
     const actions = el("div", "modal-actions");
-    const cancel = el("button", "", "cancel");
+    const cancel = el("button", "", t("cancel"));
     cancel.onclick = close;
-    const go = el("button", "primary", "add & index");
+    const go = el("button", "primary", t("add_and_index"));
     go.onclick = async () => {
       try {
         await client.locations.create(
           {path: path.value, name: name.value || null}, state.lib);
         close();
-        toast("location added — indexing", {kind: "ok"});
+        toast(t("location_added"), {kind: "ok"});
         bus.refreshNav?.();
       } catch (e) {
         err.textContent = e.message;
@@ -176,14 +190,14 @@ export function addLocationModal() {
 }
 
 export function createLibraryModal() {
-  openDialog("New library", (m, close) => {
+  openDialog(t("new_library_title"), (m, close) => {
     const name = el("input");
-    name.placeholder = "library name";
+    name.placeholder = t("library_name_placeholder");
     m.appendChild(name);
     const actions = el("div", "modal-actions");
-    const cancel = el("button", "", "cancel");
+    const cancel = el("button", "", t("cancel"));
     cancel.onclick = close;
-    const go = el("button", "primary", "create");
+    const go = el("button", "primary", t("create"));
     go.onclick = async () => {
       if (!name.value) return;
       await client.library.create({name: name.value});

@@ -4,6 +4,7 @@
 import client from "/rspc/client.js";
 import { $, el, fmtBytes } from "/static/js/util.js";
 import { openDialog, toast } from "/static/js/ui.js";
+import { t } from "/static/js/i18n.js";
 
 let dropQueue = [];  // file paths staged for sending
 
@@ -17,8 +18,8 @@ export async function openDropPanel(paths) {
   $("drop-self").textContent = st.enabled
     ? `this node: ${st.identity.slice(0, 20)}…` : "p2p disabled";
   $("drop-status").textContent = dropQueue.length
-    ? `ready to send: ${dropQueue.map(x => x.split("/").pop()).join(", ")}`
-    : "select a file → “spacedrop this file”, then pick a peer";
+    ? t("drop_ready", {files: dropQueue.map(x => x.split("/").pop()).join(", ")})
+    : t("drop_hint");
   const peers = $("peers");
   peers.innerHTML = "";
   for (const peer of st.peers || []) {
@@ -27,15 +28,15 @@ export async function openDropPanel(paths) {
       `${peer.metadata?.name || "node"} · ${peer.identity.slice(0, 16)}…` +
       (peer.connected ? " ✓" : ""));
     row.appendChild(label);
-    const send = el("button", dropQueue.length ? "primary" : "", "send");
+    const send = el("button", dropQueue.length ? "primary" : "", t("send"));
     send.disabled = !dropQueue.length;
     send.onclick = async () => {
       try {
-        $("drop-status").textContent = "sending…";
+        $("drop-status").textContent = t("drop_sending");
         await client.p2p.spacedrop(
           {identity: peer.identity, file_paths: dropQueue});
-        $("drop-status").textContent = "✓ sent";
-        toast("spacedrop sent", {kind: "ok"});
+        $("drop-status").textContent = t("drop_sent");
+        toast(t("drop_sent_toast"), {kind: "ok"});
         dropQueue = [];
       } catch (e) {
         $("drop-status").textContent = "✗ " + e.message;
@@ -46,7 +47,7 @@ export async function openDropPanel(paths) {
     peers.appendChild(row);
   }
   if (!(st.peers || []).length)
-    peers.appendChild(el("div", "meta", "no peers discovered yet"));
+    peers.appendChild(el("div", "meta", t("no_peers")));
 }
 
 let pendingOffer = null;  // {id, close} — offer currently dialogued
@@ -77,30 +78,30 @@ export function showDropOffer(ev) {
   if (pendingOffer) { offerQueue.push(ev); return; }
   // sticky: the dialog's own Escape/backdrop dismissal is disabled —
   // the global Escape handler routes to rejectPendingOffer instead
-  const close = openDialog("Incoming Spacedrop", (m, closeDlg) => {
-    m.appendChild(el("div", "meta", `from ${ev.peer.slice(0, 24)}…`));
+  const close = openDialog(t("incoming_spacedrop"), (m, closeDlg) => {
+    m.appendChild(el("div", "meta", t("from_peer", {peer: ev.peer.slice(0, 24)})));
     const list = el("div");
     list.style.margin = "8px 0";
     for (const f of ev.files) list.appendChild(el("div", "", "• " + f));
     m.appendChild(list);
     m.appendChild(el("div", "meta", fmtBytes(ev.total_size)));
     const dir = el("input");
-    dir.placeholder = "target directory (blank = default)";
+    dir.placeholder = t("target_dir_placeholder");
     m.appendChild(dir);
     const actions = el("div", "modal-actions");
-    const reject = el("button", "danger", "reject");
+    const reject = el("button", "danger", t("reject"));
     reject.onclick = async () => {
       closeDlg();
       settleOffer(ev.id);
       await client.p2p.rejectSpacedrop(ev.id);
     };
-    const accept = el("button", "primary", "accept");
+    const accept = el("button", "primary", t("accept"));
     accept.onclick = async () => {
       closeDlg();
       settleOffer(ev.id);
       await client.p2p.acceptSpacedrop(
         {id: ev.id, target_dir: dir.value || null});
-      toast("spacedrop accepted — receiving", {kind: "ok"});
+      toast(t("drop_accepted_toast"), {kind: "ok"});
     };
     actions.appendChild(reject); actions.appendChild(accept);
     m.appendChild(actions);

@@ -10,6 +10,7 @@
 // well as hover.
 
 import { el } from "/static/js/util.js";
+import { t } from "/static/js/i18n.js";
 
 // --- Dialog (ref:packages/ui/src/Dialog.tsx) -------------------------------
 
@@ -88,10 +89,10 @@ export function confirmDialog(title, message, opts = {}) {
     openDialog(title, (m, close) => {
       if (message) m.appendChild(el("p", "meta", message));
       const actions = el("div", "modal-actions");
-      const cancel = el("button", "", opts.cancelLabel || "cancel");
+      const cancel = el("button", "", opts.cancelLabel || t("cancel"));
       cancel.onclick = close;
       const go = el("button", opts.danger ? "danger" : "primary",
-                    opts.actionLabel || "ok");
+                    opts.actionLabel || t("ok"));
       go.onclick = () => { result = true; close(); };
       actions.appendChild(cancel);
       actions.appendChild(go);
@@ -116,9 +117,9 @@ export function promptDialog(title, opts = {}) {
         if (e.key === "Enter") done();
       });
       const actions = el("div", "modal-actions");
-      const cancel = el("button", "", "cancel");
+      const cancel = el("button", "", t("cancel"));
       cancel.onclick = close;
-      const go = el("button", "primary", opts.actionLabel || "ok");
+      const go = el("button", "primary", opts.actionLabel || t("ok"));
       go.onclick = done;
       actions.appendChild(cancel);
       actions.appendChild(go);

@@ -5,6 +5,7 @@
 import client from "/rspc/client.js";
 import { $, KIND_ICON, bus, el, fmtBytes, state, thumbUrl } from "/static/js/util.js";
 import { dirTarget, draggable, droppable, guardTarget } from "/static/js/dnd.js";
+import { t } from "/static/js/i18n.js";
 import { loadOverview } from "/static/js/overview.js";
 
 export function setView(view) {
@@ -73,8 +74,8 @@ export function renderCrumbs() {
     return s;
   };
   if (state.mode === "search") {
-    c.appendChild(el("span", "", `search: “${state.search}”`));
-    const back = el("button", "mini", "clear");
+    c.appendChild(el("span", "", t("search_crumb", {query: state.search})));
+    const back = el("button", "mini", t("clear"));
     back.style.marginLeft = "8px";
     back.onclick = () => { state.mode = "browse"; state.search = "";
       $("search").value = ""; clearSelection(); loadContent(true); };
@@ -82,24 +83,24 @@ export function renderCrumbs() {
     return;
   }
   if (state.mode === "duplicates") {
-    c.appendChild(el("span", "", "duplicate groups (cas_id exact match)"));
+    c.appendChild(el("span", "", t("duplicate_groups")));
     return;
   }
   if (state.mode === "overview") {
-    c.appendChild(el("span", "", "library overview"));
+    c.appendChild(el("span", "", t("library_overview")));
     return;
   }
   if (state.mode === "favorites") {
-    c.appendChild(el("span", "", "★ favorites"));
+    c.appendChild(el("span", "", t("favorites_crumb")));
     return;
   }
   if (state.mode === "recents") {
-    c.appendChild(el("span", "", "🕘 recently opened"));
+    c.appendChild(el("span", "", t("recents_crumb")));
     return;
   }
   if (state.mode === "kind") {
-    c.appendChild(el("span", "", `kind: ${state.kindName || state.kindFilter}`));
-    const back = el("button", "mini", "← overview");
+    c.appendChild(el("span", "", t("kind_crumb", {kind: state.kindName || state.kindFilter})));
+    const back = el("button", "mini", t("back_to_overview"));
     back.style.marginLeft = "8px";
     back.onclick = () => { state.mode = "overview"; clearSelection();
       loadContent(true); };
@@ -107,11 +108,11 @@ export function renderCrumbs() {
     return;
   }
   if (state.tag) {
-    c.appendChild(el("span", "", "tagged files"));
+    c.appendChild(el("span", "", t("tagged_files")));
     return;
   }
   if (!state.loc) {
-    c.appendChild(el("span", "", "select a location"));
+    c.appendChild(el("span", "", t("select_location")));
     return;
   }
   const crumbDrop = (s, path) =>
@@ -171,8 +172,8 @@ function appendFrom(start) {
     if (!listBody) {
       listBody = el("table", "listing");
       const head = el("tr");
-      for (const h of ["Name", "Kind", "Size", "Modified", "Path"])
-        head.appendChild(el("th", "", h));
+      for (const h of ["name", "kind", "size", "modified", "path"])
+        head.appendChild(el("th", "", t(h)));
       listBody.appendChild(head);
       c.appendChild(listBody);
     }
@@ -184,7 +185,7 @@ function appendFrom(start) {
                 state.nodes.slice(start));
   }
   if (state.cursor) {
-    const btn = el("button", "", "load more");
+    const btn = el("button", "", t("load_more"));
     btn.id = "more";
     btn.onclick = () => loadContent(false);
     c.appendChild(btn);
@@ -217,7 +218,7 @@ function renderCards(c, mediaOnly, nodes) {
     card.appendChild(el("div", "name",
       n.name + (n.extension ? "." + n.extension : "")));
     card.appendChild(el("div", "meta",
-      n.is_dir ? "folder" : fmtBytes(n.size_in_bytes)));
+      n.is_dir ? t("folder") : fmtBytes(n.size_in_bytes)));
     card.onclick = (e) => bus.select(n, e);
     card.ondblclick = () => activate(n);
     card.oncontextmenu = (e) => { e.preventDefault();
@@ -238,7 +239,7 @@ function renderListRows(table, nodes) {
     const icon = n.is_dir ? "📁" : (KIND_ICON[n.object_kind] || "📄");
     tr.appendChild(el("td", "",
       `${icon} ${n.name}${n.extension ? "." + n.extension : ""}`));
-    tr.appendChild(el("td", "", n.is_dir ? "folder" : (n.extension || "")));
+    tr.appendChild(el("td", "", n.is_dir ? t("folder") : (n.extension || "")));
     tr.appendChild(el("td", "", n.is_dir ? "" : fmtBytes(n.size_in_bytes)));
     tr.appendChild(el("td", "", (n.date_modified || "").slice(0, 16)));
     tr.appendChild(el("td", "", n.materialized_path || ""));
@@ -259,12 +260,12 @@ async function loadDuplicates() {
   const c = $("content");
   c.className = "";
   c.innerHTML = "";
-  c.appendChild(el("div", "meta", "scanning…"));
+  c.appendChild(el("div", "meta", t("scanning")));
   const groups = await client.search.duplicates({threshold: 8}, state.lib);
   c.innerHTML = "";
   if (!groups.length) {
     const box = el("div", "dupgroup");
-    box.appendChild(el("div", "meta", "no duplicate groups found"));
+    box.appendChild(el("div", "meta", t("no_duplicates")));
     c.appendChild(box);
     return;
   }

@@ -315,14 +315,16 @@ class _Raster:
         self.embedded = None
         try:
             fonts = self.doc.resolve(resources.get("Font")) or {}
-            fdict = self.doc.resolve(fonts.get(str(name)))
+            ref = fonts.get(str(name))  # the UNresolved ref names the
+            # object — distinct font dicts sharing a BaseFont (e.g. a
+            # form XObject's own /F1) must not collide in the cache
+            fdict = self.doc.resolve(ref)
             if isinstance(fdict, dict):
                 base = str(self.doc.resolve(fdict.get("BaseFont", base)))
-                # prefer the embedded program (cached per Tf alias +
-                # BaseFont — stable for a given page's resources)
                 from .pdf_fonts import load_embedded_font
 
-                key = f"{name}/{base}"
+                key = (f"inline-{id(fdict)}" if isinstance(ref, dict)
+                       else repr(ref))
                 if key not in self._font_cache:
                     self._font_cache[key] = load_embedded_font(self.doc, fdict)
                 self.embedded = self._font_cache[key]

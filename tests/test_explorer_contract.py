@@ -91,6 +91,40 @@ def test_explorer_assets_and_client_shape(tmp_path):
                 assert consumers >= 3, (
                     f"only {consumers} modules import the ui kit")
 
+                # i18n: every locale catalog is served, parses, and has
+                # exactly the English key set (ref:interface/locales/*)
+                async with http.get(f"{base}/static/i18n/en.json") as resp:
+                    assert resp.status == 200
+                    en = await resp.json(content_type=None)
+                assert len(en) >= 100
+                async with http.get(f"{base}/static/js/i18n.js") as resp:
+                    assert resp.status == 200
+                    i18n_js = await resp.text()
+                for export in ("initI18n", "t", "setLocale", "applyDom"):
+                    assert f"export function {export}" in i18n_js \
+                        or f"export async function {export}" in i18n_js, export
+                assert "export const LOCALES" in i18n_js
+                block = i18n_js.split("LOCALES = {")[1].split("}")[0]
+                locales = re.findall(r'"?([a-zA-Z]{2}(?:-[A-Z]{2})?)"?\s*:', block)
+                assert len(locales) >= 10, locales
+                for loc in locales:
+                    async with http.get(
+                        f"{base}/static/i18n/{loc}.json"
+                    ) as resp:
+                        assert resp.status == 200, loc
+                        cat = await resp.json(content_type=None)
+                    assert set(cat) == set(en), (
+                        f"{loc} keys diverge from en")
+                    assert all(str(v).strip() for v in cat.values()), loc
+                # the UI actually consumes the catalog
+                i18n_users = 0
+                for mod in mods:
+                    async with http.get(f"{base}{mod}") as resp:
+                        src = await resp.text()
+                    if '/static/js/i18n.js"' in src:
+                        i18n_users += 1
+                assert i18n_users >= 5, f"only {i18n_users} modules use i18n"
+
                 # the generated client covers every namespace the UI calls
                 async with http.get(f"{base}/rspc/client.js") as resp:
                     js = await resp.text()
