@@ -30,7 +30,14 @@ function pick() {
   const nav = navigator.language || "en";
   if (LOCALES[nav]) return nav;
   const short = nav.split("-")[0];
-  return LOCALES[short] ? short : "en";
+  if (LOCALES[short]) return short;
+  // base-language match: zh / zh-Hans-CN / zh-SG → first zh-* catalog
+  // (Traditional-script tags prefer zh-TW)
+  if (short === "zh") {
+    return /hant|tw|hk|mo/i.test(nav) ? "zh-TW" : "zh-CN";
+  }
+  const prefix = Object.keys(LOCALES).find(l => l.startsWith(short + "-"));
+  return prefix || "en";
 }
 
 async function fetchCatalog(loc) {
@@ -58,7 +65,8 @@ export function t(key, params) {
   let s = catalog[key] ?? fallback[key] ?? key;
   if (params) {
     for (const [k, v] of Object.entries(params)) {
-      s = s.replaceAll(`{${k}}`, String(v));
+      // function form: "$&"-style patterns in values must stay literal
+      s = s.replaceAll(`{${k}}`, () => String(v));
     }
   }
   return s;

@@ -53,7 +53,6 @@ async function renderNodeTab(body) {
 
   body.appendChild(el("h4", "", t("language")));
   const langRow = el("div", "row");
-  langRow.appendChild(el("span", "", t("language")));
   const sel = el("select");
   for (const [code, label] of Object.entries(LOCALES)) {
     const o = el("option", "", label);

@@ -493,6 +493,12 @@ def build_parser() -> argparse.ArgumentParser:
     st = sub.add_parser("status", help="node + library status")
     st.add_argument("--no-p2p", action="store_true", default=True)
 
+    lic = sub.add_parser(
+        "licenses",
+        help="dependency + license inventory (the deps-generator role)",
+    )
+    lic.add_argument("--out", help="write JSON here instead of stdout")
+
     br = sub.add_parser("browse", help="ephemeral (non-indexed) listing")
     br.add_argument("path")
     br.add_argument("--hidden", action="store_true")
@@ -610,6 +616,16 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_labeler(args)
     if args.cmd == "bench":
         return cmd_bench(args)
+    if args.cmd == "licenses":
+        from .utils.deps import collect
+
+        doc = json.dumps(collect(), indent=2)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(doc + "\n")
+        else:
+            print(doc)
+        return 0
     return 2
 
 
