@@ -83,9 +83,10 @@ function renderRoutes() {
 
 async function refreshNav() {
   renderRoutes();
-  const [locs, tags, stats, saved] = await Promise.all([
+  const [locs, tags, labels, stats, saved] = await Promise.all([
     client.locations.list(null, state.lib),
     client.tags.list(null, state.lib),
+    client.labels.list(null, state.lib),
     client.library.statistics(null, state.lib),
     client.search.saved.list(null, state.lib),
   ]);
@@ -117,6 +118,23 @@ async function refreshNav() {
       loadContent(true); };
     tagDiv.appendChild(item);
   }
+  // AI labels route (ref:interface/app/$libraryId/labels.tsx): the
+  // labeler's vocabulary as clickable filters
+  const labDiv = $("labels");
+  labDiv.innerHTML = "";
+  for (const n of labels.nodes) {
+    const item = el("div", "item", "🤖 " + (n.name || "?"));
+    item.onclick = () => { setActive(item);
+      Object.assign(state, {mode: "label", labelFilter: n.id,
+                            labelName: n.name, loc: null, tag: null,
+                            cursor: null});
+      clearSelection();
+      loadContent(true); };
+    labDiv.appendChild(item);
+  }
+  if (!labels.nodes.length)
+    labDiv.appendChild(el("div", "meta", t("no_labels_yet")));
+
   const savDiv = $("saved");
   savDiv.innerHTML = "";
   for (const s of saved.nodes) {
@@ -254,7 +272,8 @@ sock.subscribe("invalidation.listen", (ev) => {
   $("events").textContent = `↻ ${ev.key}`;
   if (["search.paths", "locations.list", "tags.list"].includes(ev.key))
     loadContent(true);
-  if (["locations.list", "tags.list", "search.saved.list"].includes(ev.key))
+  if (["locations.list", "tags.list", "labels.list",
+       "search.saved.list"].includes(ev.key))
     refreshNav();
   if (ev.key === "library.list") loadLibraries();
   if (ev.key === "jobs.reports" &&

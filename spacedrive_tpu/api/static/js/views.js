@@ -46,6 +46,8 @@ export async function loadContent(reset) {
     extra.orderDir = "desc";
   } else if (state.mode === "kind") {
     filter.kinds = [state.kindFilter];
+  } else if (state.mode === "label") {
+    filter.labels = [state.labelFilter];  // ref:labels.tsx route
   } else {
     if (state.loc) {
       filter.locationId = state.loc;
@@ -105,6 +107,10 @@ export function renderCrumbs() {
     back.onclick = () => { state.mode = "overview"; clearSelection();
       loadContent(true); };
     c.appendChild(back);
+    return;
+  }
+  if (state.mode === "label") {
+    c.appendChild(el("span", "", t("label_crumb", {name: state.labelName || ""})));
     return;
   }
   if (state.tag) {

@@ -357,6 +357,7 @@ class ImageLabeler:
             self.skipped += len(batch.entries)
             self._batch_pending[batch.id] = 0
             return
+        wrote = False
         for off in range(0, len(batch.entries), self.batch_size):
             chunk = batch.entries[off : off + self.batch_size]
             decoded = await asyncio.to_thread(self._decode_chunk, chunk)
@@ -369,9 +370,17 @@ class ImageLabeler:
             await asyncio.to_thread(
                 self._write_labels, library, [e for e, _ in ok], probs
             )
+            wrote = True
             self._batch_pending[batch.id] = max(
                 0, self._batch_pending.get(batch.id, 0) - len(chunk)
             )
+        # fresh labels must reach live explorers (the sidebar Labels
+        # route listens on labels.list invalidations)
+        node = getattr(library, "node", None)
+        if wrote and node is not None:
+            from ..api.invalidate import invalidate_query
+
+            invalidate_query(node, "labels.list", library)
 
     def _decode_chunk(self, chunk: list[dict[str, Any]]) -> list[np.ndarray | None]:
         # same dispatch as the thumbnailer (HEIF rides libheif, not PIL)
