@@ -370,6 +370,38 @@ def _files(r: Router) -> None:
         _object_update(node, library, int(arg["id"]), note=arg.get("note"))
         return None
 
+    @r.query("files.getMediaData", library=True)
+    def get_media_data(node, library, arg):
+        """Decoded media_data row for an object id — EXIF capture facts
+        for images, stream facts for videos (ref:core/src/api/files.rs:126
+        `getMediaData`; blobs are msgpack, decoded here for the
+        inspector)."""
+        import msgpack
+
+        row = library.db.find_one("media_data", object_id=int(arg))
+        if row is None:
+            return None
+
+        def mp(blob):
+            if blob is None:
+                return None
+            try:
+                return msgpack.unpackb(blob)
+            except Exception:
+                return None
+
+        return {
+            "resolution": mp(row["resolution"]),
+            "media_date": mp(row["media_date"]),
+            "media_location": mp(row["media_location"]),
+            "camera_data": mp(row["camera_data"]),
+            "artist": row["artist"],
+            "description": row["description"],
+            "copyright": row["copyright"],
+            "exif_version": row["exif_version"],
+            "epoch_time": row["epoch_time"],
+        }
+
     @r.mutation("files.setFavorite", library=True)
     def set_favorite(node, library, arg):
         _object_update(node, library, int(arg["id"]), favorite=int(bool(arg["favorite"])))

@@ -3,6 +3,55 @@
 
 import client from "/rspc/client.js";
 import { $, bus, el, fmtBytes, fullPath, state } from "/static/js/util.js";
+import { t } from "/static/js/i18n.js";
+
+/** dt/dd list builder shared by the details and media sections. */
+function makeDl() {
+  const dl = el("dl");
+  const add = (k, v) => { if (v !== undefined && v !== null && v !== "") {
+    dl.appendChild(el("dt", "", k)); dl.appendChild(el("dd", "", String(v))); } };
+  return { dl, add };
+}
+
+/** EXIF/stream facts for the selected object (ref:Inspector MediaData
+ *  section over files.getMediaData). */
+async function mediaSection(insp, n) {
+  let md = null;
+  try {
+    md = await client.files.getMediaData(n.object_id, state.lib);
+  } catch {
+    return;
+  }
+  if (!md) return;
+  const { dl, add } = makeDl();
+  const res = md.resolution;
+  if (res && res[0]) add(t("media_resolution"), `${res[0]} × ${res[1]}`);
+  const cam = md.camera_data || {};
+  if (cam.video) {
+    if (cam.duration_seconds)
+      add(t("media_duration"), `${cam.duration_seconds.toFixed(1)} s`);
+    if (cam.fps) add("fps", cam.fps.toFixed(2));
+    if (cam.codec) add(t("media_codec"), cam.codec);
+  } else {
+    add(t("media_taken"), md.media_date);
+    const device = [cam.device_make, cam.device_model]
+      .filter(Boolean).join(" ");
+    if (device) add(t("media_camera"), device);
+    if (cam.focal_length) add(t("media_focal"), `${cam.focal_length} mm`);
+    if (cam.iso) add("ISO", cam.iso);
+    if (cam.aperture) add(t("media_aperture"), `f/${cam.aperture}`);
+    if (cam.shutter_speed) add(t("media_shutter"), cam.shutter_speed);
+  }
+  const loc = md.media_location;
+  if (loc && loc.latitude !== undefined)
+    add("GPS", `${(+loc.latitude).toFixed(5)}, ${(+loc.longitude).toFixed(5)}`);
+  if (md.artist) add(t("media_artist"), md.artist);
+  if (!dl.children.length) return;
+  const head = el("h4", "", t("media_section"));
+  head.style.margin = "12px 0 4px";
+  insp.appendChild(head);
+  insp.appendChild(dl);
+}
 
 export function updateSelection() {
   const ids = state.selectedIds;
@@ -12,7 +61,10 @@ export function updateSelection() {
 
 /** Selection model: plain click = single; ctrl/cmd = toggle; shift =
  *  range from the anchor (ref:interface Explorer multi-select). */
+let selGen = 0;  // bumped per select(); stale awaits bail
+
 export async function select(n, ev = null) {
+  const gen = ++selGen;
   if (ev && (ev.ctrlKey || ev.metaKey)) {
     if (state.selectedIds.has(n.id) && state.selectedIds.size > 1) {
       state.selectedIds.delete(n.id);
@@ -50,9 +102,7 @@ export async function select(n, ev = null) {
   }
   insp.appendChild(el("h3", "",
     n.name + (n.extension ? "." + n.extension : "")));
-  const dl = el("dl");
-  const add = (k, v) => { if (v !== undefined && v !== null && v !== "") {
-    dl.appendChild(el("dt", "", k)); dl.appendChild(el("dd", "", String(v))); } };
+  const { dl, add } = makeDl();
   add("kind", n.is_dir ? "folder" : (n.object_kind ?? ""));
   add("size", n.is_dir ? "" : fmtBytes(n.size_in_bytes));
   add("created", (n.date_created || "").slice(0, 19));
@@ -62,6 +112,8 @@ export async function select(n, ev = null) {
   insp.appendChild(dl);
 
   if (n.object_id) {
+    await mediaSection(insp, n);
+    if (gen !== selGen) return;  // superseded while fetching media
     // favorite + note (files.setFavorite/setNote take the file_path id)
     const favBtn = el("button", "",
       n.object_favorite ? "★ favorited" : "☆ favorite");
@@ -93,6 +145,7 @@ export async function select(n, ev = null) {
     const chipBox = el("div");
     insp.appendChild(chipBox);
     const myTags = (await client.tags.getForObject(n.object_id, state.lib)).nodes;
+    if (gen !== selGen) return;  // superseded while fetching tags
     for (const t of myTags) {
       const chip = el("span", "chip");
       const dot = el("i", "dot");
@@ -144,6 +197,7 @@ export async function select(n, ev = null) {
     // labels (read-only; written by the image labeler)
     const labels =
       (await client.labels.getForObject(n.object_id, state.lib)).nodes;
+    if (gen !== selGen) return;  // superseded while fetching labels
     if (labels.length) {
       const lh = el("h4", "", "Labels");
       lh.style.margin = "12px 0 4px";

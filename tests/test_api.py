@@ -19,11 +19,14 @@ from spacedrive_tpu.api.router import CoreEventKind
 
 @pytest.fixture()
 def corpus(tmp_path):
+    from PIL import Image
+
     d = tmp_path / "corpus"
     d.mkdir()
     (d / "alpha.txt").write_bytes(b"a" * 1000)
     (d / "beta.bin").write_bytes(os.urandom(2000))
     (d / "photo.jpg").write_bytes(b"\xff\xd8\xff\xe0" + os.urandom(500))
+    Image.new("RGB", (48, 36), (200, 40, 40)).save(d / "real.png")
     sub = d / "nested"
     sub.mkdir()
     (sub / "gamma.txt").write_bytes(b"g" * 300)
@@ -358,6 +361,15 @@ def test_overview_favorites_recents_api(tmp_path, corpus):
             )
             assert [n["name"] for n in rec["nodes"]] == ["beta", "alpha"]
             assert all(n["object_date_accessed"] for n in rec["nodes"])
+
+            # inspector media section: decoded EXIF facts for an image
+            png = lib.db.find_one("file_path", name="real")
+            md = await r.exec(node, "files.getMediaData",
+                              png["object_id"], library_id=lid)
+            assert md["resolution"] == [48, 36]
+            # a text file has no media_data row → null, not an error
+            assert await r.exec(node, "files.getMediaData",
+                                fp["object_id"], library_id=lid) is None
         finally:
             await node.shutdown()
 
