@@ -15,7 +15,12 @@ function makeDl() {
 
 /** EXIF/stream facts for the selected object (ref:Inspector MediaData
  *  section over files.getMediaData). */
-async function mediaSection(insp, n) {
+async function mediaSection(box, n) {
+  // `box` is a placeholder appended synchronously by THIS selection's
+  // render: if a newer selection supersedes us, the box is already
+  // detached and these appends are invisible — no staleness hazard,
+  // and the favorite/note/tags render is never serialized behind the
+  // media RPC.
   let md = null;
   try {
     md = await client.files.getMediaData(n.object_id, state.lib);
@@ -49,8 +54,8 @@ async function mediaSection(insp, n) {
   if (!dl.children.length) return;
   const head = el("h4", "", t("media_section"));
   head.style.margin = "12px 0 4px";
-  insp.appendChild(head);
-  insp.appendChild(dl);
+  box.appendChild(head);
+  box.appendChild(dl);
 }
 
 export function updateSelection() {
@@ -112,8 +117,12 @@ export async function select(n, ev = null) {
   insp.appendChild(dl);
 
   if (n.object_id) {
-    await mediaSection(insp, n);
-    if (gen !== selGen) return;  // superseded while fetching media
+    // media facts fill in asynchronously alongside the controls below
+    if ([5, 7].includes(n.object_kind)) {
+      const mediaBox = el("div");
+      insp.appendChild(mediaBox);
+      mediaSection(mediaBox, n);
+    }
     // favorite + note (files.setFavorite/setNote take the file_path id)
     const favBtn = el("button", "",
       n.object_favorite ? "★ favorited" : "☆ favorite");
@@ -146,16 +155,16 @@ export async function select(n, ev = null) {
     insp.appendChild(chipBox);
     const myTags = (await client.tags.getForObject(n.object_id, state.lib)).nodes;
     if (gen !== selGen) return;  // superseded while fetching tags
-    for (const t of myTags) {
+    for (const tg of myTags) {
       const chip = el("span", "chip");
       const dot = el("i", "dot");
-      dot.style.background = t.color || "#5a7bfc";
+      dot.style.background = tg.color || "#5a7bfc";
       chip.appendChild(dot);
-      chip.appendChild(document.createTextNode(t.name || "?"));
+      chip.appendChild(document.createTextNode(tg.name || "?"));
       const x = el("span", "x", "×");
       x.onclick = async () => {
         await client.tags.assign(
-          {tag_id: t.id, object_ids: [n.object_id], unassign: true}, state.lib);
+          {tag_id: tg.id, object_ids: [n.object_id], unassign: true}, state.lib);
         select(n);
       };
       chip.appendChild(x);
@@ -164,10 +173,10 @@ export async function select(n, ev = null) {
     const addBox = el("div", "addtag");
     const sel = el("select");
     sel.appendChild(el("option", "", "+ tag…"));
-    for (const t of state.allTags) {
-      if (myTags.some(m => m.id === t.id)) continue;
-      const o = el("option", "", t.name || "?");
-      o.value = t.id;
+    for (const tg of state.allTags) {
+      if (myTags.some(m => m.id === tg.id)) continue;
+      const o = el("option", "", tg.name || "?");
+      o.value = tg.id;
       sel.appendChild(o);
     }
     const newOpt = el("option", "", "new tag…");

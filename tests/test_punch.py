@@ -253,6 +253,80 @@ def test_punch_falls_back_to_relay_on_symmetric_nat():
     asyncio.run(run())
 
 
+def test_spacedrop_rides_punched_path(tmp_path):
+    """Full app protocol over a punched connection: discovery via the
+    relay registry, new_stream punches a direct UDP path, and a real
+    Spacedrop (Header framing + Spaceblock transfer) crosses it with
+    ZERO bytes through the relay."""
+
+    async def run():
+        from spacedrive_tpu.p2p import operations
+        from spacedrive_tpu.p2p.protocol import Header, HeaderType
+
+        srv = RelayServer()
+        port = await srv.start()
+        a, b = P2P("sdx"), P2P("sdx")
+        save_dir = str(tmp_path / "inbox")
+        drops_b = operations.SpacedropManager(b, save_dir=save_dir)
+
+        async def on_stream_b(stream):
+            header = await Header.read(stream)
+            if header.type == HeaderType.SPACEDROP:
+                await drops_b.handle_inbound(stream, header.spacedrop)
+
+        async def on_stream_a(stream):
+            pass
+
+        ra = RelayClient(a, ("127.0.0.1", port), on_stream_a,
+                         query_interval=0.1,
+                         udp_factory=lambda: NattedEndpoint("cone"))
+        rb = RelayClient(b, ("127.0.0.1", port), on_stream_b,
+                         query_interval=0.1,
+                         udp_factory=lambda: NattedEndpoint("cone"))
+        await ra.start()
+        await rb.start()
+        try:
+            for _ in range(100):
+                if (a.peers.get(b.identity.to_remote_identity())
+                        and a.peers[b.identity.to_remote_identity()].is_discovered):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise TimeoutError("relay discovery failed")
+
+            src = str(tmp_path / "gift.bin")
+            payload = os.urandom(300_000)
+            with open(src, "wb") as f:
+                f.write(payload)
+
+            async def auto_accept():
+                for _ in range(200):
+                    if drops_b.pending:
+                        drops_b.accept(next(iter(drops_b.pending)), save_dir)
+                        return
+                    await asyncio.sleep(0.05)
+
+            drops_a = operations.SpacedropManager(a)
+            drop_id, _ = await asyncio.gather(
+                drops_a.send(b.identity.to_remote_identity(), [src]),
+                auto_accept(),
+            )
+            with open(os.path.join(save_dir, "gift.bin"), "rb") as f:
+                assert f.read() == payload
+            assert drops_a.progress[drop_id] == 100
+            # the transfer really was direct: the relay spliced nothing
+            assert srv.stats.pipes_opened == 0
+            assert srv.stats.bytes_relayed == 0
+        finally:
+            await ra.shutdown()
+            await rb.shutdown()
+            await a.shutdown()
+            await b.shutdown()
+            await srv.shutdown()
+
+    asyncio.run(run())
+
+
 def test_punch_disabled_uses_relay():
     async def run():
         srv, a, b, ra, rb, echoed = await _relay_pair("cone", "cone")
