@@ -268,6 +268,17 @@ sock.subscribe("p2p.events", (ev) => {
   if (ev.kind && ev.kind.startsWith("Peer") &&
       $("drop-panel").classList.contains("open")) openDropPanel();
 });
+sock.subscribe("notifications.listen", (ev) => {
+  // persisted job-outcome notifications (ref:lib.rs emit_notification)
+  const d = ev.data || {};
+  const what = d.job || "job";
+  const kind = d.kind === "error" ? "error"
+             : d.kind === "warning" ? "info" : "ok";
+  toast(
+    d.message ? `${what}: ${d.message}`
+              : `${what} ${t(d.kind === "error" ? "job_failed" : "job_done")}`,
+    {kind});
+});
 sock.subscribe("invalidation.listen", (ev) => {
   $("events").textContent = `↻ ${ev.key}`;
   if (["search.paths", "locations.list", "tags.list"].includes(ev.key))

@@ -104,11 +104,56 @@ class JobManager:
         self._active.pop(job.id, None)
         logger.info("job %s -> %s", job.NAME, report.status.name)
 
+        self._notify_outcome(job, library, report)
+
         # chain: spawn queued next jobs on success (ref:mod.rs:213-231)
         if report.status in (JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS):
             self._invalidate_on_complete(job, library)
             for next_job in job.next_jobs:
                 await self.ingest(next_job, library, parent=report)
+
+    @staticmethod
+    def _notify_outcome(job: StatefulJob, library: Any, report: JobReport) -> None:
+        """Persisted library notification for job outcomes the user
+        should see (ref:lib.rs:267-278 emit_notification): failures,
+        and the completion of a chain's last job. NOT notified:
+        user-initiated cancels (the user already knows), intermediate
+        chain stages (one toast per chain, not per stage), and jobs
+        flagged `notify_outcome=False` (watcher-triggered rescans fire
+        on every filesystem flush — toasting those would spam and grow
+        the notification table without bound)."""
+        if not getattr(job, "notify_outcome", True):
+            return
+        node = getattr(library, "node", None)
+        if node is None or getattr(node, "notifications", None) is None:
+            return
+        failed = report.status == JobStatus.FAILED
+        partial = report.status == JobStatus.COMPLETED_WITH_ERRORS
+        # chain terminus: the last job of a chain (no queued successors)
+        chain_done = (
+            not job.next_jobs
+            and report.status in (JobStatus.COMPLETED,
+                                  JobStatus.COMPLETED_WITH_ERRORS)
+        )
+        if not (failed or chain_done):
+            return
+        message = None
+        if failed and report.errors_text:
+            message = report.errors_text[-1][:200]
+        elif partial:
+            n = len(report.errors_text) or len(job.errors)
+            message = f"{n or 'some'} items failed"
+            if report.errors_text:
+                message += f"; last: {report.errors_text[-1][:150]}"
+        try:
+            node.notifications.emit_library(library.db, str(library.id), {
+                "kind": "error" if failed else ("warning" if partial else "ok"),
+                "job": job.NAME,
+                "status": report.status.name,
+                "message": message,
+            })
+        except Exception:  # noqa: BLE001 - notifying must never kill a job
+            logger.debug("job outcome notification failed", exc_info=True)
 
     @staticmethod
     def _invalidate_on_complete(job: StatefulJob, library: Any) -> None:

@@ -91,9 +91,12 @@ async def _spawn_scan_chain(
     sub_path: str | None = None,
     shallow: bool = False,
     backend: str = "auto",
+    notify: bool = True,
 ) -> uuid.UUID:
     """The one Indexer → FileIdentifier → MediaProcessor chain every
-    scan variant spawns (ref:location/mod.rs:443-475 JobBuilder chain)."""
+    scan variant spawns (ref:location/mod.rs:443-475 JobBuilder chain).
+    `notify=False` (watcher-triggered rescans) suppresses the chain's
+    outcome notification — those fire per filesystem flush."""
     from ..object.file_identifier.job import FileIdentifierJob
     from ..object.media.job import MediaProcessorJob
     from .indexer.job import IndexerJob
@@ -102,11 +105,14 @@ async def _spawn_scan_chain(
     if sub_path is not None:
         init["sub_path"] = sub_path
     indexer_init = {**init, "shallow": True} if shallow else dict(init)
-    builder = (
-        JobBuilder(IndexerJob(indexer_init))
-        .queue_next(FileIdentifierJob({**init, "backend": backend}))
-        .queue_next(MediaProcessorJob({**init, "backend": backend}))
-    )
+    jobs = [
+        IndexerJob(indexer_init),
+        FileIdentifierJob({**init, "backend": backend}),
+        MediaProcessorJob({**init, "backend": backend}),
+    ]
+    for j in jobs:
+        j.notify_outcome = notify
+    builder = JobBuilder(jobs[0]).queue_next(jobs[1]).queue_next(jobs[2])
     return await builder.spawn(job_manager, library)
 
 
@@ -133,7 +139,8 @@ async def deep_rescan_sub_path(
     into the location needs (a shallow scan of its parent would index
     only the dir row, not its pre-existing contents)."""
     return await _spawn_scan_chain(
-        library, location, job_manager, sub_path=sub_path, backend=backend
+        library, location, job_manager, sub_path=sub_path, backend=backend,
+        notify=False,  # watcher-driven; see _spawn_scan_chain
     )
 
 
@@ -145,7 +152,8 @@ async def light_scan_location(
 ) -> uuid.UUID:
     """Shallow re-scan of one directory (ref:location/mod.rs:517)."""
     return await _spawn_scan_chain(
-        library, location, job_manager, sub_path=sub_path, shallow=True
+        library, location, job_manager, sub_path=sub_path, shallow=True,
+        notify=False,  # watcher-driven; see _spawn_scan_chain
     )
 
 

@@ -362,6 +362,14 @@ def test_overview_favorites_recents_api(tmp_path, corpus):
             assert [n["name"] for n in rec["nodes"]] == ["beta", "alpha"]
             assert all(n["object_date_accessed"] for n in rec["nodes"])
 
+            # job outcomes surface as persisted notifications: the
+            # scan chain's terminus emitted exactly one "ok" row
+            notifs = await r.exec(node, "notifications.get")
+            jobs_notified = [n for n in notifs
+                             if n["data"].get("job") == "media_processor"]
+            assert len(jobs_notified) == 1
+            assert jobs_notified[0]["data"]["kind"] == "ok"
+
             # inspector media section: decoded EXIF facts for an image
             png = lib.db.find_one("file_path", name="real")
             md = await r.exec(node, "files.getMediaData",
