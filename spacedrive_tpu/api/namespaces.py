@@ -1093,10 +1093,14 @@ def _p2p(r: Router) -> None:
     def state(node):
         if node.p2p is None:
             return {"enabled": False, "peers": []}
+        relay_client = node.p2p.relay_client
         return {
             "enabled": True,
             "port": node.p2p.port,
             "identity": str(node.p2p.p2p.remote_identity),
+            # path-selection telemetry: punched-direct vs relayed dials
+            "punch": (dict(relay_client.punch_stats)
+                      if relay_client is not None else None),
             "peers": [
                 {
                     "identity": str(p.identity),

@@ -3,6 +3,7 @@
 
 import client from "/rspc/client.js";
 import { $, bus, el } from "/static/js/util.js";
+import { t } from "/static/js/i18n.js";
 
 export function showOnboarding() {
   const board = $("onboard");
@@ -11,23 +12,21 @@ export function showOnboarding() {
   box.innerHTML = "";
   box.appendChild(el("h1", "", ""));
   box.querySelector("h1").innerHTML = "Welcome to <b>spacedrive-tpu</b>";
-  box.appendChild(el("p", "",
-    "A library is the database that indexes your files. Create one to "
-    + "get started — you can add locations (folders to index) next."));
+  box.appendChild(el("p", "", t("onboard_intro")));
   const name = el("input");
-  name.placeholder = "library name";
-  name.value = "My Library";
+  name.placeholder = t("library_name_placeholder");
+  name.value = t("onboard_default_name");
   box.appendChild(name);
   const path = el("input");
-  path.placeholder = "first location path (optional, e.g. /home/me/files)";
+  path.placeholder = t("onboard_first_location");
   box.appendChild(path);
   const err = el("div", "meta");
   err.style.color = "var(--err)";
   box.appendChild(err);
   const actions = el("div", "modal-actions");
-  const go = el("button", "primary", "create library");
+  const go = el("button", "primary", t("onboard_create"));
   go.onclick = async () => {
-    if (!name.value) { err.textContent = "name required"; return; }
+    if (!name.value) { err.textContent = t("onboard_name_required"); return; }
     go.disabled = true;
     try {
       const lib = await client.library.create({name: name.value});

@@ -35,6 +35,7 @@ class P2PManager:
         self.node = node
         self.p2p = P2P("spacedrive", node.config.config.identity)
         self.spacedrop = SpacedropManager(self.p2p, node.event_bus)
+        self.relay_client = None  # set when p2p.relay is configured
         from .pairing import PairingManager
 
         self.pairing = PairingManager(node, node.event_bus)
@@ -79,6 +80,7 @@ class P2PManager:
                 )
                 await relay.start()
                 self.p2p.register_discovery(relay)
+                self.relay_client = relay  # punch telemetry for p2p.state
         for lib in self.node.libraries.libraries.values():
             self.register_library(lib)
 

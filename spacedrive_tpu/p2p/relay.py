@@ -498,6 +498,9 @@ class RelayClient:
         self._relay_udp: tuple[str, int] | None = None
         self._ctrl: asyncio.StreamWriter | None = None
         self._punch_waits: dict[str, asyncio.Future] = {}
+        # path-selection telemetry (surfaced via p2p.state)
+        self.punch_stats = {"attempted": 0, "direct": 0, "fallback": 0,
+                            "accepted": 0}
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -676,13 +679,17 @@ class RelayClient:
             # under the caller's deadline, and the fallback gets only
             # what remains (floored so it always has a fighting chance)
             start = asyncio.get_running_loop().time()
+            self.punch_stats["attempted"] += 1
             try:
-                return await asyncio.wait_for(
+                stream = await asyncio.wait_for(
                     self.punch_dial(identity, timeout=timeout), timeout
                 )
+                self.punch_stats["direct"] += 1
+                return stream
             except Exception as e:  # noqa: BLE001 - any punch failure → relay
                 logger.debug("punch to %s failed (%s); using relay",
                              identity, e)
+            self.punch_stats["fallback"] += 1
             timeout = max(
                 3.0, timeout - (asyncio.get_running_loop().time() - start)
             )
@@ -790,6 +797,7 @@ class RelayClient:
                 _server_handshake(stream.reader, stream, self.identity),
                 DIAL_TIMEOUT,
             )
+            self.punch_stats["accepted"] += 1
         except Exception as e:  # noqa: BLE001 - inbound is best-effort
             logger.debug("punch accept failed: %s", e)
             ep.close()
