@@ -388,6 +388,54 @@ def test_spacedrop_rides_punched_path(tmp_path):
     asyncio.run(run())
 
 
+def test_relay_rejects_unwitnessed_punch_addr():
+    """The relay only routes addresses it observed itself: a punch
+    carrying a token it never saw is refused (so a client cannot point
+    a victim's probes at an arbitrary third party), and tokens are
+    consumed on use."""
+
+    async def run():
+        from spacedrive_tpu.p2p.relay import (
+            _LISTEN_CONTEXT, read_frame, write_frame,
+        )
+
+        srv = RelayServer()
+        port = await srv.start()
+
+        async def register(ident: Identity):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            write_frame(w, {"cmd": "listen",
+                            "identity": str(ident.to_remote_identity()),
+                            "meta": {}})
+            await w.drain()
+            ch = await read_frame(r)
+            write_frame(w, {"sig": ident.sign(
+                _LISTEN_CONTEXT + bytes.fromhex(ch["challenge"])).hex()})
+            await w.drain()
+            ok = await read_frame(r)
+            assert ok.get("ok") and ok.get("udp_port")
+            return r, w
+
+        attacker, victim = Identity(), Identity()
+        ar, aw = await register(attacker)
+        _vr, _vw = await register(victim)
+        try:
+            write_frame(aw, {"cmd": "punch", "conn": "c1",
+                             "target": str(victim.to_remote_identity()),
+                             "token": "never-observed"})
+            await aw.drain()
+            resp = await asyncio.wait_for(read_frame(ar), 5)
+            assert resp.get("event") == "punch_addr"
+            assert resp.get("ok") is False
+            assert "token" in resp.get("error", "")
+        finally:
+            aw.close()
+            _vw.close()
+            await srv.shutdown()
+
+    asyncio.run(run())
+
+
 def test_punch_disabled_uses_relay():
     async def run():
         srv, a, b, ra, rb, echoed = await _relay_pair("cone", "cone")
