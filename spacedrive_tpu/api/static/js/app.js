@@ -10,7 +10,7 @@ import { openDropPanel, rejectPendingOffer, showDropOffer, wireDropPanel } from 
 import { addLocationModal, wireSettingsPanel } from "/static/js/settings.js";
 import { showMenu, wireContextMenu } from "/static/js/contextmenu.js";
 import { showOnboarding } from "/static/js/onboarding.js";
-import { confirmDialog, initTooltips, promptDialog, toast } from "/static/js/ui.js";
+import { attachDropdown, confirmDialog, initTooltips, promptDialog, toast } from "/static/js/ui.js";
 import { initI18n, t } from "/static/js/i18n.js";
 import { openPreview, previewOpen, wireQuickPreview } from "/static/js/quickpreview.js";
 import { droppable, guardTarget } from "/static/js/dnd.js";
@@ -177,6 +177,39 @@ function setActive(item) {
 }
 
 // ---------- header wiring ----------
+const SORT_FIELDS = [
+  ["name", "sort_name"], ["sizeInBytes", "sort_size"],
+  ["dateModified", "sort_modified"], ["dateCreated", "sort_created"],
+  ["dateAccessed", "sort_accessed"],
+];
+attachDropdown($("btn-sort"), () => {
+  // these views pin their own ordering (recents = last-opened) or have
+  // none — a selectable menu would silently no-op
+  if (["recents", "duplicates", "overview"].includes(state.mode)) {
+    return [{label: t("sort_unavailable"), disabled: true}];
+  }
+  return [
+  ...SORT_FIELDS.map(([field, key]) => ({
+    label: (state.orderBy === field ? "✓ " : "\u2007 ") + t(key),
+    onClick: () => {
+      state.orderBy = field;
+      localStorage.setItem("sd-order", field);
+      clearSelection();
+      loadContent(true);
+    },
+  })),
+  {separator: true},
+  ...[["asc", "sort_asc"], ["desc", "sort_desc"]].map(([dir, key]) => ({
+    label: (state.orderDir === dir ? "✓ " : "\u2007 ") + t(key),
+    onClick: () => {
+      state.orderDir = dir;
+      localStorage.setItem("sd-orderdir", dir);
+      clearSelection();
+      loadContent(true);
+    },
+  })),
+  ];
+});
 document.querySelectorAll("#viewsw button").forEach(b =>
   b.onclick = () => setView(b.dataset.view));
 $("search").addEventListener("keydown", (e) => {

@@ -6,11 +6,23 @@ export const KIND_ICON = {0:"ğŸ“„",1:"ğŸ“‘",2:"ğŸ“",3:"ğŸ“",4:"ğŸ“¦",5:"ğŸ–¼ï¸
                           7:"ğŸ¬",8:"ğŸ—œï¸",9:"âš™ï¸",10:"ğŸ”—",11:"ğŸ”’",12:"ğŸ”‘",
                           13:"ğŸ”—",14:"ğŸŒ"};
 
+export const ORDER_FIELDS =
+  ["name", "sizeInBytes", "dateCreated", "dateModified", "dateAccessed"];
+
+// persisted values are validated: a stale/hand-edited key must not
+// make every search.paths call 400 with no visible error
+function persisted(key, allowed, fallback) {
+  const v = localStorage.getItem(key);
+  return allowed.includes(v) ? v : fallback;
+}
+
 export const state = {
   lib: null, loc: null, tag: null, search: "", cursor: null,
   path: "/",                       // materialized path inside the location
   mode: "browse",                  // browse | search | duplicates
-  view: localStorage.getItem("sd-view") || "grid",
+  view: persisted("sd-view", ["grid", "list", "media"], "grid"),
+  orderBy: persisted("sd-order", ORDER_FIELDS, "name"),
+  orderDir: persisted("sd-orderdir", ["asc", "desc"], "asc"),
   nodes: [], selected: null, selectedIds: new Set(),
   locPaths: {}, locNames: {}, allTags: [],
 };

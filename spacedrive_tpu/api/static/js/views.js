@@ -56,6 +56,10 @@ export async function loadContent(reset) {
   }
   if (state.tag) filter.tags = [state.tag];
   if (state.view === "media" && state.mode !== "kind") filter.kinds = [5, 7];
+  if (!extra.orderBy) {  // recents pins its own dateAccessed ordering
+    extra.orderBy = state.orderBy;
+    extra.orderDir = state.orderDir;
+  }
   const page = await client.search.paths(
     {filter, take: 60, cursor: state.cursor, ...extra}, state.lib);
   if (seq !== loadSeq) return;  // a newer load superseded this one

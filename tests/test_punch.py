@@ -390,9 +390,9 @@ def test_spacedrop_rides_punched_path(tmp_path):
 
 def test_relay_rejects_unwitnessed_punch_addr():
     """The relay only routes addresses it observed itself: a punch
-    carrying a token it never saw is refused (so a client cannot point
-    a victim's probes at an arbitrary third party), and tokens are
-    consumed on use."""
+    carrying a token it never saw is refused, so a client cannot point
+    a victim's probes at an arbitrary third party. (One-shot token
+    consumption is pinned by test_observe_reports_nat_mapping.)"""
 
     async def run():
         from spacedrive_tpu.p2p.relay import (
