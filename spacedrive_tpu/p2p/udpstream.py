@@ -19,6 +19,13 @@ ordered reliable bytes it needs:
 The security posture does not rest on this layer: every byte above it
 is AEAD-protected and an attacker who forges/reorders segments can only
 cause decrypt failures (= connection teardown), same as TCP injection.
+
+Scope notes: sequence numbers are 32-bit (a single stream tops out at
+~4.9 TB — far beyond any Spacedrop session; streams are per-transfer);
+there is no receiver-advertised flow-control window — in-flight data is
+bounded by the sender window (WINDOW×MSS ≈ 144 KiB) but ACKed data
+accumulates in the reader if the application stops consuming, which the
+protocol layers above never do (they read in a loop).
 """
 
 from __future__ import annotations
