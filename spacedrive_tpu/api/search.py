@@ -36,12 +36,18 @@ _FILE_PATH_ORDER = {
     "dateCreated": "fp.date_created",
     "dateModified": "fp.date_modified",
     "dateIndexed": "fp.date_indexed",
-    # ISO-8601 text sorts chronologically; NULLs (never accessed) last
-    "dateAccessed": "COALESCE(o.date_accessed, '')",
+    # ISO-8601 text sorts chronologically; never-accessed rows sort LAST
+    # under BOTH directions: '~' (0x7E) is > any digit so it's a max key
+    # for ASC, '' is a min key so it lands last under DESC
+    "dateAccessed": {"ASC": "COALESCE(o.date_accessed, '~')",
+                     "DESC": "COALESCE(o.date_accessed, '')"},
 }
 
 _OBJECT_ORDER = {
-    "dateAccessed": "o.date_accessed",
+    # same never-accessed-last sentinels as the file_path ordering —
+    # the two search endpoints must agree on dateAccessed semantics
+    "dateAccessed": {"ASC": "COALESCE(o.date_accessed, '~')",
+                     "DESC": "COALESCE(o.date_accessed, '')"},
     "kind": "o.kind",
 }
 
@@ -235,4 +241,7 @@ def _ordering(
     if ordering not in allowed:
         raise RspcError.bad_request(f"unknown orderBy {ordering!r}")
     direction = "DESC" if arg.get("orderDir") == "desc" else "ASC"
-    return allowed[ordering], direction
+    expr = allowed[ordering]
+    if isinstance(expr, dict):  # direction-dependent NULL sentinel
+        expr = expr[direction]
+    return expr, direction

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import ctypes
 import ctypes.util
+import itertools
 import logging
 from typing import Any
 
@@ -93,8 +94,54 @@ def _cairo_ft():
         c.cairo_glyph_extents.restype = None
         c.cairo_glyph_extents.argtypes = [
             V, ctypes.POINTER(CairoGlyph), I, ctypes.POINTER(_TextExtents)]
+        c.cairo_font_face_set_user_data.restype = I
+        c.cairo_font_face_set_user_data.argtypes = [V, V, V, V]
         _cairo_ft_bound.append(True)
     return c
+
+
+# --- FT face lifetime --------------------------------------------------------
+#
+# cairo's scaled-font holdover cache may keep the font face (and through it
+# the FT_Face) alive past cairo_font_face_destroy; cairo's contract for
+# cairo_ft_font_face_create_for_ft_face requires the FT_Face to outlive every
+# cairo reference. So the FT_Face (and the memory buffer it parses lazily) is
+# freed from a cairo user-data destroy hook — invoked only when the LAST
+# cairo reference drops — never directly.
+
+class _CairoUserDataKey(ctypes.Structure):
+    _fields_ = [("unused", ctypes.c_int)]
+
+
+_FT_KEY = _CairoUserDataKey()
+_DESTROY_T = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_live_ft_faces: dict[int, tuple] = {}  # token -> (buf, FT_Face)
+# PDF decodes run concurrently on worker threads: next() on a count is
+# GIL-atomic, so parallel loads can never share a token (a shared token
+# would let one face's destroy hook free the OTHER face)
+_token_counter = itertools.count(1)
+
+
+@_DESTROY_T
+def _ft_face_destroy_hook(data):
+    buf, face = _live_ft_faces.pop(int(data or 0), (None, None))
+    ft = _ft_lib[0] if _ft_lib else None
+    if ft is not None and face:
+        ft.FT_Done_Face(face)
+
+
+def _bind_ft_lifetime(c, cairo_face, face, buf) -> None:
+    """Tie (buf, face) to the cairo face's last-reference drop. On the
+    (OOM-only) registration failure the pair stays in the registry
+    forever — a bounded leak, never a dangling FT_Face."""
+    token = next(_token_counter)
+    _live_ft_faces[token] = (buf, face)
+    status = c.cairo_font_face_set_user_data(
+        cairo_face, ctypes.byref(_FT_KEY), ctypes.c_void_p(token),
+        _ft_face_destroy_hook)
+    if status != 0:  # CAIRO_STATUS_NO_MEMORY: hook not registered
+        logger.warning("cairo_font_face_set_user_data failed (%d); "
+                       "leaking FT face rather than risking a UAF", status)
 
 
 # --- glyph names (full ASCII coverage; AGL's latin core) -------------------
@@ -132,33 +179,25 @@ class EmbeddedFont:
     char-code mapping and width table needed to lay out a show op."""
 
     def __init__(self, cairo_face: Any, code_to_gid, two_byte: bool,
-                 widths: dict[int, float], default_width: float,
-                 keepalive: tuple):
+                 widths: dict[int, float], default_width: float):
         self.cairo_face = cairo_face
         self._code_to_gid = code_to_gid  # callable code → gid
         self.two_byte = two_byte
         self.widths = widths             # code → advance /1000 units
         self.default_width = default_width
-        self._keepalive = keepalive      # (font bytes, FT_Face) — cairo
-        # reads the FT face lazily; both must outlive the font face
         self._released = False
 
     def release(self) -> None:
-        """Drop the native face objects. Call after the last cairo
-        context referencing the face is destroyed — FT_New_Memory_Face
-        does NOT copy the buffer, so without this the C-side face (and
-        its parsed tables) leaks per rendered document."""
+        """Drop OUR reference to the cairo face. The FT_Face and its
+        backing buffer are freed by the user-data destroy hook when
+        cairo drops its LAST reference — which may be later than this
+        call if the scaled-font holdover cache still holds the face."""
         if self._released:
             return
         self._released = True
         c = _cairo_ft()
-        ft = _ft()
         if c is not None and self.cairo_face:
             c.cairo_font_face_destroy(self.cairo_face)
-        buf, face = self._keepalive
-        if ft is not None and face:
-            ft.FT_Done_Face(face)
-        self._keepalive = (None, None)
         self.cairo_face = None
 
     def codes(self, raw: bytes):
@@ -321,8 +360,9 @@ def load_embedded_font(doc: Any, fdict: dict) -> EmbeddedFont | None:
             widths, default = _cid_widths(doc, d0)
             cairo_face = c.cairo_ft_font_face_create_for_ft_face(
                 face, FT_LOAD_DEFAULT)
+            _bind_ft_lifetime(c, cairo_face, face, buf)
             return EmbeddedFont(cairo_face, code_to_gid, True, widths,
-                                default, (buf, face))
+                                default)
 
         descriptor = doc.resolve(fdict.get("FontDescriptor"))
         if not isinstance(descriptor, dict):
@@ -351,8 +391,9 @@ def load_embedded_font(doc: Any, fdict: dict) -> EmbeddedFont | None:
         widths, default = _simple_widths(doc, fdict)
         cairo_face = c.cairo_ft_font_face_create_for_ft_face(
             face, FT_LOAD_DEFAULT)
+        _bind_ft_lifetime(c, cairo_face, face, buf)
         return EmbeddedFont(cairo_face, code_to_gid, False, widths,
-                            default, (buf, face))
+                            default)
     except Exception as exc:  # noqa: BLE001 - hostile input; toy fallback
         logger.debug("embedded font load failed: %s", exc)
         return None

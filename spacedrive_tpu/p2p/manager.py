@@ -43,7 +43,20 @@ class P2PManager:
         self._beacon_addrs = beacon_addrs
         self._bind_host = bind_host
         self._unsubs: list[Any] = []
+        # in-flight sync-alert fan-outs: tracked so shutdown can await
+        # them — an orphaned alert coroutine cancelled at loop teardown
+        # is exactly the kind of half-sent alert production can't afford
+        self._alert_tasks: set[asyncio.Task] = set()
+        self._shutting_down = False
         self.port: int | None = None
+
+    def _spawn_alert(self, loop: asyncio.AbstractEventLoop,
+                     lib_id: uuid.UUID) -> None:
+        if self._shutting_down or not loop.is_running():
+            return
+        task = loop.create_task(self._alert_peers(lib_id))
+        self._alert_tasks.add(task)
+        task.add_done_callback(self._alert_tasks.discard)
 
     # --- lifecycle -----------------------------------------------------
 
@@ -134,9 +147,7 @@ class P2PManager:
             if event in (("SyncMessage", "Created"), ("SyncMessage", "Ingested")):
                 loop = getattr(self, "_loop", None)
                 if loop is not None and loop.is_running():
-                    loop.call_soon_threadsafe(
-                        lambda: loop.create_task(self._alert_peers(lib_id))
-                    )
+                    loop.call_soon_threadsafe(self._spawn_alert, loop, lib_id)
 
         try:
             self._loop = asyncio.get_running_loop()
@@ -222,9 +233,23 @@ class P2PManager:
             logger.warning("unhandled header type %s", header.type)
 
     async def shutdown(self) -> None:
+        self._shutting_down = True
         for unsub in self._unsubs:
             unsub()
         self._unsubs.clear()
+        if self._alert_tasks:
+            # drain in-flight alerts (don't interrupt a half-sent one);
+            # past the grace window they're cancelled. Our own
+            # cancellation propagates out of asyncio.wait untouched.
+            done, pending = await asyncio.wait(self._alert_tasks, timeout=5.0)
+            for task in pending:
+                task.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+            for task in done:
+                if not task.cancelled() and (exc := task.exception()):
+                    logger.warning("sync alert task died: %r", exc)
+        self._alert_tasks.clear()
         for actor in self.ingest_actors.values():
             await actor.stop()
         self.ingest_actors.clear()

@@ -79,6 +79,11 @@ DIAL_TIMEOUT = 15.0
 # the server evicts silent listeners as half-open after that
 CONTROL_IDLE_TIMEOUT = 120.0
 _LISTEN_CONTEXT = b"sd-relay-listen-v1"
+# inbound punch-accept caps (client side): concurrent accepts, and
+# accepts per source identity per sliding window
+PUNCH_ACCEPT_MAX = 4
+PUNCH_ACCEPT_PER_SOURCE = 4
+PUNCH_ACCEPT_WINDOW = 30.0
 
 
 async def read_frame(reader: asyncio.StreamReader) -> dict[str, Any]:
@@ -94,6 +99,34 @@ def write_frame(writer: asyncio.StreamWriter, msg: dict[str, Any]) -> None:
     writer.write(struct.pack(">I", len(data)) + data)
 
 
+class _SlidingWindow:
+    """Per-identity sliding-window rate limiter with bounded memory:
+    identities whose whole window expired are pruned once the table
+    reaches `max_idents`."""
+
+    def __init__(self, limit: int, window: float, max_idents: int = 1024):
+        self.limit = limit
+        self.window = window
+        self.max_idents = max_idents
+        self._times: dict[str, list[float]] = {}
+
+    def allow(self, ident: str) -> bool:
+        now = time.monotonic()
+        recent = [t for t in self._times.get(ident, [])
+                  if now - t < self.window]
+        if len(self._times) >= self.max_idents and ident not in self._times:
+            self._times = {
+                i: w for i, w in self._times.items()
+                if w and now - w[-1] < self.window
+            }
+        if len(recent) >= self.limit:
+            self._times[ident] = recent
+            return False
+        recent.append(now)
+        self._times[ident] = recent
+        return True
+
+
 @dataclass
 class RelayLimits:
     """Resource caps for a deployed relay (circuit-v2's role). `None`
@@ -101,6 +134,10 @@ class RelayLimits:
     max_pipes_per_target: int = 8
     max_pipes_total: int = 256
     pipe_rate_bytes_per_s: int | None = None
+    # punch coordination is cheap for the relay but triggers ~5 s of
+    # socket-binding observe+probe work at the TARGET — rate-limit it
+    # per authenticated source so one keypair can't spray a victim
+    punch_per_source_per_minute: int = 12
 
 
 @dataclass
@@ -111,6 +148,7 @@ class RelayStats:
     pipes_refused_total_cap: int = 0
     bytes_relayed: int = 0
     listener_evictions: int = 0
+    punches_refused_rate: int = 0
 
     def snapshot(self) -> dict[str, int]:
         return {k: int(v) for k, v in self.__dict__.items()}
@@ -183,6 +221,16 @@ class RelayServer:
         # observe token → (witnessed addr, monotonic time); punch
         # routing resolves addrs from here so they are relay-verified
         self._observed: dict[str, tuple[tuple[str, int], float]] = {}
+        # authenticated source identity → recent punch-request times
+        # (sliding minute window, see RelayLimits.punch_per_source_per_minute)
+        self._punch_rate = _SlidingWindow(
+            self.limits.punch_per_source_per_minute, 60.0)
+
+    def _punch_rate_ok(self, ident: str) -> bool:
+        if not self._punch_rate.allow(ident):
+            self.stats.punches_refused_rate += 1
+            return False
+        return True
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._server = await asyncio.start_server(self._handle, host, port)
@@ -322,6 +370,18 @@ class RelayServer:
                     # the addr is the one the relay WITNESSED for the
                     # carried observe token — senders cannot point
                     # probes at third parties
+                    if not self._punch_rate_ok(ident):
+                        # refused BEFORE consuming the one-shot observe
+                        # token or touching the target: an explicit error
+                        # so the dialer falls back to the relayed pipe
+                        # immediately instead of timing out
+                        write_frame(writer, {
+                            "event": "punch_addr",
+                            "conn": req.get("conn"), "ok": False,
+                            "error": "punch rate limited",
+                        })
+                        await writer.drain()
+                        continue
                     addr = self._witnessed(req.get("token"))
                     target_w = self._listeners.get(req.get("target"))
                     if target_w is None or addr is None:
@@ -498,9 +558,15 @@ class RelayClient:
         self._relay_udp: tuple[str, int] | None = None
         self._ctrl: asyncio.StreamWriter | None = None
         self._punch_waits: dict[str, asyncio.Future] = {}
+        # inbound punch-accept guard: each accept binds a socket and runs
+        # up to ~5 s of observe+probe spray, so any registered keypair
+        # could otherwise exhaust us with punch events (availability DoS)
+        self._punch_active = 0
+        self._punch_rate = _SlidingWindow(
+            PUNCH_ACCEPT_PER_SOURCE, PUNCH_ACCEPT_WINDOW, max_idents=256)
         # path-selection telemetry (surfaced via p2p.state)
         self.punch_stats = {"attempted": 0, "direct": 0, "fallback": 0,
-                            "accepted": 0}
+                            "accepted": 0, "refused": 0}
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -773,14 +839,44 @@ class RelayClient:
             raise
 
     async def _punch_accept(self, msg: dict[str, Any]) -> None:
-        """Answer a punch request: observe, return our address, open
-        simultaneously, then run the SERVER side of Noise over UDP."""
-        from . import punch
-        from .udpstream import UdpStream
-
+        """Admission control for an inbound punch event; the actual
+        observe/open/handshake work runs in `_punch_accept_inner`."""
         ctrl = self._ctrl
         if ctrl is None or self._relay_udp is None:
             return
+        # concurrency cap + per-source sliding window: dropped requests
+        # leave the dialer to fall back to the relayed pipe — bounded
+        # work here beats availability for a spraying peer. The cap
+        # covers only the observe/probe/handshake phase; the slot is
+        # released BEFORE the accepted stream is served, so long-lived
+        # inbound transfers don't starve new punches.
+        src = str(msg.get("from", ""))
+        if self._punch_active >= PUNCH_ACCEPT_MAX \
+                or not self._punch_rate.allow(src):
+            self.punch_stats["refused"] += 1
+            logger.debug("punch accept from %s refused (load)", src[:16])
+            return
+        self._punch_active += 1
+        try:
+            es = await self._punch_accept_inner(msg, ctrl)
+        finally:
+            self._punch_active -= 1
+        if es is None:
+            return
+        try:
+            await self._on_stream(es)
+        finally:
+            await es.close()
+
+    async def _punch_accept_inner(self, msg: dict[str, Any],
+                                  ctrl) -> "EncryptedStream | None":
+        """Answer an admitted punch request: observe, return our
+        address, open simultaneously, then run the SERVER side of
+        Noise over UDP. Returns the authenticated stream (served by
+        the caller, outside the concurrency slot) or None."""
+        from . import punch
+        from .udpstream import UdpStream
+
         ep = self._make_udp()
         try:
             await ep.bind()
@@ -798,11 +894,8 @@ class RelayClient:
                 DIAL_TIMEOUT,
             )
             self.punch_stats["accepted"] += 1
+            return es
         except Exception as e:  # noqa: BLE001 - inbound is best-effort
             logger.debug("punch accept failed: %s", e)
             ep.close()
-            return
-        try:
-            await self._on_stream(es)
-        finally:
-            await es.close()
+            return None

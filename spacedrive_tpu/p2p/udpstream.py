@@ -99,7 +99,11 @@ class UdpStream:
             return
         if typ not in (DATA, FIN):
             return
-        if seq >= self._recv_next and len(self._reorder) < _REORDER_CAP:
+        # the in-order segment is ALWAYS accepted — if only out-of-order
+        # segments could fill a capped buffer, a hostile peer that stuffed
+        # the reorder buffer would wedge the stream permanently
+        if seq == self._recv_next or (
+                seq > self._recv_next and len(self._reorder) < _REORDER_CAP):
             self._reorder.setdefault(seq, (typ, payload))
             while self._recv_next in self._reorder:
                 t, p = self._reorder.pop(self._recv_next)

@@ -362,6 +362,29 @@ def test_overview_favorites_recents_api(tmp_path, corpus):
             assert [n["name"] for n in rec["nodes"]] == ["beta", "alpha"]
             assert all(n["object_date_accessed"] for n in rec["nodes"])
 
+            # unfiltered dateAccessed ASC: never-accessed rows sort LAST
+            # (regression: COALESCE to '' put them first under ASC)
+            allrows = await r.exec(
+                node, "search.paths",
+                {"orderBy": "dateAccessed", "orderDir": "asc"},
+                library_id=lid,
+            )
+            accessed_flags = [bool(n["object_date_accessed"])
+                              for n in allrows["nodes"]]
+            assert accessed_flags[:2] == [True, True]
+            assert not any(accessed_flags[2:])
+            assert [n["name"] for n in allrows["nodes"][:2]] == ["alpha", "beta"]
+
+            # search.objects must agree on dateAccessed semantics
+            objs = await r.exec(
+                node, "search.objects",
+                {"orderBy": "dateAccessed", "orderDir": "asc"},
+                library_id=lid,
+            )
+            obj_flags = [bool(o.get("date_accessed")) for o in objs["nodes"]]
+            assert obj_flags[:2] == [True, True]
+            assert not any(obj_flags[2:])
+
             # job outcomes surface as persisted notifications: the
             # scan chain's terminus emitted exactly one "ok" row
             notifs = await r.exec(node, "notifications.get")

@@ -454,3 +454,98 @@ def test_punch_disabled_uses_relay():
             await srv.shutdown()
 
     asyncio.run(run())
+
+
+def test_relay_rate_limits_punch_per_source():
+    """One authenticated keypair spraying punch requests gets refused
+    past the per-source window — the victim never sees the overflow
+    (punch-accept work is ~5 s of socket spray per event, so unlimited
+    routing is an availability DoS)."""
+
+    async def run():
+        from spacedrive_tpu.p2p.relay import (
+            _LISTEN_CONTEXT, RelayLimits, read_frame, write_frame,
+        )
+
+        srv = RelayServer(RelayLimits(punch_per_source_per_minute=3))
+        port = await srv.start()
+
+        async def register(ident: Identity):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            write_frame(w, {"cmd": "listen",
+                            "identity": str(ident.to_remote_identity()),
+                            "meta": {}})
+            await w.drain()
+            ch = await read_frame(r)
+            write_frame(w, {"sig": ident.sign(
+                _LISTEN_CONTEXT + bytes.fromhex(ch["challenge"])).hex()})
+            await w.drain()
+            ok = await read_frame(r)
+            assert ok.get("ok")
+            return r, w
+
+        attacker, victim = Identity(), Identity()
+        ar, aw = await register(attacker)
+        _vr, _vw = await register(victim)
+        try:
+            errors = []
+            for i in range(5):
+                write_frame(aw, {"cmd": "punch", "conn": f"c{i}",
+                                 "target": str(victim.to_remote_identity()),
+                                 "token": "never-observed"})
+                await aw.drain()
+                resp = await asyncio.wait_for(read_frame(ar), 5)
+                assert resp.get("event") == "punch_addr"
+                assert resp.get("ok") is False
+                errors.append(resp.get("error", ""))
+            # first 3 hit the (deliberately bogus) token check; the
+            # 4th and 5th never get that far — rate limit fires first
+            assert all("token" in e for e in errors[:3])
+            assert all("rate limited" in e for e in errors[3:])
+            assert srv.stats.punches_refused_rate == 2
+        finally:
+            aw.close()
+            _vw.close()
+            await srv.shutdown()
+
+    asyncio.run(run())
+
+
+def test_client_caps_concurrent_punch_accepts():
+    """Inbound punch events beyond the concurrency cap / per-source
+    window are dropped without binding sockets or spraying probes."""
+
+    async def run():
+        from spacedrive_tpu.p2p.relay import (
+            PUNCH_ACCEPT_MAX, PUNCH_ACCEPT_PER_SOURCE,
+        )
+
+        srv, a, b, ra, rb, echoed = await _relay_pair("cone", "cone")
+        try:
+            # saturate the concurrency gate: events must bounce at the
+            # top of _punch_accept, before any endpoint is created
+            rb._punch_active = PUNCH_ACCEPT_MAX
+            made = []
+            orig_make = rb._make_udp
+            rb._make_udp = lambda: made.append(1) or orig_make()
+            await rb._punch_accept({"conn": "x", "from": "spammer",
+                                    "addr": ["127.0.0.1", 1]})
+            assert rb.punch_stats["refused"] == 1
+            assert made == []
+            rb._punch_active = 0
+
+            # per-source sliding window: burst from one identity bounces
+            # after PUNCH_ACCEPT_PER_SOURCE entries
+            import time as _time
+            now = _time.monotonic()
+            rb._punch_rate._times["spammer"] = [now] * PUNCH_ACCEPT_PER_SOURCE
+            await rb._punch_accept({"conn": "y", "from": "spammer",
+                                    "addr": ["127.0.0.1", 1]})
+            assert rb.punch_stats["refused"] == 2
+            assert made == []
+        finally:
+            await ra.shutdown()
+            await rb.shutdown()
+            await srv.shutdown()
+
+    asyncio.run(run())
