@@ -492,6 +492,530 @@ def decode_scaling(tmp: str, n_images: int) -> dict:
     }
 
 
+# --- device-clock per-stage composition ------------------------------------
+#
+# The tunnel caps host→device at ≲1.5 GB/s on a good day and 0.01–0.05
+# under shared load, so the WALL-CLOCK e2e figures above can spend a
+# whole round blocked (round 1–4 did). This mode gives configs 1/3/4/5 a
+# tunnel-independent leg: each REAL pipeline stage is measured where it
+# actually runs — host stages on the host clock, device stages as the
+# marginal cost of chained distinct-input dispatches on PRE-STAGED
+# buffers (bench.py's technique: the chain's dependent sum means the
+# marginal dispatch measures device compute, not the ~90 ms tunnel RTT)
+# — and the H2D leg is *counted in bytes* and composed at stated PCIe
+# rates a production v5e host actually has (BASELINE.md: 10–30+ GB/s
+# local PCIe vs this rig's shared tunnel).
+
+PCIE_RATES_GBPS = (8.0, 16.0, 32.0)
+
+
+def _marginal_device_s(dispatch, chain_k: int = 6, repeats: int = 3):
+    """Median marginal per-dispatch device seconds. `dispatch(i)` must
+    run on pre-staged device buffers, varying real content by `i` via a
+    jitted on-device edit (distinct inputs defeat result caching)."""
+    import jax.numpy as jnp
+
+    def chain(k: int, base: int) -> None:
+        acc = None
+        for i in range(k):
+            w = dispatch(base + i)
+            s = jnp.sum(w, dtype=jnp.float32)
+            acc = s if acc is None else acc + s
+        np.asarray(acc)
+
+    chain(chain_k, 0)  # warm/compile
+    samples = []
+    for rep in range(repeats):
+        t0 = time.perf_counter()
+        chain(1, 1_000 + rep * 31)
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        chain(chain_k, 2_000 + rep * 31)
+        tk = time.perf_counter() - t0
+        samples.append(max(1e-9, (tk - t1) / (chain_k - 1)))
+    med, lo, hi = median_spread(samples)
+    if med < 2e-4 and chain_k < 64:
+        # sub-200 µs dispatches (tiny batches) drown in chain noise —
+        # re-measure with a longer chain so the marginal resolves
+        return _marginal_device_s(dispatch, chain_k=chain_k * 8,
+                                  repeats=repeats)
+    return med, lo, hi
+
+
+def _compose(host_s: float, h2d_bytes: int, device_s: float,
+             n_items: int, tunnel_gbps: float) -> dict:
+    """Per-PCIe-rate composition of measured stages. Two models:
+    - serial: every stage waits for the previous (lower bound);
+    - pipelined: the production WindowPipeline keeps PIPELINE_DEPTH
+      windows in flight, so steady-state cost/window = max(host leg,
+      H2D leg, device leg) — host stages serialize with each other on
+      this 1-core host but overlap device work (worker threads)."""
+    out = {}
+    rates = dict.fromkeys(PCIE_RATES_GBPS)
+    if tunnel_gbps > 0:
+        rates[None] = tunnel_gbps  # measured-tunnel context row
+    for rate in rates:
+        gbps = tunnel_gbps if rate is None else rate
+        h2d_s = h2d_bytes / (gbps * 1e9)
+        serial = host_s + h2d_s + device_s
+        pipelined = max(host_s, h2d_s, device_s)
+        # the north-star host is 16-core: its host stages (reads,
+        # decode, pack, DB) parallelize across cores, this rig's can't
+        host16 = max(host_s / CPU_BASELINE_CORES, h2d_s, device_s)
+        key = "tunnel_measured" if rate is None else f"pcie_{int(rate)}GBps"
+        out[key] = {
+            "h2d_s": round(h2d_s, 3),
+            "serial_items_per_s": round(n_items / serial, 1),
+            "pipelined_items_per_s": round(n_items / pipelined, 1),
+            "pipelined_host16_projected_items_per_s": round(
+                n_items / host16, 1),
+        }
+    return out
+
+
+def compose_config1(tmp: str, n_files: int, probes: dict) -> dict:
+    """Identifier pass, per-stage: sampled disk reads + message
+    assembly (host) → canonical batch pack (host) → H2D bytes →
+    device BLAKE3 (marginal, staged) → object link/DB write (host,
+    from a REAL CPU-backend scan's run_metadata)."""
+    import jax
+
+    from spacedrive_tpu.ops import blake3_jax, cas
+
+    log(f"compose config 1: {n_files} mixed files…")
+    corpus = os.path.join(tmp, "corpusC1")
+    build_mixed_corpus(corpus, n_files)
+    paths = sorted(
+        (os.path.join(corpus, f), os.stat(os.path.join(corpus, f)).st_size)
+        for f in os.listdir(corpus)
+    )
+
+    # stage: disk read + message assembly (the identifier's
+    # _fetch_window read leg, same cas.read_message calls)
+    t0 = time.perf_counter()
+    msgs = []
+    for p, s in paths:
+        if s > 0:
+            msgs.append(cas.read_message(p, s))
+    read_s = time.perf_counter() - t0
+    msg_bytes = sum(len(m) for m in msgs)
+
+    # stage: canonical batch pack (cas_ids_begin's bucketing + pack)
+    t0 = time.perf_counter()
+    buckets: dict[int, list[bytes]] = {}
+    for m in msgs:
+        c = (cas.LARGE_CHUNKS if len(m) == cas.LARGE_MSG_LEN
+             else cas._bucket_for(len(m)))
+        buckets.setdefault(c, []).append(m)
+    batches = []
+    for c, ms in sorted(buckets.items()):
+        for off in range(0, len(ms), cas.DEVICE_BATCH):
+            arr, lens = cas.pack_canonical_batch(ms[off:off + cas.DEVICE_BATCH], c)
+            batches.append((arr, lens, c))
+    pack_s = time.perf_counter() - t0
+    h2d_bytes = sum(a.nbytes for a, _l, _c in batches)
+
+    # stage: device compute — marginal on the staged hot bucket; other
+    # buckets are charged at the same measured GB/s (PROFILE.md: the
+    # rate is flat from batch 512 up)
+    hot = max(batches, key=lambda b: b[0].nbytes)
+    arr, lens, chunks = hot
+    a_dev = jax.device_put(arr.view(np.uint32))
+    l_dev = jax.device_put(lens)
+    jax.block_until_ready(a_dev)
+    freshen = jax.jit(lambda a, t: a.at[:, 4].set(t))
+
+    staged = [a_dev]
+
+    def dispatch(i):
+        staged[0] = freshen(staged[0], np.uint32(i % 251))
+        return blake3_jax.hash_batch(staged[0], l_dev, max_chunks=chunks)
+
+    dev_med, dev_lo, dev_hi = _marginal_device_s(dispatch)
+    dev_gbps = arr.nbytes / dev_med / 1e9
+    device_s = h2d_bytes / (dev_gbps * 1e9)
+
+    # stage: DB write — run the REAL identifier job (CPU backend: host
+    # hashing, so the tunnel can't pollute it) and take its db_time
+    data_dir = os.path.join(tmp, "node-compose1")
+    scan = asyncio.run(run_scan(data_dir, corpus, use_device=False,
+                                backend="cpu"))
+    shutil.rmtree(data_dir, ignore_errors=True)
+    db_s = float(scan["identifier_meta"].get("db_time") or 0.0)
+
+    host_s = read_s + pack_s + db_s
+    probes["pre"] = probes["post"] = round(probe_link(0), 3)
+    result = {
+        "name": "config1 identifier pass, device-clock composition",
+        "files": len(paths),
+        "stages": {
+            "disk_read_assemble_s": round(read_s, 3),
+            "pack_s": round(pack_s, 3),
+            "h2d_bytes": h2d_bytes,
+            "message_bytes": msg_bytes,
+            "device_compute_s": round(device_s, 4),
+            "device_dispatch_spread_s": [round(dev_lo, 5), round(dev_med, 5),
+                                         round(dev_hi, 5)],
+            "device_gbps": round(dev_gbps, 1),
+            "db_write_s": round(db_s, 3),
+        },
+        "composition": _compose(host_s, h2d_bytes, device_s, len(paths),
+                                probes["pre"]),
+        "assumptions": [
+            "device GB/s measured on the hot bucket via chained "
+            "distinct-input dispatches (staged buffers, on-device "
+            "freshening); other buckets charged at the same rate "
+            "(PROFILE.md: flat from batch 512)",
+            "H2D counts the padded canonical batches (the u32 view "
+            "transfers exactly these bytes)",
+            "db_write_s from a real CPU-backend FileIdentifierJob "
+            "run_metadata on the same corpus",
+            "host stages measured on this 1-core host; the 16-core "
+            "north-star host parallelizes them",
+        ],
+    }
+    log(f"  read {read_s:.2f}s pack {pack_s:.2f}s db {db_s:.2f}s "
+        f"device {device_s*1e3:.1f}ms ({dev_gbps:.0f} GB/s) "
+        f"h2d {h2d_bytes/1e6:.0f} MB")
+    return result
+
+
+def _compose_thumbs(decoded, probes: dict, name: str, n_items: int,
+                    decode_s: float) -> dict:
+    """Shared config-3/4 composition: canvas pack (host) → H2D bytes →
+    device resize (marginal, staged) → webp encode + store (host)."""
+    import jax
+
+    from spacedrive_tpu.object.media.thumbnail import process as tp
+    from spacedrive_tpu.ops import thumbnail_jax as tj
+
+    # stage: canvas pack — resize_batch's host leg, replicated with the
+    # same bucketing so the packed bytes equal production's
+    t0 = time.perf_counter()
+    groups: dict[tuple[int, int], list] = {}
+    for d in decoded:
+        h, w = d.array.shape[:2]
+        b = tj.bucket_for(h, w)
+        groups.setdefault(b, []).append(d)
+    canvases = []
+    for (bh, bw), ds in groups.items():
+        bpad = 1 << max(0, (len(ds) - 1).bit_length())
+        canv = np.zeros((bpad, bh, bw, 4), np.uint8)
+        scales = np.ones((bpad, 2), np.float32)
+        for j, d in enumerate(ds):
+            img, (th, tw) = d.array, d.target
+            if bh < bw and img.shape[0] > img.shape[1]:
+                img = np.transpose(img, (1, 0, 2))
+                th, tw = tw, th
+            h, w = img.shape[:2]
+            canv[j, :h, :w] = img
+            scales[j] = (th / h, tw / w)
+        canvases.append((canv, scales))
+    pack_s = time.perf_counter() - t0
+    h2d_bytes = sum(c.nbytes for c, _s in canvases)
+
+    # stage: device resize — marginal on the staged biggest group
+    canv, scales = max(canvases, key=lambda g: g[0].nbytes)
+    c_dev = jax.device_put(canv)
+    s_dev = jax.device_put(scales)
+    jax.block_until_ready(c_dev)
+    freshen = jax.jit(lambda a, t: a.at[:, 0, 0, 0].set(t))
+    staged = [c_dev]
+
+    def dispatch(i):
+        staged[0] = freshen(staged[0], np.uint8(i % 251))
+        return tj._resize_fn()(staged[0], s_dev, out_size=tj.OUT_CANVAS)
+
+    dev_med, dev_lo, dev_hi = _marginal_device_s(dispatch)
+    dev_gbps = canv.nbytes / dev_med / 1e9
+    device_s = h2d_bytes / (dev_gbps * 1e9)
+
+    # stage: webp encode + store (host) — production finish() on real
+    # resized output
+    resized = tp.resize_decoded(decoded)
+    t0 = time.perf_counter()
+    blobs = [tp.finish(d, r) for d, r in zip(decoded, resized)]
+    encode_s = time.perf_counter() - t0
+    store_dir = tempfile.mkdtemp(prefix="sd-thumbs-")
+    t0 = time.perf_counter()
+    for i, b in enumerate(blobs):
+        with open(os.path.join(store_dir, f"{i}.webp"), "wb") as f:
+            f.write(b)
+    store_s = time.perf_counter() - t0
+    shutil.rmtree(store_dir, ignore_errors=True)
+
+    host_s = decode_s + pack_s + encode_s + store_s
+    probes["pre"] = probes["post"] = round(probe_link(0), 3)
+    result = {
+        "name": name,
+        "items": n_items,
+        "stages": {
+            "decode_s": round(decode_s, 3),
+            "pack_s": round(pack_s, 3),
+            "h2d_bytes": h2d_bytes,
+            "device_resize_s": round(device_s, 4),
+            "device_dispatch_spread_s": [round(dev_lo, 5), round(dev_med, 5),
+                                         round(dev_hi, 5)],
+            "device_gbps": round(dev_gbps, 1),
+            "webp_encode_s": round(encode_s, 3),
+            "store_s": round(store_s, 3),
+        },
+        "composition": _compose(host_s, h2d_bytes, device_s, n_items,
+                                probes["pre"]),
+        "assumptions": [
+            "decode/encode measured through the production decode()/"
+            "finish() paths on this 1-core host (parallelizes across "
+            "cores on the north-star host — see decode_scaling)",
+            "device GB/s measured on the staged biggest canvas group; "
+            "smaller groups charged at the same rate",
+        ],
+    }
+    log(f"  decode {decode_s:.2f}s pack {pack_s:.2f}s encode {encode_s:.2f}s "
+        f"device {device_s*1e3:.1f}ms ({dev_gbps:.0f} GB/s)")
+    return result
+
+
+def compose_config3(tmp: str, n_images: int, probes: dict) -> dict:
+    from spacedrive_tpu.object.media.thumbnail import process as tp
+
+    log(f"compose config 3: {n_images} JPEGs…")
+    corpus = os.path.join(tmp, "corpusC3")
+    build_image_corpus(corpus, n_images)
+    paths = sorted(os.path.join(corpus, f) for f in os.listdir(corpus))
+    tp.decode(paths[0], "jpg")  # warm imports
+    t0 = time.perf_counter()
+    decoded = [tp.decode(p, "jpg") for p in paths]
+    decode_s = time.perf_counter() - t0
+    return _compose_thumbs(
+        decoded, probes,
+        "config3 JPEG thumbnails, device-clock composition",
+        len(paths), decode_s,
+    )
+
+
+def compose_config4(tmp: str, n_clips: int, probes: dict) -> dict:
+    from spacedrive_tpu.object.media.thumbnail import process as tp
+
+    log(f"compose config 4: {n_clips} clips…")
+    corpus = os.path.join(tmp, "corpusC4")
+    build_video_corpus(corpus, n_clips)
+    paths = sorted(os.path.join(corpus, f) for f in os.listdir(corpus))
+    tp.decode(paths[0], "mp4")  # warm the native decoder
+    t0 = time.perf_counter()
+    decoded = [tp.decode(p, "mp4") for p in paths]
+    decode_s = time.perf_counter() - t0
+    return _compose_thumbs(
+        decoded, probes,
+        "config4 video thumbnails, device-clock composition",
+        len(paths), decode_s,
+    )
+
+
+def compose_config5(tmp: str, n_images: int, probes: dict) -> dict:
+    """Dedup, per-stage: decode+gray (host) → H2D gray/bits bytes →
+    device pHash + blockwise Hamming (both marginal, staged)."""
+    import jax
+
+    from PIL import Image
+
+    from spacedrive_tpu.ops import phash_jax
+
+    log(f"compose config 5: {n_images} images…")
+    corpus = os.path.join(tmp, "corpusC5")
+    build_image_corpus(corpus, n_images)
+    paths = sorted(os.path.join(corpus, f) for f in os.listdir(corpus))
+
+    t0 = time.perf_counter()
+    grays = []
+    for p in paths:
+        arr = np.asarray(Image.open(p).convert("RGBA"))
+        grays.append(phash_jax.to_gray32(arr))
+    decode_s = time.perf_counter() - t0
+    gray = np.stack(grays)
+
+    # device pHash, marginal on the staged gray batch
+    g_dev = jax.device_put(gray)
+    jax.block_until_ready(g_dev)
+    freshen_g = jax.jit(lambda a, t: a.at[:, 0, 0].set(t))
+    staged_g = [g_dev]
+
+    def dispatch_phash(i):
+        staged_g[0] = freshen_g(staged_g[0], np.float32((i % 251) / 251.0))
+        return phash_jax._phash_fn()(staged_g[0])
+
+    ph_med, ph_lo, ph_hi = _marginal_device_s(dispatch_phash)
+
+    # device Hamming: blockwise thresholded sweep over n_hashes, as
+    # near_pairs runs it, marginal per block on staged bits
+    n_hashes = int(os.environ.get("SD_E2E_HASHES", "8192"))
+    bits_small = np.asarray(phash_jax._phash_fn()(gray))
+    rng = np.random.default_rng(15)
+    big = bits_small[rng.integers(0, bits_small.shape[0], n_hashes)]
+    big = big ^ (rng.random(big.shape) < 0.2)
+    pad = (-n_hashes) % phash_jax.PAIR_BLOCK
+    padded = np.concatenate(
+        [big, np.ones((pad, phash_jax.HASH_BITS), bool)]) if pad else big
+    b_dev = jax.device_put(padded)
+    rows_dev = jax.device_put(padded[: phash_jax.PAIR_BLOCK])
+    thr = jax.device_put(np.uint8(10))
+    jax.block_until_ready(b_dev)
+    freshen_b = jax.jit(lambda a, t: a.at[:, 0].set(t))
+    staged_b = [rows_dev]
+
+    def dispatch_block(i):
+        staged_b[0] = freshen_b(staged_b[0], bool(i % 2))
+        return phash_jax._block_fn()(staged_b[0], b_dev, thr)
+
+    hb_med, hb_lo, hb_hi = _marginal_device_s(dispatch_block)
+    n_blocks = (n_hashes + phash_jax.PAIR_BLOCK - 1) // phash_jax.PAIR_BLOCK
+    hamming_s = hb_med * n_blocks
+    pairs = n_hashes * n_hashes
+
+    h2d_bytes = gray.nbytes + padded.nbytes
+    # readback: the packed match bitmap (n_blocks × PAIR_BLOCK × padded/8)
+    d2h_bytes = n_blocks * phash_jax.PAIR_BLOCK * (padded.shape[0] // 8)
+    device_s = ph_med + hamming_s
+    probes["pre"] = probes["post"] = round(probe_link(0), 3)
+    result = {
+        "name": "config5 dedup pHash + Hamming, device-clock composition",
+        "images": len(paths),
+        "hamming_n": n_hashes,
+        "stages": {
+            "decode_gray_s": round(decode_s, 3),
+            "h2d_bytes": h2d_bytes,
+            "d2h_bitmap_bytes": d2h_bytes,
+            "device_phash_s": [round(ph_lo, 5), round(ph_med, 5),
+                               round(ph_hi, 5)],
+            "device_hamming_s_per_block": [round(hb_lo, 5), round(hb_med, 5),
+                                           round(hb_hi, 5)],
+            "device_s_total": round(device_s, 4),
+            "device_mpairs_per_s": round(pairs / hamming_s / 1e6, 1),
+        },
+        "composition": _compose(decode_s, h2d_bytes + d2h_bytes, device_s,
+                                len(paths), probes["pre"]),
+        "assumptions": [
+            "Hamming sweep = per-block marginal × block count (blocks "
+            "are independent identical dispatches)",
+            "transfer leg counts H2D gray+bits AND the packed bitmap "
+            "readback at the same stated rate",
+        ],
+    }
+    log(f"  decode {decode_s:.2f}s phash {ph_med*1e3:.2f}ms/batch "
+        f"hamming {hb_med*1e3:.2f}ms/block × {n_blocks} "
+        f"→ {pairs / hamming_s / 1e6:,.0f} Mpairs/s")
+    return result
+
+
+def run_composition(tmp: str, n_files: int, n_images: int,
+                    n_clips: int) -> dict:
+    out: dict = {
+        "note": (
+            "tunnel-independent projection: host stages on the host "
+            "clock, device stages as marginal chained-dispatch cost on "
+            "staged buffers, H2D composed at stated PCIe rates "
+            "(production v5e hosts: 10–30+ GB/s local PCIe; this rig's "
+            "shared tunnel swings 0.01–1.6 GB/s). 'pipelined' = "
+            "steady-state max(host, H2D, device) per the production "
+            "WindowPipeline; 'serial' = no overlap (lower bound)."
+        ),
+    }
+    for key, fn, args in (
+        ("config1", compose_config1, (tmp, n_files)),
+        ("config3", compose_config3, (tmp, n_images)),
+        ("config4", compose_config4, (tmp, n_clips)),
+        ("config5", compose_config5, (tmp, n_images)),
+    ):
+        try:
+            # NOT routed through probed(): host/device-clock stages are
+            # tunnel-independent by construction, so congestion gives
+            # context (the tunnel_measured row), never a blocked flag
+            probes: dict = {}
+            result = fn(*args, probes)
+            result["link_probe_gbps"] = probes
+            out[key] = result
+        except Exception as e:  # noqa: BLE001 - one config must not kill the rest
+            log(f"  composition {key} FAILED: {e!r}")
+            out[key] = {"error": repr(e)}
+    return out
+
+
+# --- calm-window watcher + attempt log -------------------------------------
+
+ATTEMPTS_PATH = "BENCH_E2E_attempts.jsonl"
+
+
+def append_attempt(record: dict) -> None:
+    record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **record}
+    with open(ATTEMPTS_PATH, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def attempt_summary() -> dict | None:
+    """Fold the round's probe/run attempts into the artifact, so 'no
+    calm window existed' is itself evidenced."""
+    if not os.path.exists(ATTEMPTS_PATH):
+        return None
+    rows = []
+    with open(ATTEMPTS_PATH) as f:
+        for line in f:
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+    if not rows:
+        return None
+    probes = [r["gbps"] for r in rows if "gbps" in r]
+    return {
+        "attempts": len(rows),
+        "first": rows[0].get("ts"),
+        "last": rows[-1].get("ts"),
+        "probe_gbps_min": round(min(probes), 3) if probes else None,
+        "probe_gbps_max": round(max(probes), 3) if probes else None,
+        "calm_probes": sum(1 for g in probes if g >= CONGESTION_GBPS),
+        "full_runs": sum(1 for r in rows if r.get("event") == "full-run"),
+    }
+
+
+def watch_main() -> None:
+    """SD_E2E_WATCH mode: probe the link on an interval all round,
+    logging every attempt; launch the FULL recording (subprocess, so
+    keep-best applies) whenever a calm window appears. A lockfile
+    (SD_TPU_LOCK) pauses probing while something else owns the chip."""
+    interval = float(os.environ.get("SD_E2E_WATCH_INTERVAL", "600"))
+    lock = os.environ.get("SD_TPU_LOCK", "/tmp/sd_tpu_busy")
+    max_runs = int(os.environ.get("SD_E2E_WATCH_MAX_RUNS", "3"))
+    runs = 0
+    log(f"calm-window watcher: probing every {interval:.0f}s "
+        f"(lockfile {lock}, max {max_runs} full runs)")
+    while True:
+        if os.path.exists(lock):
+            append_attempt({"event": "skipped", "reason": "tpu-lock"})
+        else:
+            try:
+                g = probe_link(0)
+            except Exception as e:  # noqa: BLE001 - probe must never kill the watch
+                append_attempt({"event": "probe-error", "error": repr(e)})
+                g = 0.0
+            append_attempt({"event": "probe", "gbps": round(g, 3)})
+            if g >= CONGESTION_GBPS and runs < max_runs:
+                log(f"calm window ({g:.2f} GB/s) — launching full recording")
+                append_attempt({"event": "full-run", "gbps": round(g, 3)})
+                import subprocess
+
+                env = dict(os.environ)
+                env.pop("SD_E2E_WATCH", None)
+                r = subprocess.run(
+                    [sys.executable, __file__], env=env,
+                    stdout=subprocess.DEVNULL,
+                )
+                append_attempt({"event": "full-run-done",
+                                "returncode": r.returncode})
+                runs += 1
+                if runs >= max_runs:
+                    log("watcher: max full runs recorded; probe-only now")
+        time.sleep(interval)
+
+
 # --- artifact discipline ---------------------------------------------------
 
 CONFIG_METRICS = {
@@ -546,7 +1070,8 @@ def main() -> None:
     from spacedrive_tpu.ops import configure_compilation_cache
 
     configure_compilation_cache()
-    which = os.environ.get("SD_E2E_CONFIGS", "1,3,4,5,decode").split(",")
+    which = os.environ.get(
+        "SD_E2E_CONFIGS", "compose,1,3,4,5,decode").split(",")
     n_files = int(os.environ.get("SD_E2E_FILES", "10000"))
     n_images = int(os.environ.get("SD_E2E_IMAGES", "256"))
     n_clips = int(os.environ.get("SD_E2E_CLIPS", "8"))
@@ -569,6 +1094,12 @@ def main() -> None:
         # one bounded wait up front for a calm window; per-config probes
         # then record what the link actually was during each config
         results["link_probe_gbps"] = round(probe_link(), 3)
+        append_attempt({"event": "recording-start",
+                        "gbps": results["link_probe_gbps"],
+                        "configs": ",".join(which)})
+        if "compose" in which:
+            results["device_clock_composition"] = run_composition(
+                tmp, min(n_files, 4096), min(n_images, 128), n_clips)
         if "1" in which:
             results["config1"] = probed(config_1, tmp, n_files, repeats)
         if "3" in which:
@@ -590,8 +1121,19 @@ def main() -> None:
                 prev = json.load(f)
         except Exception:
             prev = None
+    # partial runs (SD_E2E_CONFIGS subsets) must not clobber sections a
+    # previous recording earned: carry forward what this run didn't do
+    carried = []
+    if prev:
+        for key in (*CONFIG_METRICS, "decode_scaling",
+                    "device_clock_composition"):
+            if key not in results and key in prev:
+                results[key] = prev[key]
+                carried.append(key)
+    results["carried_from_previous"] = carried or None
     notes = regression_notes(results, prev)
     results["regression_notes"] = notes or None
+    results["attempt_log"] = attempt_summary()
 
     doc = json.dumps(results, indent=2)
     # keep-best: never let a congested re-run clobber a calm artifact
@@ -608,4 +1150,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("SD_E2E_WATCH") == "1":
+        watch_main()
+    else:
+        main()

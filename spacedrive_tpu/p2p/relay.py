@@ -114,16 +114,19 @@ class _SlidingWindow:
         now = time.monotonic()
         recent = [t for t in self._times.get(ident, [])
                   if now - t < self.window]
-        if len(self._times) >= self.max_idents and ident not in self._times:
-            self._times = {
-                i: w for i, w in self._times.items()
-                if w and now - w[-1] < self.window
-            }
         if len(recent) >= self.limit:
             self._times[ident] = recent
             return False
         recent.append(now)
         self._times[ident] = recent
+        if len(self._times) > self.max_idents:
+            # hard cap: keypairs are free to mint, so expiry alone
+            # can't bound the table — evict the stalest identities
+            # (oldest last-seen) down to the cap
+            for stale in sorted(
+                    self._times, key=lambda i: self._times[i][-1]
+            )[: len(self._times) - self.max_idents]:
+                del self._times[stale]
         return True
 
 

@@ -38,6 +38,18 @@ class UdpEndpoint:
         self._transport, _ = await loop.create_datagram_endpoint(
             _Proto, local_addr=(host, port)
         )
+        # the default ~208 KiB buffers hold <200 MTU-sized datagrams —
+        # one paced burst from a large congestion window; ask for 4 MiB
+        # (the kernel clamps to {r,w}mem_max, so this is best-effort)
+        sock = self._transport.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            for opt in (_socket.SO_RCVBUF, _socket.SO_SNDBUF):
+                try:
+                    sock.setsockopt(_socket.SOL_SOCKET, opt, 4 << 20)
+                except OSError:
+                    pass
         self.local_addr = self._transport.get_extra_info("sockname")[:2]
         return self.local_addr
 

@@ -242,3 +242,137 @@ def test_cacophony_vectors():
             assert ct.hex() == msg["ciphertext"], f"transport message {idx}"
         ran += 1
     assert ran > 0, "no XX/25519/ChaChaPoly/SHA256 vectors in corpus"
+
+
+# --- transcript pinning + independent cross-implementation ----------------
+#
+# The published cacophony/snow vector corpus cannot be vendored (zero
+# egress), so two defenses stand in until it can (VERDICT r4 #6):
+# 1. a PINNED full-handshake transcript from fixed keys — any silent
+#    KDF/ordering/nonce regression in our implementation trips it;
+# 2. an INDEPENDENT straight-line XX implementation below (written
+#    from spec §5/§7.5 with none of the production code's structure)
+#    must produce byte-identical messages — a deviation that is
+#    self-consistent inside the state machine still has to agree with
+#    a second from-spec derivation.
+
+_PIN_M1 = "0faa684ed28867b97f4a6a2dee5df8ce974e76b7018e3f22a1c4cf2678570f20"
+_PIN_M2 = (
+    "ff2ee45601ec1b67310c7790404585ae697331eee1c1f8cf2419731c1fff3e6b"
+    "5cda1c2d8029877d73fad62823946ccd0c5da35c129100f43d33a59cf19ea8fc"
+    "aded90742efc635ff7e5865f706b2b6a8ff44261f2e570acb78f5db7abfff065"
+    "74d3d59310fb18ac4f875475"
+)
+_PIN_M3 = (
+    "f4e4988e97bdcbf0f799d02dd2242624bda72d200e97e322c4f723213896a31e"
+    "6addf0834abd1e778afc4aa0bf69452e926339ba70fe4c74f8559dabbce2604b"
+    "c5f9ea2ebcdbe3f5408f5e15"
+)
+_PIN_HH = "c339ecf420ac4b9337f4dd1c083cf2837eeda9794c9f9eca609516d9c830b8d5"
+_PIN_T1 = ("412fcad3f556a5e5258dacc7b3507a2fe4ccd8f3264efeb5a55f27d1"
+           "acc7f451124bcbbde14b")
+
+
+def _fixed_key(byte: int):
+    return X25519PrivateKey.from_private_bytes(bytes([byte]) * 32)
+
+
+def test_transcript_pinned():
+    """Fixed statics/ephemerals → the full XX transcript, handshake
+    hash, and first transport record are pinned byte-for-byte."""
+    i = HandshakeState(True, _fixed_key(0x11), prologue=b"sdx-pin",
+                       e=_fixed_key(0x22))
+    r = HandshakeState(False, _fixed_key(0x33), prologue=b"sdx-pin",
+                       e=_fixed_key(0x44))
+    m1 = i.write_message(b"")
+    r.read_message(m1)
+    m2 = r.write_message(b"resp-payload")
+    i.read_message(m2)
+    m3 = i.write_message(b"init-payload")
+    r.read_message(m3)
+    assert m1.hex() == _PIN_M1
+    assert m2.hex() == _PIN_M2
+    assert m3.hex() == _PIN_M3
+    hh = i.handshake_hash
+    hh = hh() if callable(hh) else hh
+    assert hh.hex() == _PIN_HH
+    ci_send, _ci_recv = i.split()
+    assert ci_send.encrypt_with_ad(
+        b"", b"first-transport-record").hex() == _PIN_T1
+
+
+def test_independent_straightline_xx_agrees():
+    """A second, structurally unrelated XX derivation (straight-line
+    code, its own HKDF/cipher plumbing) reproduces the same pinned
+    transcript from the same fixed keys."""
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    name = b"Noise_XX_25519_ChaChaPoly_SHA256"
+    h = name + b"\x00" * (32 - len(name)) if len(name) <= 32 \
+        else hashlib.sha256(name).digest()
+    ck = h
+
+    def mix_hash(h, data):
+        return hashlib.sha256(h + data).digest()
+
+    def hkdf2(ck, ikm):
+        tk = hmac.new(ck, ikm, hashlib.sha256).digest()
+        o1 = hmac.new(tk, b"\x01", hashlib.sha256).digest()
+        o2 = hmac.new(tk, o1 + b"\x02", hashlib.sha256).digest()
+        return o1, o2
+
+    def pub(priv):
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat,
+        )
+        return priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+
+    def dh(priv, pub_raw):
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PublicKey,
+        )
+        return priv.exchange(X25519PublicKey.from_public_bytes(pub_raw))
+
+    def enc(k, n, ad, pt):
+        nonce = b"\x00\x00\x00\x00" + n.to_bytes(8, "little")
+        return ChaCha20Poly1305(k).encrypt(nonce, pt, ad)
+
+    si, ei = _fixed_key(0x11), _fixed_key(0x22)
+    sr, er = _fixed_key(0x33), _fixed_key(0x44)
+    h = mix_hash(h, b"sdx-pin")  # prologue
+
+    # -> e   (no key yet: payload in the clear)
+    h = mix_hash(h, pub(ei))
+    m1 = pub(ei) + b""
+    h = mix_hash(h, b"")
+    assert m1.hex() == _PIN_M1
+
+    # <- e, ee, s, es  + enc(payload)
+    h = mix_hash(h, pub(er))
+    ck, k = hkdf2(ck, dh(er, pub(ei)))          # ee (responder side)
+    n = 0
+    c_s = enc(k, n, h, pub(sr)); n += 1
+    h = mix_hash(h, c_s)
+    ck, k = hkdf2(ck, dh(sr, pub(ei)))          # es (responder: DH(s, re))
+    n = 0
+    c_p = enc(k, n, h, b"resp-payload")
+    h = mix_hash(h, c_p)
+    m2 = pub(er) + c_s + c_p
+    assert m2.hex() == _PIN_M2
+
+    # -> s, se  + enc(payload)
+    n = 1
+    c_s2 = enc(k, n, h, pub(si))
+    h = mix_hash(h, c_s2)
+    ck, k = hkdf2(ck, dh(si, pub(er)))          # se (initiator: DH(s, re))
+    n = 0
+    c_p2 = enc(k, n, h, b"init-payload")
+    h = mix_hash(h, c_p2)
+    m3 = c_s2 + c_p2
+    assert m3.hex() == _PIN_M3
+    assert h.hex() == _PIN_HH
+
+    # split: k1 (initiator→responder), first transport record
+    tk = hmac.new(ck, b"", hashlib.sha256).digest()
+    k1 = hmac.new(tk, b"\x01", hashlib.sha256).digest()
+    assert enc(k1, 0, b"", b"first-transport-record").hex() == _PIN_T1
