@@ -49,6 +49,7 @@ def mount() -> Router:
     _p2p(r)
     _nodes(r)
     _volumes(r)
+    _keys(r)
     _preferences(r)
     _notifications(r)
     _backups(r)
@@ -1257,6 +1258,110 @@ def _volumes(r: Router) -> None:
     @r.mutation("volumes.track", library=True)
     def track(node, library):
         return save_volumes(library.db)
+
+
+def _key_manager(library):
+    """Per-library crypto vault (ref:core/src/api/keys.rs — the
+    KeyManager the reference's KeyManager/ UI drives). The keystore
+    file lives next to the library database."""
+    km = getattr(library, "key_manager", None)
+    if km is None:
+        from ..crypto.keys import KeyManager
+
+        path = library.db.path
+        store = (path[: -len(".db")] if path.endswith(".db") else path) \
+            + ".keystore"
+        km = KeyManager(store)
+        library.key_manager = km
+    return km
+
+
+def _keys(r: Router) -> None:
+    from ..crypto.keys import CryptoError
+
+    def guard(fn, *a):
+        try:
+            return fn(*a)
+        except CryptoError as e:
+            raise RspcError.bad_request(str(e))
+
+    @r.query("keys.state", library=True)
+    def state(node, library):
+        km = _key_manager(library)
+        mounted = set(km.mounted_uuids())
+        return {
+            "unlocked": km.unlocked,
+            "keys": [
+                {"uuid": sk.uuid, "automount": sk.automount,
+                 "algorithm": int(sk.algorithm),
+                 "mounted": sk.uuid in mounted}
+                for sk in km.stored.values()
+            ],
+        }
+
+    @r.mutation("keys.unlock", library=True)
+    def unlock(node, library, arg):
+        km = _key_manager(library)
+        km.set_master_password(str(arg["password"]).encode())
+        if km.stored:
+            # VERIFY before committing: decrypting any stored key proves
+            # the password. Accepting it unchecked would let a typo'd
+            # password "unlock" the vault and encrypt NEW keys under the
+            # typo — a keystore needing two different passwords.
+            probe = next(iter(km.stored))
+            try:
+                km.mount(probe)
+                km.unmount(probe)
+            except CryptoError:
+                km.lock()
+                invalidate_query(node, "keys.state", library)
+                raise RspcError.bad_request("wrong master password")
+        mounted = guard(km.automount)
+        invalidate_query(node, "keys.state", library)
+        return {"automounted": mounted}
+
+    @r.mutation("keys.lock", library=True)
+    def lock(node, library):
+        _key_manager(library).lock()
+        invalidate_query(node, "keys.state", library)
+        return None
+
+    @r.mutation("keys.add", library=True)
+    def add(node, library, arg):
+        import secrets as _secrets
+
+        arg = arg or {}
+        km = _key_manager(library)
+        if arg.get("material"):
+            try:
+                material = bytes.fromhex(arg["material"])
+            except ValueError:
+                raise RspcError.bad_request("material must be hex")
+        else:
+            material = _secrets.token_bytes(32)
+        key_uuid = guard(
+            lambda: km.add_key(material,
+                               automount=bool(arg.get("automount"))))
+        invalidate_query(node, "keys.state", library)
+        return {"uuid": key_uuid}
+
+    @r.mutation("keys.mount", library=True)
+    def mount(node, library, arg):
+        guard(_key_manager(library).mount, str(arg))
+        invalidate_query(node, "keys.state", library)
+        return None
+
+    @r.mutation("keys.unmount", library=True)
+    def unmount(node, library, arg):
+        guard(_key_manager(library).unmount, str(arg))
+        invalidate_query(node, "keys.state", library)
+        return None
+
+    @r.mutation("keys.delete", library=True)
+    def delete(node, library, arg):
+        guard(_key_manager(library).delete_key, str(arg))
+        invalidate_query(node, "keys.state", library)
+        return None
 
 
 def _preferences(r: Router) -> None:

@@ -79,6 +79,7 @@ function renderRoutes() {
   route("🏠 " + t("overview"), "overview");
   route("★ " + t("favorites"), "favorites");
   route("🕘 " + t("recents"), "recents");
+  route("🌐 " + t("network"), "network");
 }
 
 async function refreshNav() {
@@ -107,6 +108,25 @@ async function refreshNav() {
     droppable(item, () => guardTarget(n.id, "/"));
     locDiv.appendChild(item);
   }
+  // "This device" volumes → ephemeral (non-indexed) browse
+  // (ref:interface/app/$libraryId/ephemeral.tsx via the sidebar)
+  try {
+    const vols = await client.volumes.list();
+    const volDiv = $("volumes");
+    volDiv.innerHTML = "";
+    for (const v of vols) {
+      const item = el("div", "item", "💻 " + (v.name || v.mount_point));
+      item.onclick = () => { setActive(item);
+        Object.assign(state, {mode: "ephemeral", ephPath: v.mount_point,
+                              ephRoot: v.mount_point,
+                              ephRootName: v.name || v.mount_point,
+                              loc: null, tag: null, cursor: null});
+        clearSelection();
+        loadContent(true); };
+      volDiv.appendChild(item);
+    }
+  } catch { /* volumes are best-effort chrome */ }
+
   state.allTags = tags.nodes;
   const tagDiv = $("tags");
   tagDiv.innerHTML = "";
@@ -324,9 +344,24 @@ sock.subscribe("invalidation.listen", (ev) => {
       $("jobs-panel").classList.contains("open")) renderJobs();
 });
 
+// ---------- deep links ----------
+// `sdx desktop --open-path P` lands here as "#/ephemeral?path=P"
+function applyDeepLink() {
+  const m = location.hash.match(/^#\/ephemeral\?path=(.+)$/);
+  if (!m) return false;
+  const path = decodeURIComponent(m[1]);
+  Object.assign(state, {mode: "ephemeral", ephPath: path, ephRoot: "/",
+                        ephRootName: "/", loc: null, tag: null,
+                        cursor: null});
+  clearSelection();
+  loadContent(true);
+  return true;
+}
+window.addEventListener("hashchange", applyDeepLink);
+
 // ---------- boot ----------
 await initI18n();  // catalogs before first render (top-level await)
 setView(state.view);
-loadLibraries().catch(e => {
+loadLibraries().then(() => { applyDeepLink(); }).catch(e => {
   $("stats").textContent = "error: " + e.message;
 });

@@ -6,7 +6,7 @@
 import client from "/rspc/client.js";
 import { $, bus, el, fullPath, state } from "/static/js/util.js";
 import {
-  confirmDialog, initMenus, openMenu, promptDialog, toast,
+  confirmDialog, initMenus, openDialog, openMenu, promptDialog, toast,
 } from "/static/js/ui.js";
 import { t } from "/static/js/i18n.js";
 
@@ -34,6 +34,131 @@ function pasteItem() {
   };
 }
 
+// Tag assignment from the item menu (ref:interface Explorer
+// ContextMenu AssignTagMenuItems): checkbox per tag, immediate
+// assign/unassign over tags.assign, plus inline new-tag creation.
+async function tagsDialog(chosen) {
+  const objIds = [...new Set(chosen.map(x => x.object_id).filter(Boolean))];
+  if (!objIds.length) { toast(t("tags_need_identify"), {kind: "info"}); return; }
+  // per-object tag sets: a multi-selection renders checked only when
+  // EVERY object carries the tag, indeterminate when some do —
+  // toggling from indeterminate assigns to all (never blind-unassigns
+  // from objects whose state the checkbox didn't show)
+  const perObject = await Promise.all(objIds.map(async (oid) =>
+    new Set((await client.tags.getForObject(oid, state.lib))
+      .nodes.map(tg => tg.id))));
+  const countFor = (tagId) =>
+    perObject.reduce((s, set) => s + (set.has(tagId) ? 1 : 0), 0);
+  openDialog(t("assign_tags_title"), (m, close) => {
+    const list = el("div");
+    const row = (tag) => {
+      const lab = el("label", "row");
+      const cb = el("input");
+      cb.type = "checkbox";
+      const cnt = countFor(tag.id);
+      cb.checked = cnt === objIds.length && cnt > 0;
+      cb.indeterminate = cnt > 0 && cnt < objIds.length;
+      cb.onchange = async () => {
+        const assign = cb.checked || cb.indeterminate;
+        cb.indeterminate = false;
+        cb.checked = assign;
+        await client.tags.assign({tag_id: tag.id, object_ids: objIds,
+                                  unassign: !assign}, state.lib);
+        for (const set of perObject)
+          assign ? set.add(tag.id) : set.delete(tag.id);
+        toast(assign ? t("tag_assigned", {name: tag.name})
+                     : t("tag_unassigned", {name: tag.name}),
+              {kind: "ok"});
+      };
+      lab.appendChild(cb);
+      lab.appendChild(el("span", "", " 🏷️ " + (tag.name || "?")));
+      return lab;
+    };
+    for (const tag of state.allTags) list.appendChild(row(tag));
+    if (!state.allTags.length)
+      list.appendChild(el("p", "meta", t("no_tags_yet")));
+    m.appendChild(list);
+    const mk = el("div", "row");
+    const name = el("input");
+    name.placeholder = t("new_tag_placeholder");
+    const add = el("button", "mini", "+");
+    add.onclick = async () => {
+      if (!name.value.trim()) return;
+      const createdId = await client.tags.create(
+        {name: name.value.trim()}, state.lib);
+      const created = {id: createdId, name: name.value.trim()};
+      await client.tags.assign(
+        {tag_id: created.id, object_ids: objIds}, state.lib);
+      state.allTags.push(created);
+      list.appendChild(row(created));
+      const cb = list.lastChild.querySelector("input");
+      cb.checked = true;
+      name.value = "";
+      bus.refreshNav();
+    };
+    name.onkeydown = (e) => { if (e.key === "Enter") add.onclick(); };
+    mk.appendChild(name);
+    mk.appendChild(add);
+    m.appendChild(mk);
+  });
+}
+
+// Batch rename (ref:interface Explorer RenameDialog multi form):
+// pattern with {n} (counter) and {name} (old stem); extensions are
+// preserved; a live preview shows the first few results before apply.
+function batchRenameDialog(chosen, refresh) {
+  openDialog(t("batch_rename_title", {n: chosen.length}), (m, close) => {
+    m.appendChild(el("p", "meta", t("batch_rename_body")));
+    const pat = el("input");
+    pat.value = "{name}";
+    pat.style.width = "100%";
+    const preview = el("p", "meta");
+    const names = () => chosen.map((x, i) =>
+      pat.value.replaceAll("{n}", String(i + 1))
+               .replaceAll("{name}", x.name)
+      + (x.extension ? "." + x.extension : ""));
+    const update = () => {
+      preview.textContent =
+        names().slice(0, 3).join(" · ") + (chosen.length > 3 ? " …" : "");
+    };
+    pat.oninput = update;
+    update();
+    const go = el("button", "", t("rename"));
+    go.onclick = async () => {
+      const out = names();
+      if (new Set(out).size !== out.length) {
+        toast(t("batch_rename_collision"), {kind: "error"});
+        return;
+      }
+      // sequential with an honest partial-failure report: a target
+      // that already exists (400) must not abort silently mid-batch
+      let done = 0;
+      let firstErr = null;
+      for (let i = 0; i < chosen.length; i++) {
+        try {
+          await client.files.renameFile(
+            {id: chosen[i].id, new_name: out[i]}, state.lib);
+          done++;
+        } catch (e) {
+          firstErr = firstErr || e;
+        }
+      }
+      if (firstErr) {
+        toast(t("batch_rename_partial",
+                {done, n: chosen.length, error: firstErr.message}),
+              {kind: "error"});
+      } else {
+        toast(t("batch_renamed_toast", {n: chosen.length}), {kind: "ok"});
+      }
+      close();
+      refresh();
+    };
+    m.appendChild(pat);
+    m.appendChild(preview);
+    m.appendChild(go);
+  });
+}
+
 export function showMenu(x, y, n) {
   const refresh = () => bus.loadContent(true);
   // when the clicked item is part of a multi-selection, batch ops
@@ -50,7 +175,10 @@ export function showMenu(x, y, n) {
   const displayName = n.name + (n.extension ? "." + n.extension : "");
 
   openMenu(x, y, [
-    {
+    many ? {
+      label: t("menu_batch_rename", {n: chosen.length}),
+      onClick: () => batchRenameDialog(chosen, refresh),
+    } : {
       label: t("menu_rename"),
       onClick: async () => {
         const name = await promptDialog(t("rename_title"), {
@@ -60,6 +188,10 @@ export function showMenu(x, y, n) {
         await client.files.renameFile({id: n.id, new_name: name}, state.lib);
         refresh();
       },
+    },
+    {
+      label: label(t("menu_tags")),
+      onClick: () => tagsDialog(chosen),
     },
     {
       label: label(t("menu_copy")),

@@ -23,7 +23,10 @@ let current = null; // node being previewed
 export const previewOpen = () => !!current;
 
 export function openPreview(n) {
-  if (!n || n.is_dir) return;
+  // ephemeral (non-indexed) rows have no location to serve the file
+  // from and no db id to stamp — no preview until a raw-path file
+  // route exists
+  if (!n || n.is_dir || n.ephemeral) return;
   current = n;
   render();
   $("preview-back").classList.add("open");
@@ -33,6 +36,7 @@ export function openPreview(n) {
 /** opening a preview counts as opening the file — feeds the recents
  *  route (ref:core/src/api/files.rs:298 updateAccessTime) */
 function stampAccess(n) {
+  if (n.ephemeral) return;
   n.object_date_accessed = new Date().toISOString();
   client.files.updateAccessTime({ids: [n.id]}, state.lib).catch(() => {});
 }

@@ -20,6 +20,7 @@ export function renderSettings() {
     {id: "library", label: t("tab_library"), render: renderLibraryTab},
     {id: "locations", label: t("tab_locations"), render: renderLocationsTab},
     {id: "volumes", label: t("tab_volumes"), render: renderVolumesTab},
+    {id: "keys", label: t("tab_keys"), render: renderKeysTab},
   ], {initial: activeTab, onSelect: (id) => { activeTab = id; }});
 }
 
@@ -151,6 +152,80 @@ async function renderVolumesTab(body) {
     row.appendChild(el("span", "", `${v.name || v.mount_point}`));
     row.appendChild(el("span", "meta",
       `${fmtBytes(v.available_capacity)} free of ${fmtBytes(v.total_capacity)}`));
+    body.appendChild(row);
+  }
+}
+
+// Key manager (ref:interface/app/$libraryId/KeyManager/ over
+// core/src/api/keys.rs): unlock the per-library vault with the master
+// password, then add/mount/unmount/delete stored keys.
+async function renderKeysTab(body) {
+  const st = await client.keys.state(null, state.lib);
+  const rerender = async () => { body.innerHTML = ""; await renderKeysTab(body); };
+
+  if (!st.unlocked) {
+    body.appendChild(el("p", "meta", t("keys_locked_body")));
+    const row = el("div", "row");
+    const pw = el("input");
+    pw.type = "password";
+    pw.id = "km-password";
+    pw.placeholder = t("master_password");
+    const go = el("button", "", t("unlock"));
+    go.onclick = async () => {
+      if (!pw.value) return;
+      const res = await client.keys.unlock({password: pw.value}, state.lib);
+      toast(t("keys_unlocked_toast", {n: res.automounted}), {kind: "ok"});
+      rerender();
+    };
+    pw.onkeydown = (e) => { if (e.key === "Enter") go.onclick(); };
+    row.appendChild(pw);
+    row.appendChild(go);
+    body.appendChild(row);
+    return;
+  }
+
+  const bar = el("div", "row");
+  const addBtn = el("button", "", t("key_add"));
+  addBtn.onclick = async () => {
+    await client.keys.add({}, state.lib);
+    toast(t("key_added_toast"), {kind: "ok"});
+    rerender();
+  };
+  const lockBtn = el("button", "", t("keys_lock"));
+  lockBtn.onclick = async () => {
+    await client.keys.lock(null, state.lib);
+    rerender();
+  };
+  bar.appendChild(addBtn);
+  bar.appendChild(lockBtn);
+  body.appendChild(bar);
+
+  if (!st.keys.length)
+    body.appendChild(el("p", "meta", t("keys_empty")));
+  for (const k of st.keys) {
+    const row = el("div", "row");
+    row.dataset.key = k.uuid;
+    row.appendChild(el("span", "", "🔑 " + k.uuid.slice(0, 8)));
+    row.appendChild(el("span", "meta",
+      k.mounted ? t("key_mounted") : t("key_unmounted")));
+    const mnt = el("button", "mini",
+      k.mounted ? t("key_unmount") : t("key_mount"));
+    mnt.onclick = async () => {
+      await (k.mounted
+        ? client.keys.unmount(k.uuid, state.lib)
+        : client.keys.mount(k.uuid, state.lib));
+      rerender();
+    };
+    row.appendChild(mnt);
+    const del = el("button", "mini", t("delete"));
+    del.onclick = async () => {
+      const ok = await confirmDialog(t("key_delete_title"),
+        t("key_delete_body"), {danger: true, actionLabel: t("delete")});
+      if (!ok) return;
+      await client.keys.delete(k.uuid, state.lib);
+      rerender();
+    };
+    row.appendChild(del);
     body.appendChild(row);
   }
 }

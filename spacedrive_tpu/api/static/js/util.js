@@ -47,7 +47,8 @@ export function fmtBytes(n) {
 }
 
 export const thumbUrl = (n) =>
-  `/spacedrive/thumbnail/${state.lib}/${n.cas_id.slice(0,3)}/${n.cas_id}.webp`;
+  `/spacedrive/thumbnail/${n.ephemeral ? "ephemeral" : state.lib}` +
+  `/${n.cas_id.slice(0,3)}/${n.cas_id}.webp`;
 
 /** location-relative path of a row ("/dir/name.ext") */
 export const relPath = (n) =>

@@ -20,6 +20,15 @@ let loadSeq = 0;  // drop stale responses when loads overlap
 
 export async function loadContent(reset) {
   if (state.mode === "duplicates") return loadDuplicates();
+  if (state.mode === "ephemeral") return loadEphemeral();
+  if (state.mode === "network") {
+    ++loadSeq;
+    state.nodes = [];
+    state.cursor = null;
+    renderCrumbs();
+    const { loadNetwork } = await import("/static/js/network.js");
+    return loadNetwork();
+  }
   if (state.mode === "overview") {
     // invalidate any in-flight listing and drop its rows: a stale
     // response must not paint over the landing page, and keyboard
@@ -70,6 +79,36 @@ export async function loadContent(reset) {
   else appendFrom(before);  // keep scroll position on "load more"
 }
 
+// ---------- ephemeral (non-indexed) browse ----------
+// (ref:interface/app/$libraryId/ephemeral.tsx — browse any path on
+// this device without indexing; thumbs are generated on the fly into
+// the ephemeral namespace by the backend walker)
+async function loadEphemeral() {
+  const seq = ++loadSeq;
+  state.cursor = null;
+  renderCrumbs();
+  let page;
+  try {
+    page = await client.ephemeralFiles.list({ path: state.ephPath });
+  } catch (e) {
+    if (seq !== loadSeq) return;
+    $("content").innerHTML = "";
+    $("content").appendChild(el("div", "meta", t("ephemeral_error", {error: e.message})));
+    return;
+  }
+  if (seq !== loadSeq) return;
+  state.nodes = page.entries.map((en, i) => ({
+    ...en,
+    id: "eph:" + en.path,
+    object_kind: en.kind,
+    date_created: new Date(en.date_created * 1000).toISOString(),
+    date_modified: new Date(en.date_modified * 1000).toISOString(),
+    materialized_path: null,
+    ephemeral: true,
+  }));
+  render();
+}
+
 export function renderCrumbs() {
   const c = $("crumbs");
   c.innerHTML = "";
@@ -79,6 +118,28 @@ export function renderCrumbs() {
     c.appendChild(s);
     return s;
   };
+  if (state.mode === "ephemeral") {
+    // device-absolute crumb trail: every segment is navigable
+    const root = state.ephRoot || "/";
+    seg("💻 " + (state.ephRootName || root), () => {
+      state.ephPath = root; clearSelection(); loadContent(true);
+    });
+    const rel = state.ephPath.startsWith(root)
+      ? state.ephPath.slice(root.length) : state.ephPath;
+    let acc = root.endsWith("/") ? root : root + "/";
+    for (const p of rel.split("/").filter(Boolean)) {
+      c.appendChild(el("span", "sep", "›"));
+      acc += p + "/";
+      const target = acc.slice(0, -1);
+      seg(p, () => { state.ephPath = target; clearSelection();
+        loadContent(true); });
+    }
+    return;
+  }
+  if (state.mode === "network") {
+    c.appendChild(el("span", "", t("network_crumb")));
+    return;
+  }
   if (state.mode === "search") {
     c.appendChild(el("span", "", t("search_crumb", {query: state.search})));
     const back = el("button", "mini", t("clear"));
@@ -144,6 +205,12 @@ export function renderCrumbs() {
 }
 
 export function openDir(n) {
+  if (n.ephemeral) {
+    state.ephPath = n.path;
+    clearSelection();
+    loadContent(true);
+    return;
+  }
   state.path = (n.materialized_path || "/") + n.name + "/";
   state.selected = null;
   state.selectedIds = new Set();
@@ -158,6 +225,15 @@ export function clearSelection() {
 }
 
 export function upDir() {
+  if (state.mode === "ephemeral") {
+    const root = state.ephRoot || "/";
+    if (state.ephPath === root) return;
+    const parent = state.ephPath.replace(/\/[^/]+$/, "") || root;
+    state.ephPath = parent.length < root.length ? root : parent;
+    clearSelection();
+    loadContent(true);
+    return;
+  }
   if (state.mode !== "browse" || !state.loc || state.path === "/") return;
   clearSelection();
   const parts = state.path.split("/").filter(Boolean);
@@ -231,11 +307,14 @@ function renderCards(c, mediaOnly, nodes) {
       n.is_dir ? t("folder") : fmtBytes(n.size_in_bytes)));
     card.onclick = (e) => bus.select(n, e);
     card.ondblclick = () => activate(n);
-    card.oncontextmenu = (e) => { e.preventDefault();
-      if (!state.selectedIds.has(n.id)) bus.select(n);
-      bus.showMenu(e.clientX, e.clientY, n); };
-    draggable(card, n);
-    if (n.is_dir) droppable(card, dirTarget(n));
+    if (!n.ephemeral) {
+      // db-backed affordances: tag/favorite menus, move-by-drag
+      card.oncontextmenu = (e) => { e.preventDefault();
+        if (!state.selectedIds.has(n.id)) bus.select(n);
+        bus.showMenu(e.clientX, e.clientY, n); };
+      draggable(card, n);
+      if (n.is_dir) droppable(card, dirTarget(n));
+    }
     c.appendChild(card);
   }
 }
@@ -255,11 +334,13 @@ function renderListRows(table, nodes) {
     tr.appendChild(el("td", "", n.materialized_path || ""));
     tr.onclick = (e) => bus.select(n, e);
     tr.ondblclick = () => activate(n);
-    tr.oncontextmenu = (e) => { e.preventDefault();
-      if (!state.selectedIds.has(n.id)) bus.select(n);
-      bus.showMenu(e.clientX, e.clientY, n); };
-    draggable(tr, n);
-    if (n.is_dir) droppable(tr, dirTarget(n));
+    if (!n.ephemeral) {
+      tr.oncontextmenu = (e) => { e.preventDefault();
+        if (!state.selectedIds.has(n.id)) bus.select(n);
+        bus.showMenu(e.clientX, e.clientY, n); };
+      draggable(tr, n);
+      if (n.is_dir) droppable(tr, dirTarget(n));
+    }
     table.appendChild(tr);
   }
 }

@@ -587,6 +587,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="seconds between stats log lines (0 disables)")
 
     sub.add_parser("bench", help="run the headline benchmark")
+
+    dk = sub.add_parser(
+        "desktop",
+        help="managed desktop host: single instance, browser UI, "
+             "deep links, background node (ref:apps/desktop/src-tauri)",
+    )
+    dk.add_argument("--host", default="127.0.0.1")
+    dk.add_argument("--port", type=int, default=0)
+    dk.add_argument("--open-path", default=None, metavar="PATH",
+                    help="open the explorer on PATH (deep link; targets "
+                         "the running instance if one exists)")
+    dk.add_argument("--no-open", action="store_true",
+                    help="don't launch a browser (headless/CI)")
+    dk.add_argument("--quit", action="store_true",
+                    help="stop the running instance for this data dir")
+    dk.add_argument("--register", action="store_true",
+                    help="write the XDG launcher/'Open with' entry and exit")
     return p
 
 
@@ -616,6 +633,18 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_labeler(args)
     if args.cmd == "bench":
         return cmd_bench(args)
+    if args.cmd == "desktop":
+        from . import desktop
+
+        if args.register:
+            path = desktop.register_xdg()
+            print(f"registered {path}")
+            return 0
+        return asyncio.run(desktop.run_or_forward(
+            args.data_dir, open_path=args.open_path,
+            quit_running=args.quit, host=args.host, port=args.port,
+            open_browser=not args.no_open,
+        ))
     if args.cmd == "licenses":
         from .utils.deps import collect
 

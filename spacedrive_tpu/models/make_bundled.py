@@ -6,13 +6,15 @@ air-gapped install therefore never labels. This framework ships a
 small trained checkpoint IN the package (`models/bundled/`) so
 `sdx labeler provision --bundled` works with zero egress.
 
-The artifact is a LabelerNet trained on sklearn's bundled digits
-dataset (1,797 real 8×8 handwritten-digit scans — the only real image
-dataset available without network in this build environment). It is a
-modest model with an honest scope: ten `digit N` classes, ~97% eval
-top-1 — enough to make the full provision→index→label pipeline real
-offline, and the exact same artifact contract (`weights.npz`) any
-user-trained or downloaded model uses.
+The artifact is a LabelerNet trained on two corpora that need no
+network: sklearn's bundled digit scans (1,797 real 8×8 images — the
+digit head) and a procedurally rendered scene/kind corpus
+(`train.SCENE_CLASSES`: document scan, screenshot, line art, photo,
+chart, dark photo — the statistics a file manager's content actually
+has). A modest model with an honest scope — but on a real photo
+library it now says "photo"/"screenshot"/"document scan" instead of
+"digit 7". Same artifact contract (`weights.npz`) as any user-trained
+or downloaded model.
 
 Run `python -m spacedrive_tpu.models.make_bundled` to rebuild; it
 retrains with a fixed seed, overwrites the artifact, and rewrites
@@ -28,19 +30,19 @@ import os
 from .provision import sha256_file
 
 BUNDLED_DIR = os.path.join(os.path.dirname(__file__), "bundled")
-ARTIFACT = os.path.join(BUNDLED_DIR, "labeler_digits.npz")
+ARTIFACT = os.path.join(BUNDLED_DIR, "labeler_offline.npz")
 MANIFEST = os.path.join(BUNDLED_DIR, "MANIFEST.json")
 
 
-def build(steps: int = 600, use_device: bool = False) -> dict:
+def build(steps: int = 1200, use_device: bool = False) -> dict:
     from . import checkpoint
-    from .train import TrainConfig, array_batches, digits_demo_dataset, train
+    from .train import TrainConfig, array_batches, bundled_dataset, train
 
     cfg = TrainConfig(
         image_size=32, widths=(8, 16, 32, 32, 32), depths=(1, 1, 1, 1),
         batch_size=64, steps=steps, use_device=use_device, seed=0,
     )
-    (tr_x, tr_y), (ev_x, ev_y), classes = digits_demo_dataset(cfg.image_size)
+    (tr_x, tr_y), (ev_x, ev_y), classes = bundled_dataset(cfg.image_size)
     params, _model, metrics = train(
         array_batches(tr_x, tr_y, cfg.batch_size, seed=cfg.seed),
         classes, cfg, eval_set=(ev_x, ev_y),
@@ -51,7 +53,8 @@ def build(steps: int = 600, use_device: bool = False) -> dict:
         ARTIFACT, params, classes=classes, image_size=cfg.image_size,
         widths=cfg.widths, depths=cfg.depths,
         extra={"metrics": metrics,
-               "trained_on": "sklearn digits (1,797 8x8 scans)"},
+               "trained_on": "sklearn digits (1,797 8x8 scans) + "
+                             "procedural scene corpus (train.py)"},
     )
     manifest = {
         "artifact": os.path.basename(ARTIFACT),

@@ -117,6 +117,10 @@ class Node:
         if self._started:
             return
         self._started = True
+        # bind the thumbnailer to THIS loop up front: enqueues arrive
+        # from worker threads (non-indexed walker) and can only wake the
+        # actor thread-safely once it knows its owning loop
+        self.thumbnailer._ensure_started()
         for lib in self.libraries.load_all():
             await self._init_library(lib)
         if self.config.config.p2p.enabled:

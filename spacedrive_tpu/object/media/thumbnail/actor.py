@@ -111,11 +111,28 @@ class Thumbnailer:
         if self._worker is None or self._worker.done():
             self._cond = self._cond or asyncio.Condition()
             self._wake = self._wake or asyncio.Event()
-            self._worker = asyncio.get_running_loop().create_task(
+            self._loop = asyncio.get_running_loop()
+            self._worker = self._loop.create_task(
                 self._run(), name="thumbnailer"
             )
             if self._fg or self._bg:
                 self._wake.set()
+
+    def _kick(self) -> None:
+        """Start/wake the worker. Raises RuntimeError off-loop — the
+        caller then schedules `_kick_on_loop` via call_soon_threadsafe
+        (asyncio.Event.set is NOT thread-safe, and enqueues arrive from
+        to_thread workers — e.g. the non-indexed walker queueing
+        on-the-fly thumbnails)."""
+        self._ensure_started()
+        assert self._wake is not None
+        self._wake.set()
+
+    def _kick_on_loop(self) -> None:
+        try:
+            self._kick()
+        except RuntimeError:
+            pass  # loop shutting down
 
     async def shutdown(self) -> None:
         """Persist unprocessed batches (including the in-flight
@@ -189,12 +206,22 @@ class Thumbnailer:
         self._pending[self._ns(library_id)] += len(norm)
         self._batch_pending[batch.id] = len(norm)
         self._save()
+        # which thread are we on? asyncio.Event.set is only safe on the
+        # owning loop — and once the worker is pre-started (Node.start),
+        # _kick would NOT raise off-loop, so the check must be explicit
         try:
-            self._ensure_started()
-            assert self._wake is not None
-            self._wake.set()
+            running = asyncio.get_running_loop()
         except RuntimeError:
-            pass  # no running loop yet; started on first await
+            running = None
+        owner = getattr(self, "_loop", None)
+        if running is not None and (owner is None or running is owner):
+            self._kick()
+        elif owner is not None and owner.is_running():
+            # off-loop caller (a to_thread worker) or a foreign loop:
+            # hand the kick to the owning loop
+            owner.call_soon_threadsafe(self._kick_on_loop)
+        # with no loop bound yet, the batch is persisted and processed
+        # on first await/start()
         return batch.id
 
     def delete_thumbnails(self, library_id: str | None, cas_ids: list[str]) -> int:
