@@ -1304,18 +1304,25 @@ def _keys(r: Router) -> None:
         km = _key_manager(library)
         km.set_master_password(str(arg["password"]).encode())
         if km.stored:
-            # VERIFY before committing: decrypting any stored key proves
+            # VERIFY before committing: decrypting a stored key proves
             # the password. Accepting it unchecked would let a typo'd
             # password "unlock" the vault and encrypt NEW keys under the
-            # typo — a keystore needing two different passwords.
-            probe = next(iter(km.stored))
+            # typo — a keystore needing two different passwords. The
+            # probe prefers an unmounted key and never unmounts one that
+            # was already mounted (a second unlock must not yank a key
+            # out from under its consumers).
+            mounted_before = set(km.mounted_uuids())
+            probe = next((u for u in km.stored if u not in mounted_before),
+                         next(iter(km.stored)))
             try:
                 km.mount(probe)
-                km.unmount(probe)
             except CryptoError:
                 km.lock()
                 invalidate_query(node, "keys.state", library)
                 raise RspcError.bad_request("wrong master password")
+            if probe not in mounted_before \
+                    and not km.stored[probe].automount:
+                km.unmount(probe)
         mounted = guard(km.automount)
         invalidate_query(node, "keys.state", library)
         return {"automounted": mounted}

@@ -203,9 +203,11 @@ const SORT_FIELDS = [
   ["dateAccessed", "sort_accessed"],
 ];
 attachDropdown($("btn-sort"), () => {
-  // these views pin their own ordering (recents = last-opened) or have
-  // none — a selectable menu would silently no-op
-  if (["recents", "duplicates", "overview"].includes(state.mode)) {
+  // these views pin their own ordering (recents = last-opened,
+  // ephemeral = dirs-first walker order) or have none — a selectable
+  // menu would silently no-op
+  if (["recents", "duplicates", "overview", "ephemeral", "network"]
+      .includes(state.mode)) {
     return [{label: t("sort_unavailable"), disabled: true}];
   }
   return [

@@ -173,9 +173,16 @@ async function renderKeysTab(body) {
     const go = el("button", "", t("unlock"));
     go.onclick = async () => {
       if (!pw.value) return;
-      const res = await client.keys.unlock({password: pw.value}, state.lib);
-      toast(t("keys_unlocked_toast", {n: res.automounted}), {kind: "ok"});
-      rerender();
+      try {
+        const res = await client.keys.unlock(
+          {password: pw.value}, state.lib);
+        toast(t("keys_unlocked_toast", {n: res.automounted}), {kind: "ok"});
+        rerender();
+      } catch (e) {
+        // wrong password is a 400 — the form must say so, not go dead
+        toast(e.message, {kind: "error"});
+        pw.select();
+      }
     };
     pw.onkeydown = (e) => { if (e.key === "Enter") go.onclick(); };
     row.appendChild(pw);
@@ -184,12 +191,15 @@ async function renderKeysTab(body) {
     return;
   }
 
+  const failToast = (e) => toast(e.message, {kind: "error"});
   const bar = el("div", "row");
   const addBtn = el("button", "", t("key_add"));
   addBtn.onclick = async () => {
-    await client.keys.add({}, state.lib);
-    toast(t("key_added_toast"), {kind: "ok"});
-    rerender();
+    try {
+      await client.keys.add({}, state.lib);
+      toast(t("key_added_toast"), {kind: "ok"});
+      rerender();
+    } catch (e) { failToast(e); }
   };
   const lockBtn = el("button", "", t("keys_lock"));
   lockBtn.onclick = async () => {
@@ -211,10 +221,12 @@ async function renderKeysTab(body) {
     const mnt = el("button", "mini",
       k.mounted ? t("key_unmount") : t("key_mount"));
     mnt.onclick = async () => {
-      await (k.mounted
-        ? client.keys.unmount(k.uuid, state.lib)
-        : client.keys.mount(k.uuid, state.lib));
-      rerender();
+      try {
+        await (k.mounted
+          ? client.keys.unmount(k.uuid, state.lib)
+          : client.keys.mount(k.uuid, state.lib));
+        rerender();
+      } catch (e) { failToast(e); }
     };
     row.appendChild(mnt);
     const del = el("button", "mini", t("delete"));
@@ -222,8 +234,10 @@ async function renderKeysTab(body) {
       const ok = await confirmDialog(t("key_delete_title"),
         t("key_delete_body"), {danger: true, actionLabel: t("delete")});
       if (!ok) return;
-      await client.keys.delete(k.uuid, state.lib);
-      rerender();
+      try {
+        await client.keys.delete(k.uuid, state.lib);
+        rerender();
+      } catch (e) { failToast(e); }
     };
     row.appendChild(del);
     body.appendChild(row);

@@ -193,8 +193,11 @@ class DesktopHost:
         return Node(self.data_dir)
 
     async def start(self) -> int:
-        """Start core + API + control plane; returns the API port."""
-        if not self.try_lock():
+        """Start core + API + control plane; returns the API port.
+        A lock already held by THIS host (run_or_forward's probe) is
+        kept — releasing and re-acquiring would open a race window for
+        a concurrent launch to steal the instance."""
+        if self._lock_fd is None and not self.try_lock():
             raise DesktopError("another sdx desktop owns this data dir")
         self.node = self._make_node()
         await self.node.start()
@@ -274,7 +277,8 @@ async def run_or_forward(data_dir: str, *, open_path: str | None = None,
         print(f"sdx desktop: forwarded to running instance "
               f"(pid {resp.get('pid')}, {resp.get('url')})")
         return 0
-    probe._unlock()  # run() re-acquires; no window: same process
+    # keep holding the lock into run() — releasing here would let a
+    # concurrent launch win the re-acquire and crash this process
     print(f"sdx desktop: starting core for {probe.data_dir}")
     await probe.run(open_path)
     return 0

@@ -643,6 +643,17 @@ def test_keys_wrong_master_password_refused(tmp_path):
                     json={"arg": {"material": "zz"}, "library_id": lid},
                 ) as resp:
                     assert resp.status == 400
+                # a REPEAT unlock (second client/stale tab) must not
+                # yank a mounted key out from under its consumers via
+                # the verification probe
+                st = await _rspc(http, base, "keys.state", None, lid)
+                k = st["keys"][0]["uuid"]
+                await _rspc(http, base, "keys.mount", k, lid)
+                await _rspc(http, base, "keys.unlock",
+                            {"password": "right"}, lid)
+                st = await _rspc(http, base, "keys.state", None, lid)
+                assert st["keys"][0]["mounted"], \
+                    "re-unlock probe unmounted an in-use key"
         finally:
             await node.shutdown()
 
