@@ -130,11 +130,17 @@ async def _run_one(node, request: dict[str, Any]) -> dict[str, Any] | None:
                 "no event listener: call spawn_core_event_listener first")
         if req_id in _subscriptions:
             return _error_response(req_id, 400, f"id {req_id!r} in use")
+        try:
+            # resolution errors (unknown proc shape, bad library_id)
+            # raise HERE, before "started" is promised — the ws
+            # transport answers these on the request too
+            agen = node.router.subscribe(node, method, arg, library_id)
+        except RspcError as e:
+            return _error_response(req_id, e.code, e.message)
 
         async def pump() -> None:
             try:
-                async for item in node.router.subscribe(
-                        node, method, arg, library_id):
+                async for item in agen:
                     cb = _event_cb
                     if cb is None:
                         break
@@ -174,6 +180,18 @@ def handle_core_msg(query: str, data_dir: str,
 
     async def work() -> None:
         try:
+            await _work_inner()
+        except Exception as e:  # noqa: BLE001 - the callback MUST fire:
+            # a swallowed exception here leaves the app-side promise
+            # waiting forever
+            try:
+                callback(_dumps([_error_response(None, 500,
+                                                 f"bridge: {e}")]))
+            except Exception:  # noqa: BLE001 - nothing left to tell
+                pass
+
+    async def _work_inner() -> None:
+        try:
             parsed = json.loads(query)
         except ValueError:
             # decode failures echo the query back as the error, exactly
@@ -189,15 +207,19 @@ def handle_core_msg(query: str, data_dir: str,
                                              f"core init: {e}")]))
             return
         reqs = parsed if isinstance(parsed, list) else [parsed]
-        responses = []
-        for req in reqs:
+
+        async def one(req):
             if not isinstance(req, dict):
-                responses.append(_error_response(None, 400, "bad request"))
-                continue
-            resp = await _run_one(node, req)
-            if resp is not None:
-                responses.append(resp)
-        callback(_dumps(responses))
+                return _error_response(None, 400, "bad request")
+            if not isinstance(req.get("id"), (str, int, float, type(None))):
+                return _error_response(None, 400,
+                                       "id must be a string, number or null")
+            return await _run_one(node, req)
+
+        # concurrent like the reference's join_all; gather preserves
+        # response order
+        responses = await asyncio.gather(*(one(r) for r in reqs))
+        callback(_dumps([r for r in responses if r is not None]))
 
     asyncio.run_coroutine_threadsafe(work(), loop)
 
@@ -251,6 +273,10 @@ def shutdown_core(timeout: float = 15.0) -> None:
     finally:
         loop.call_soon_threadsafe(loop.stop)
         thread.join(timeout)
+        if not thread.is_alive():
+            # the selector + self-pipe fds leak per background/
+            # foreground cycle otherwise
+            loop.close()
         _subscriptions.clear()
         _node = None
         _init_lock = None

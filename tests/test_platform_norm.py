@@ -1,0 +1,183 @@
+"""macOS/Windows watcher normalization state machines, driven with
+simulated raw streams (the native event sources only exist on their
+hosts; the MACHINES are the portable parity —
+ref:core/src/location/manager/watcher/{macos,windows}.rs)."""
+
+from spacedrive_tpu.location.watcher.events import EventKind
+from spacedrive_tpu.location.watcher.platform_norm import (
+    MacOsNormalizer, WindowsNormalizer,
+)
+
+
+def _kinds(evs):
+    return [(e.kind, e.path, e.old_path) for e in evs]
+
+
+# --- macOS -----------------------------------------------------------------
+
+
+def test_macos_rename_pairs_within_window():
+    exists = {"/w/new.txt"}
+    m = MacOsNormalizer(exists=lambda p: p in exists)
+    # old half first (path vanished), then new half (path exists)
+    assert m.on_raw("rename_any", "/w/old.txt", now=0.0) == []
+    evs = m.on_raw("rename_any", "/w/new.txt", now=0.05)
+    assert _kinds(evs) == [(EventKind.RENAME, "/w/new.txt", "/w/old.txt")]
+    assert m.tick(1.0) == []  # nothing left to expire
+
+
+def test_macos_rename_pairs_reverse_order():
+    exists = {"/w/new.txt"}
+    m = MacOsNormalizer(exists=lambda p: p in exists)
+    assert m.on_raw("rename_any", "/w/new.txt", now=0.0) == []
+    evs = m.on_raw("rename_any", "/w/old.txt", now=0.05)
+    assert _kinds(evs) == [(EventKind.RENAME, "/w/new.txt", "/w/old.txt")]
+
+
+def test_macos_unpaired_halves_degrade():
+    # moved OUT: only the old half ever arrives -> REMOVE after window
+    m = MacOsNormalizer(exists=lambda p: False)
+    assert m.on_raw("rename_any", "/w/gone.txt", now=0.0) == []
+    assert m.tick(0.05) == []  # still inside the pairing window
+    assert _kinds(m.tick(0.2)) == [(EventKind.REMOVE, "/w/gone.txt", None)]
+    # moved IN: only the new half -> CREATE after window
+    m2 = MacOsNormalizer(exists=lambda p: True)
+    assert m2.on_raw("rename_any", "/w/arrived.txt", now=0.0) == []
+    assert _kinds(m2.tick(0.2)) == [
+        (EventKind.CREATE, "/w/arrived.txt", None)]
+
+
+def test_macos_finder_double_create_deduped():
+    m = MacOsNormalizer(exists=lambda p: True)
+    evs = m.on_raw("create_dir", "/w/folder", now=0.0)
+    assert _kinds(evs) == [(EventKind.CREATE, "/w/folder", None)]
+    assert evs[0].is_dir
+    # Finder's duplicate within the window is swallowed
+    assert m.on_raw("create_dir", "/w/folder", now=0.02) == []
+    # a LATER create of the same path is a genuine new event
+    assert len(m.on_raw("create_dir", "/w/folder", now=1.0)) == 1
+
+
+def test_macos_modify_coalescing_and_reincident_flush():
+    m = MacOsNormalizer(exists=lambda p: True)
+    # spam modifies every 50 ms: quieter-than-100ms never fires...
+    t = 0.0
+    for _ in range(5):
+        assert m.on_raw("modify_data", "/w/dl.bin", now=t) == []
+        assert m.tick(t + 0.049) == []
+        t += 0.05
+    # ...until the quiet window passes
+    assert _kinds(m.tick(t + 0.2)) == [(EventKind.MODIFY, "/w/dl.bin", None)]
+
+    # a file that NEVER goes quiet flushes at the reincident cap
+    t = 0.0
+    while t < 9.8:
+        m.on_raw("modify_data", "/w/hot.bin", now=t)
+        assert m.tick(t + 0.05) == []
+        t += 0.09
+    m.on_raw("modify_data", "/w/hot.bin", now=t)
+    evs = m.tick(10.1)  # past the cap despite never going quiet
+    assert _kinds(evs) == [(EventKind.MODIFY, "/w/hot.bin", None)]
+
+
+def test_macos_remove_cancels_pending_modify():
+    m = MacOsNormalizer(exists=lambda p: False)
+    m.on_raw("modify_data", "/w/x.txt", now=0.0)
+    evs = m.on_raw("remove_file", "/w/x.txt", now=0.01)
+    assert _kinds(evs) == [(EventKind.REMOVE, "/w/x.txt", None)]
+    assert m.tick(5.0) == []  # the buffered modify died with the file
+
+
+# --- Windows ---------------------------------------------------------------
+
+
+def test_windows_move_is_remove_then_create_paired_by_identity():
+    w = WindowsNormalizer()
+    assert w.on_raw("remove", "/w/a/doc.txt", now=0.0, ident=77) == []
+    evs = w.on_raw("create", "/w/b/doc.txt", now=0.05, ident=77)
+    assert _kinds(evs) == [
+        (EventKind.RENAME, "/w/b/doc.txt", "/w/a/doc.txt")]
+    assert w.tick(1.0) == []  # the remove was consumed by the pairing
+
+
+def test_windows_unpaired_remove_really_deletes():
+    w = WindowsNormalizer()
+    assert w.on_raw("remove", "/w/dead.txt", now=0.0, ident=5) == []
+    assert w.tick(0.05) == []  # grace window still open
+    assert _kinds(w.tick(0.2)) == [(EventKind.REMOVE, "/w/dead.txt", None)]
+
+
+def test_windows_create_with_different_identity_is_a_create():
+    w = WindowsNormalizer()
+    w.on_raw("remove", "/w/old.txt", now=0.0, ident=5)
+    evs = w.on_raw("create", "/w/new.txt", now=0.05, ident=6)
+    assert _kinds(evs) == [(EventKind.CREATE, "/w/new.txt", None)]
+    # the unrelated remove still expires into a real deletion
+    assert _kinds(w.tick(0.2)) == [(EventKind.REMOVE, "/w/old.txt", None)]
+
+
+def test_windows_rename_from_to_pairs_either_order():
+    w = WindowsNormalizer()
+    assert w.on_raw("rename_from", "/w/a.txt", now=0.0) == []
+    evs = w.on_raw("rename_to", "/w/b.txt", now=0.02)
+    assert _kinds(evs) == [(EventKind.RENAME, "/w/b.txt", "/w/a.txt")]
+
+    assert w.on_raw("rename_to", "/w/d.txt", now=1.0) == []
+    evs = w.on_raw("rename_from", "/w/c.txt", now=1.02)
+    assert _kinds(evs) == [(EventKind.RENAME, "/w/d.txt", "/w/c.txt")]
+
+    # unpaired halves degrade like macOS
+    assert w.on_raw("rename_from", "/w/lost.txt", now=2.0) == []
+    assert _kinds(w.tick(2.2)) == [(EventKind.REMOVE, "/w/lost.txt", None)]
+    assert w.on_raw("rename_to", "/w/found.txt", now=3.0) == []
+    assert _kinds(w.tick(3.2)) == [(EventKind.CREATE, "/w/found.txt", None)]
+
+
+def test_windows_locked_create_defers_until_release():
+    locked = {"/w/busy.tmp"}
+    w = WindowsNormalizer(locked=lambda p: p in locked)
+    assert w.on_raw("create", "/w/busy.tmp", now=0.0) == []
+    # still locked: every tick RE-PROBES and keeps deferring — emitting
+    # now would hand downstream a file it cannot open
+    assert w.tick(0.2) == []
+    assert w.tick(2.0) == []
+    # writer releases the handle -> the CREATE finally surfaces
+    locked.clear()
+    assert _kinds(w.tick(2.1)) == [(EventKind.CREATE, "/w/busy.tmp", None)]
+
+
+def test_macos_concurrent_renames_do_not_mispair():
+    """Finder batch-move: two old halves buffered, new halves arrive in
+    the OPPOSITE order — identity (or basename) pairing must keep each
+    file with its own old path."""
+    on_disk = set()
+    idents = {"/dst/a.txt": 1, "/dst/b.txt": 2}
+    missing = {"/src/a.txt": 1, "/src/b.txt": 2}
+    m = MacOsNormalizer(
+        exists=lambda p: p in on_disk,
+        ident=lambda p: idents.get(p),
+        ident_of_missing=lambda p: missing.get(p),
+    )
+    assert m.on_raw("rename_any", "/src/a.txt", now=0.0) == []
+    assert m.on_raw("rename_any", "/src/b.txt", now=0.01) == []
+    on_disk.update(idents)
+    evs = m.on_raw("rename_any", "/dst/b.txt", now=0.02)
+    evs += m.on_raw("rename_any", "/dst/a.txt", now=0.03)
+    assert sorted(_kinds(evs)) == [
+        (EventKind.RENAME, "/dst/a.txt", "/src/a.txt"),
+        (EventKind.RENAME, "/dst/b.txt", "/src/b.txt"),
+    ]
+    assert m.tick(1.0) == []  # everything paired, nothing degrades
+
+    # without identity probes, the BASENAME heuristic still pairs right
+    on_disk2 = set()
+    m2 = MacOsNormalizer(exists=lambda p: p in on_disk2)
+    m2.on_raw("rename_any", "/src/a.txt", now=0.0)
+    m2.on_raw("rename_any", "/src/b.txt", now=0.01)
+    on_disk2.update({"/dst/a.txt", "/dst/b.txt"})
+    evs = m2.on_raw("rename_any", "/dst/b.txt", now=0.02)
+    evs += m2.on_raw("rename_any", "/dst/a.txt", now=0.03)
+    assert sorted(_kinds(evs)) == [
+        (EventKind.RENAME, "/dst/a.txt", "/src/a.txt"),
+        (EventKind.RENAME, "/dst/b.txt", "/src/b.txt"),
+    ]
