@@ -181,6 +181,10 @@ def main() -> None:
         e2e.append((time.perf_counter() - t0) / pipe_depth)
     e2e_s, e2e_lo, e2e_hi = median_spread(e2e)
     e2e_fps = n / e2e_s
+    # bracket the e2e leg: the tunnel swings on minute scales, so the
+    # startup probe alone can't vouch for what the link was DURING it
+    link_post_gbps = probe_link()
+    link_worst = min(link_gbps, link_post_gbps)
     log(f"e2e (host→device, {pipe_depth} in flight): {e2e_s*1e3:.1f} ms/batch "
         f"[{e2e_lo*1e3:.1f}–{e2e_hi*1e3:.1f}]  {e2e_fps:,.0f} files/s  "
         f"{batch_bytes/e2e_s/1e9:.2f} GB/s")
@@ -242,6 +246,12 @@ def main() -> None:
         "unit": "files/s",
         # honest baseline: 16-core-projected native C, per the north star
         "vs_baseline": round(e2e_fps / cpu16_fps, 3) if cpu16_fps else None,
+        # self-describing congestion flag (worst of the probes
+        # BRACKETING the e2e leg): when the tunnel is congested the e2e
+        # number measures the LINK, not the framework — the
+        # device-clock legs (extras below, PROFILE.md, BENCH_E2E.json
+        # device_clock_composition) carry the framework's signal
+        "blocked": ("congested-link" if link_worst < 0.5 else None),
         "spread": {
             "e2e_ms": [round(e2e_lo * 1e3, 1), round(e2e_s * 1e3, 1), round(e2e_hi * 1e3, 1)],
             "device_ms": [round(dev_lo * 1e3, 1), round(dev_s * 1e3, 1), round(dev_hi * 1e3, 1)],
@@ -251,6 +261,7 @@ def main() -> None:
             "device_compute_gbps": round(dev_gbps, 2),
             "device_vs_cpu16": round(dev_fps / cpu16_fps, 3) if cpu16_fps else None,
             "link_probe_gbps": round(link_gbps, 3),
+            "link_probe_post_gbps": round(link_post_gbps, 3),
             "cpu_1core_files_per_s": round(cpu1_fps, 1) if cpu1_fps else None,
             "cpu_16core_projected_files_per_s": round(cpu16_fps, 1) if cpu16_fps else None,
             "host_cores": host_cores,
