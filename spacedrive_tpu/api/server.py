@@ -65,6 +65,7 @@ class ApiServer:
                     "/spacedrive/file/{library_id}/{location_id}/{path:.*}",
                     self._file,
                 ),
+                web.get("/spacedrive/local", self._local_file),
             ]
         )
         self._runner: web.AppRunner | None = None
@@ -271,6 +272,25 @@ class ApiServer:
         ctype = mimetypes.guess_type(full)[0] or _sniff_mime(full)
         # FileResponse implements Range (206/Content-Range/416, incl.
         # suffix ranges) correctly — don't re-implement it
+        return web.FileResponse(
+            full,
+            headers={"Content-Type": ctype, "Accept-Ranges": "bytes"},
+        )
+
+    async def _local_file(self, request: web.Request) -> web.StreamResponse:
+        """Range-aware serving of a NON-INDEXED local path — the
+        ephemeral browse's preview source (the reference's custom URI
+        serves ephemeral paths the same way for ephemeral.tsx). Trust
+        model: identical to the ephemeralFiles.* procedures on the same
+        localhost API (which already list/rename/delete arbitrary local
+        paths); this route only adds read."""
+        raw = request.query.get("path", "")
+        full = os.path.abspath(raw)
+        if not raw or not os.path.isabs(raw):
+            raise web.HTTPBadRequest(text="absolute path required")
+        if not os.path.isfile(full):
+            raise web.HTTPNotFound()
+        ctype = mimetypes.guess_type(full)[0] or _sniff_mime(full)
         return web.FileResponse(
             full,
             headers={"Content-Type": ctype, "Accept-Ranges": "bytes"},

@@ -7,6 +7,11 @@ import client from "/rspc/client.js";
 import { $, KIND_ICON, bus, el, fmtBytes, relPath, state } from "/static/js/util.js";
 
 export const fileUrl = (n) => {
+  if (n.ephemeral) {
+    // non-indexed rows serve over the raw-path route (same trust
+    // surface as the ephemeralFiles.* procedures)
+    return `/spacedrive/local?path=${encodeURIComponent(n.path)}`;
+  }
   // per-segment encoding: "#"/"?" in filenames must not become
   // fragment/query separators (encodeURI leaves them bare)
   const path = relPath(n).split("/").map(encodeURIComponent).join("/");
@@ -23,14 +28,11 @@ let current = null; // node being previewed
 export const previewOpen = () => !!current;
 
 export function openPreview(n) {
-  // ephemeral (non-indexed) rows have no location to serve the file
-  // from and no db id to stamp — no preview until a raw-path file
-  // route exists
-  if (!n || n.is_dir || n.ephemeral) return;
+  if (!n || n.is_dir) return;
   current = n;
   render();
   $("preview-back").classList.add("open");
-  stampAccess(n);
+  stampAccess(n);  // no-op for ephemeral rows (no db id to stamp)
 }
 
 /** opening a preview counts as opening the file — feeds the recents

@@ -480,6 +480,33 @@ def test_explorer_ephemeral_network_keys(tmp_path):
                 sub = await _rspc(http, base, "ephemeralFiles.list",
                                   {"path": str(eph / "sub")})
                 assert sub["entries"] == []
+                # QuickPreview's raw-path source: range-aware serving of
+                # the non-indexed file (ref: the custom URI serving
+                # ephemeral.tsx's previews)
+                async with http.get(
+                    f"{base}/spacedrive/local",
+                    params={"path": str(eph / "pic.jpg")},
+                ) as resp:
+                    assert resp.status == 200
+                    assert resp.headers["Content-Type"] == "image/jpeg"
+                    body = await resp.read()
+                assert body[:2] == b"\xff\xd8"  # JPEG SOI
+                async with http.get(
+                    f"{base}/spacedrive/local",
+                    params={"path": str(eph / "pic.jpg")},
+                    headers={"Range": "bytes=0-1"},
+                ) as resp:
+                    assert resp.status == 206
+                    assert await resp.read() == body[:2]
+                async with http.get(
+                    f"{base}/spacedrive/local", params={"path": "rel/path"},
+                ) as resp:
+                    assert resp.status == 400
+                async with http.get(
+                    f"{base}/spacedrive/local",
+                    params={"path": "/no/such/file.bin"},
+                ) as resp:
+                    assert resp.status == 404
                 # volumes feed the sidebar
                 vols = await _rspc(http, base, "volumes.list")
                 assert vols and all("mount_point" in v for v in vols)
