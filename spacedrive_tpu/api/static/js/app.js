@@ -8,7 +8,7 @@ import { closeInspector, select } from "/static/js/inspector.js";
 import { onJobProgress, renderJobs, wireJobsPanel } from "/static/js/jobs.js";
 import { openDropPanel, rejectPendingOffer, showDropOffer, wireDropPanel } from "/static/js/spacedrop.js";
 import { addLocationModal, wireSettingsPanel } from "/static/js/settings.js";
-import { showMenu, wireContextMenu } from "/static/js/contextmenu.js";
+import { showEphemeralMenu, showMenu, wireContextMenu } from "/static/js/contextmenu.js";
 import { showOnboarding } from "/static/js/onboarding.js";
 import { attachDropdown, confirmDialog, initTooltips, promptDialog, toast } from "/static/js/ui.js";
 import { initI18n, t } from "/static/js/i18n.js";
@@ -265,6 +265,7 @@ $("btn-save-search").onclick = async () => {
 };
 $("btn-addloc").onclick = () => addLocationModal();
 bus.showMenu = showMenu;
+bus.showEphemeralMenu = showEphemeralMenu;
 wireJobsPanel();
 wireDropPanel();
 wireSettingsPanel();

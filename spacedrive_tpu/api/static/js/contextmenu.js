@@ -159,6 +159,77 @@ function batchRenameDialog(chosen, refresh) {
   });
 }
 
+// Context menu for NON-INDEXED rows (ref:core/src/api/ephemeral_files.rs
+// over the ephemeral.tsx menu): rename/delete on raw paths — the
+// db-backed affordances (tags, copy jobs, validate) don't apply.
+export function showEphemeralMenu(x, y, n) {
+  const refresh = () => bus.loadContent(true);
+  const displayName = n.name + (n.extension ? "." + n.extension : "");
+  // delete covers the whole selection (deleteFiles takes a batch);
+  // rename is single-item by nature
+  const chosen = state.selectedIds.has(n.id) && state.selectedIds.size > 1
+    ? state.nodes.filter(x => state.selectedIds.has(x.id)) : [n];
+  const many = chosen.length > 1;
+  openMenu(x, y, [
+    {
+      label: t("menu_rename"),
+      onClick: async () => {
+        const name = await promptDialog(t("rename_title"), {
+          value: displayName, actionLabel: t("rename"),
+        });
+        if (!name) return;
+        try {
+          await client.ephemeralFiles.renameFile(
+            {path: n.path, new_name: name});
+          refresh();
+        } catch (e) { toast(e.message, {kind: "error"}); }
+      },
+    },
+    {separator: true},
+    {
+      label: many
+        ? t("menu_n_items", {verb: t("menu_delete"), n: chosen.length})
+        : t("menu_delete"),
+      danger: true,
+      onClick: async () => {
+        const what = many ? t("n_items", {n: chosen.length})
+                          : `“${displayName}”`;
+        const ok = await confirmDialog(t("delete_confirm_title"),
+          t("eph_delete_body", {what}),
+          {danger: true, actionLabel: t("delete")});
+        if (!ok) return;
+        try {
+          const res = await client.ephemeralFiles.deleteFiles(
+            {paths: chosen.map(x => x.path)});
+          if (res.errors?.length)
+            toast(res.errors[0], {kind: "error"});
+          refresh();
+        } catch (e) { toast(e.message, {kind: "error"}); }
+      },
+    },
+  ]);
+}
+
+/** Empty-space menu in ephemeral mode: new folder in the current dir. */
+export function showEphemeralBackgroundMenu(x, y) {
+  openMenu(x, y, [
+    {
+      label: t("menu_new_folder"),
+      onClick: async () => {
+        const name = await promptDialog(t("new_folder_title"), {
+          value: t("new_folder_default"), actionLabel: t("create"),
+        });
+        if (!name) return;
+        try {
+          await client.ephemeralFiles.createFolder(
+            {path: state.ephPath, name});
+          bus.loadContent(true);
+        } catch (e) { toast(e.message, {kind: "error"}); }
+      },
+    },
+  ]);
+}
+
 export function showMenu(x, y, n) {
   const refresh = () => bus.loadContent(true);
   // when the clicked item is part of a multi-selection, batch ops
@@ -254,6 +325,8 @@ export function wireContextMenu() {
   $("content").addEventListener("contextmenu", (e) => {
     if (e.target.closest(".card, tr[data-fp]")) return;  // item menus
     e.preventDefault();
-    showBackgroundMenu(e.clientX, e.clientY);
+    if (state.mode === "ephemeral")
+      showEphemeralBackgroundMenu(e.clientX, e.clientY);
+    else showBackgroundMenu(e.clientX, e.clientY);
   });
 }

@@ -314,6 +314,10 @@ function renderCards(c, mediaOnly, nodes) {
         bus.showMenu(e.clientX, e.clientY, n); };
       draggable(card, n);
       if (n.is_dir) droppable(card, dirTarget(n));
+    } else {
+      card.oncontextmenu = (e) => { e.preventDefault();
+        if (!state.selectedIds.has(n.id)) bus.select(n);
+        bus.showEphemeralMenu(e.clientX, e.clientY, n); };
     }
     c.appendChild(card);
   }
@@ -340,6 +344,10 @@ function renderListRows(table, nodes) {
         bus.showMenu(e.clientX, e.clientY, n); };
       draggable(tr, n);
       if (n.is_dir) droppable(tr, dirTarget(n));
+    } else {
+      tr.oncontextmenu = (e) => { e.preventDefault();
+        if (!state.selectedIds.has(n.id)) bus.select(n);
+        bus.showEphemeralMenu(e.clientX, e.clientY, n); };
     }
     table.appendChild(tr);
   }

@@ -511,6 +511,30 @@ def test_explorer_ephemeral_network_keys(tmp_path):
                 vols = await _rspc(http, base, "volumes.list")
                 assert vols and all("mount_point" in v for v in vols)
 
+                # ephemeral context-menu flows: new folder, rename,
+                # delete on raw paths (ref:api/ephemeral_files.rs)
+                async with http.get(
+                    f"{base}/static/js/contextmenu.js") as resp:
+                    menu_js = await resp.text()
+                for probe in ("showEphemeralMenu",
+                              "ephemeralFiles.renameFile",
+                              "ephemeralFiles.deleteFiles",
+                              "ephemeralFiles.createFolder"):
+                    assert probe in menu_js, probe
+                await _rspc(http, base, "ephemeralFiles.createFolder",
+                            {"path": str(eph), "name": "made"})
+                await _rspc(http, base, "ephemeralFiles.renameFile",
+                            {"path": str(eph / "notes.txt"),
+                             "new_name": "renamed.txt"})
+                res = await _rspc(http, base, "ephemeralFiles.deleteFiles",
+                                  {"paths": [str(eph / "renamed.txt")]})
+                assert res == {"deleted": 1, "errors": []}
+                listing = await _rspc(http, base, "ephemeralFiles.list",
+                                      {"path": str(eph)})
+                names = {e["name"] for e in listing["entries"]}
+                assert "made" in names and "notes" not in names \
+                    and "renamed" not in names
+
                 # --- network page backend (p2p off on this node: the
                 # page renders the disabled state; live-peer rendering
                 # is pinned by test_p2p/test_punch over the same API)
