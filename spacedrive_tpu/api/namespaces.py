@@ -315,7 +315,7 @@ def _locations(r: Router) -> None:
             pub_id=new_pub_id(),
             name=arg["name"],
             default=False,
-            rules=[RulePerKind(kind=kind, parameters=list(arg["parameters"]))],
+            rules=[RulePerKind(kind=kind, params=list(arg["parameters"]))],
         )
         rid = library.db.insert(
             "indexer_rule",

@@ -21,6 +21,7 @@ export function renderSettings() {
     {id: "locations", label: t("tab_locations"), render: renderLocationsTab},
     {id: "volumes", label: t("tab_volumes"), render: renderVolumesTab},
     {id: "keys", label: t("tab_keys"), render: renderKeysTab},
+    {id: "rules", label: t("tab_rules"), render: renderRulesTab},
   ], {initial: activeTab, onSelect: (id) => { activeTab = id; }});
 }
 
@@ -154,6 +155,66 @@ async function renderVolumesTab(body) {
       `${fmtBytes(v.available_capacity)} free of ${fmtBytes(v.total_capacity)}`));
     body.appendChild(row);
   }
+}
+
+// Indexer rules (ref:interface settings/library/rules over
+// core/src/api/locations.rs indexer_rules): list system + custom
+// rules, create glob-based accept/reject rules, delete custom ones.
+async function renderRulesTab(body) {
+  const rules = await client.locations.indexerRules.list(null, state.lib);
+  const rerender = async () => { body.innerHTML = ""; await renderRulesTab(body); };
+  for (const r of rules) {
+    const row = el("div", "row");
+    row.dataset.rule = String(r.id);
+    row.appendChild(el("span", "", "📑 " + r.name));
+    row.appendChild(el("span", "meta",
+      r.default ? t("rule_system") : t("rule_custom")));
+    if (!r.default) {
+      const del = el("button", "mini", t("delete"));
+      del.onclick = async () => {
+        const ok = await confirmDialog(t("rule_delete_title"),
+          t("rule_delete_body", {name: r.name}),
+          {danger: true, actionLabel: t("delete")});
+        if (!ok) return;
+        try {
+          await client.locations.indexerRules.delete(r.id, state.lib);
+          rerender();
+        } catch (e) { toast(e.message, {kind: "error"}); }
+      };
+      row.appendChild(del);
+    }
+    body.appendChild(row);
+  }
+  const mk = el("div", "row");
+  const name = el("input");
+  name.placeholder = t("rule_name_placeholder");
+  const globs = el("input");
+  globs.placeholder = t("rule_globs_placeholder");
+  const kind = el("select");
+  for (const [value, key] of [["REJECT_FILES_BY_GLOB", "rule_reject"],
+                              ["ACCEPT_FILES_BY_GLOB", "rule_accept"]]) {
+    const o = el("option", "", t(key));
+    o.value = value;
+    kind.appendChild(o);
+  }
+  const add = el("button", "mini", "+");
+  add.onclick = async () => {
+    const patterns = globs.value.split(",").map(s => s.trim()).filter(Boolean);
+    if (!name.value.trim() || !patterns.length) return;
+    try {
+      await client.locations.indexerRules.create({
+        name: name.value.trim(), kind: kind.value, parameters: patterns,
+      }, state.lib);
+      toast(t("rule_created_toast"), {kind: "ok"});
+      rerender();
+    } catch (e) { toast(e.message, {kind: "error"}); }
+  };
+  mk.appendChild(name);
+  mk.appendChild(kind);
+  mk.appendChild(globs);
+  mk.appendChild(add);
+  body.appendChild(mk);
+  body.appendChild(el("p", "meta", t("rules_hint")));
 }
 
 // Key manager (ref:interface/app/$libraryId/KeyManager/ over

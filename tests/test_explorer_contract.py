@@ -437,7 +437,9 @@ def test_explorer_ephemeral_network_keys(tmp_path):
                 async with http.get(f"{base}/static/js/settings.js") as resp:
                     set_js = await resp.text()
                 for call in ("keys.state", "keys.unlock", "keys.add",
-                             "keys.mount", "keys.delete"):
+                             "keys.mount", "keys.delete",
+                             "indexerRules.list", "indexerRules.create",
+                             "indexerRules.delete"):
                     assert call in set_js, call
                 async with http.get(f"{base}/") as resp:
                     page = await resp.text()
@@ -576,6 +578,33 @@ def test_explorer_ephemeral_network_keys(tmp_path):
                 await _rspc(http, base, "keys.delete", added["uuid"], lid)
                 st = await _rspc(http, base, "keys.state", None, lid)
                 assert st["keys"] == []
+
+                # --- Rules settings pane backend: the full flow the
+                # tab drives (system rules undeletable; custom CRUD)
+                rules = await _rspc(http, base,
+                                    "locations.indexerRules.list", None, lid)
+                system = [r_ for r_ in rules if r_["default"]]
+                assert system, "system rules must ship with the library"
+                async with http.post(
+                    f"{base}/rspc/locations.indexerRules.delete",
+                    json={"arg": system[0]["id"], "library_id": lid},
+                ) as resp:
+                    assert resp.status == 400
+                rid = await _rspc(http, base,
+                                  "locations.indexerRules.create",
+                                  {"name": "no temps",
+                                   "kind": "REJECT_FILES_BY_GLOB",
+                                   "parameters": ["*.tmp", "cache/**"]},
+                                  lid)
+                rules = await _rspc(http, base,
+                                    "locations.indexerRules.list", None, lid)
+                assert any(r_["id"] == rid and not r_["default"]
+                           for r_ in rules)
+                await _rspc(http, base, "locations.indexerRules.delete",
+                            rid, lid)
+                rules = await _rspc(http, base,
+                                    "locations.indexerRules.list", None, lid)
+                assert not any(r_["id"] == rid for r_ in rules)
         finally:
             await node.shutdown()
 
