@@ -230,7 +230,12 @@ def _locations(r: Router) -> None:
             dry_run=bool(arg.get("dry_run", False)),
             indexer_rules_ids=arg.get("indexer_rules_ids", []),
         )
-        loc = args.create(library)
+        try:
+            loc = args.create(library)
+        except (NotADirectoryError, PermissionError, FileNotFoundError) as e:
+            # a bad/unreadable path is the caller's error, not a crash
+            # (ref:api/locations.rs create error variants)
+            raise RspcError.bad_request(f"location path: {e}")
         if loc is None:
             return None
         await scan_location(library, loc, node.jobs)

@@ -12,10 +12,13 @@ rspc's merge naming.
 from __future__ import annotations
 
 import inspect
+import logging
 import uuid
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, AsyncIterator, Awaitable, Callable
+
+logger = logging.getLogger(__name__)
 
 
 class CoreEventKind(str, Enum):
@@ -107,9 +110,27 @@ class Router:
             args.append(lib)
         if _wants_arg(proc.fn, proc.library_scoped):
             args.append(arg)
-        result = proc.fn(*args)
-        if inspect.isawaitable(result):
-            result = await result
+        try:
+            result = proc.fn(*args)
+            if inspect.isawaitable(result):
+                result = await result
+        except (KeyError, TypeError, ValueError) as e:
+            # Handlers index straight into the caller's arg shape (the
+            # rspc style); a wrong shape is the CLIENT's error and must
+            # answer 400, not crash to a 500 (ref:rspc BadRequest). But
+            # ONLY when the raising frame is the handler body itself —
+            # the same exception types from deeper in the call tree are
+            # server bugs and must keep their 500 + traceback log.
+            tb = e.__traceback__
+            innermost = None
+            while tb is not None:
+                innermost = tb.tb_frame.f_code
+                tb = tb.tb_next
+            if innermost is not proc.fn.__code__:
+                raise
+            logger.warning("bad argument for %s: %r", key, e)
+            raise RspcError.bad_request(
+                f"bad argument for {key}: {type(e).__name__}: {e}")
         return result
 
     def subscribe(

@@ -1,0 +1,208 @@
+"""API-surface smoke: every procedure the higher-level flows don't
+reach gets CALLED with plausible arguments against a live server.
+
+Why: writing the Rules settings pane exposed that
+`locations.indexerRules.create` had shipped with an argument-shape
+TypeError — a whole class of bug (handler signature vs caller shape)
+that only fires on invocation. This test eliminates the class: a call
+may succeed (200) or refuse with a DOMAIN error (4xx), but a 500 is
+always a latent handler bug. Subscriptions are exercised over the same
+websocket frames the generated client sends.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+
+async def _server(tmp_path):
+    from spacedrive_tpu.node import Node
+
+    node = Node(str(tmp_path / "node"), use_device=False, with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    port = await node.start_api()
+    return node, f"http://127.0.0.1:{port}"
+
+
+def test_every_uncovered_procedure_answers_without_500(tmp_path):
+    async def run():
+        import aiohttp
+
+        node, base = await _server(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as http:
+                results = {}
+
+                async def call(key, arg=None, lib=None, want=(200,)):
+                    async with http.post(
+                        f"{base}/rspc/{key}",
+                        json={"arg": arg, "library_id": lib},
+                    ) as resp:
+                        body = await resp.json()
+                        assert resp.status != 500, (key, body)
+                        assert resp.status in want, (key, resp.status, body)
+                        results[key] = resp.status
+                        return body.get("result")
+
+                lid = (await call("library.create", {"name": "smoke"}))["uuid"]
+                root = tmp_path / "files"
+                root.mkdir()
+                (root / "a.txt").write_text("alpha")
+                (root / "b.txt").write_text("beta")
+                loc_id = await call("locations.create", {"path": str(root)}, lid)
+                for _ in range(150):
+                    page = await call("search.paths", {"filter": {}}, lid)
+                    rows = [n for n in page["nodes"]
+                            if n.get("extension") == "txt"
+                            and n.get("object_id")]
+                    if len(rows) == 2:
+                        break
+                    await asyncio.sleep(0.1)
+                assert len(rows) == 2
+                fp, fp2 = rows
+                oid = fp["object_id"]
+
+                # --- albums / spaces (generic collections namespaces)
+                for ns in ("albums", "spaces"):
+                    cid = await call(f"{ns}.create", {"name": "c1"}, lid)
+                    got = await call(f"{ns}.list", None, lid)
+                    assert any(c["id"] == cid for c in got["nodes"]), ns
+                    await call(f"{ns}.addObjects",
+                               {"id": cid, "object_ids": [oid]}, lid)
+                    objs = await call(f"{ns}.getObjects", cid, lid)
+                    assert len(objs["nodes"]) == 1, ns
+                    await call(f"{ns}.delete", cid, lid)
+
+                # --- auth (stubbed identity provider)
+                await call("auth.me")
+                await call("auth.logout")
+
+                # --- backups: deleting a nonexistent backup is a no-op
+                # or a domain refusal, never a crash
+                await call("backups.delete", "no-such-backup",
+                           want=(200, 400, 404))
+
+                # --- cloud config (no live cloud: enable may refuse)
+                await call("cloud.getApiOrigin")
+                await call("cloud.setApiOrigin", "http://127.0.0.1:9")
+                await call("cloud.library.get", None, lid,
+                           want=(200, 400, 404, 502))
+                await call("cloud.sync.enable", None, lid,
+                           want=(200, 400, 404, 502))
+
+                # --- files extras
+                await call("files.setNote",
+                           {"id": fp["id"], "note": "hello"}, lid)
+                await call("files.validate",
+                           {"location_id": loc_id, "sub_path": "/"}, lid)
+                await call("files.eraseFiles",
+                           {"location_id": loc_id,
+                            "file_path_ids": [fp2["id"]],
+                            "passes": 1}, lid)
+
+                # --- jobs bookkeeping
+                await call("jobs.isActive", None, lid)
+                await call("jobs.clear", "00000000-0000-0000-0000-000000000000",
+                           lid, want=(200, 400, 404))
+                await call("jobs.clearAll", None, lid)
+
+                # --- labels read paths (none assigned: empty results)
+                await call("labels.getForObject", oid, lid)
+                await call("labels.getWithObjects", [oid], lid,
+                           want=(200, 400))
+                await call("labels.delete", 999999, lid,
+                           want=(200, 400, 404))
+
+                # --- locations breadth
+                await call("locations.get", loc_id, lid)
+                await call("locations.update",
+                           {"id": loc_id, "name": "renamed"}, lid)
+                await call("locations.indexerRules.listForLocation",
+                           loc_id, lid)
+                await call("locations.subPathRescan",
+                           {"location_id": loc_id, "sub_path": "/"}, lid)
+                await call("locations.relink", {"path": str(root)}, lid,
+                           want=(200, 400, 404))
+                # wrong arg SHAPE answers 400 with detail, never 500
+                # (the class of bug this whole test exists to catch)
+                await call("locations.relink", "just-a-string", lid,
+                           want=(400,))
+                # a nonexistent path is the CALLER's error too
+                await call("locations.create",
+                           {"path": "/nonexistent-dir-xyz"}, lid,
+                           want=(400,))
+
+                # --- misc node surfaces
+                await call("models.imageDetection.list")
+                await call("nodes.updateThumbnailerPreferences",
+                           {"background_processing_percentage": 50})
+                await call("notifications.dismiss", 999999, lid,
+                           want=(200, 400, 404))
+                await call("notifications.dismissAll", None, lid)
+                await call("search.detectDuplicates",
+                           {"location_id": loc_id}, lid,
+                           want=(200, 400))
+                await call("volumes.track", None, lid)
+
+                # --- p2p guards: disabled node must refuse cleanly
+                for key, arg in (
+                    ("p2p.acceptSpacedrop", {"id": "x", "path": "/tmp"}),
+                    ("p2p.rejectSpacedrop", "x"),
+                    ("p2p.cancelSpacedrop", "x"),
+                    ("p2p.acceptPairing", 1),
+                    ("p2p.rejectPairing", 1),
+                ):
+                    await call(key, arg, want=(200, 400, 404))
+
+                # --- sync namespace (single node: enabled=False path)
+                await call("sync.enabled", None, lid)
+                await call("sync.messages", None, lid)
+                await call("sync.backfill", None, lid, want=(200, 400))
+
+                # --- tags breadth
+                tag_id = await call("tags.create", {"name": "t"}, lid)
+                await call("tags.update",
+                           {"id": tag_id, "name": "t2", "color": "#f00"},
+                           lid)
+                await call("tags.delete", tag_id, lid)
+
+                # --- library breadth (edit, then delete a 2nd library)
+                await call("library.edit",
+                           {"id": lid, "name": "smoke2"}, lid)
+                lid2 = (await call("library.create", {"name": "gone"}))["uuid"]
+                await call("library.delete", lid2)
+                libs = await call("library.list")
+                assert [l["uuid"] for l in libs] == [lid]
+
+                # --- subscriptions over the SAME ws frames the client
+                # sends: each must register and not kill the socket
+                ws = await http.ws_connect(f"{base}/rspc/ws")
+                for i, (key, lib) in enumerate([
+                    ("notifications.listen", None),
+                    ("p2p.events", None),
+                    ("sync.newMessage", lid),
+                    ("invalidation.listen", None),
+                ]):
+                    await ws.send_str(json.dumps({
+                        "id": str(i), "type": "subscriptionAdd",
+                        "key": key, "library_id": lib,
+                    }))
+                # a mutation that fires invalidations; the socket must
+                # still be alive and deliver something
+                await call("tags.create", {"name": "after-sub"}, lid)
+                got_frame = False
+                try:
+                    msg = await ws.receive(timeout=10)
+                    got_frame = msg.type == aiohttp.WSMsgType.TEXT
+                except asyncio.TimeoutError:
+                    pass
+                assert got_frame, "subscription socket delivered nothing"
+                await ws.close()
+
+                assert len(results) >= 45, sorted(results)
+        finally:
+            await node.shutdown()
+
+    asyncio.run(run())
