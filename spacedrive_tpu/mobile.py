@@ -57,10 +57,6 @@ _event_cb: Callable[[str], None] | None = None
 _subscriptions: dict[Any, asyncio.Task] = {}
 
 
-class BridgeError(Exception):
-    pass
-
-
 def _runtime() -> asyncio.AbstractEventLoop:
     """The RUNTIME static: one background loop thread, lazily started."""
     global _loop, _thread
