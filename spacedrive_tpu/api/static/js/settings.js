@@ -112,6 +112,61 @@ async function renderLibraryTab(body) {
   act.appendChild(newBtn);
   act.appendChild(delBtn);
   body.appendChild(act);
+
+  // Backups (ref:core/src/api/backups.rs + interface settings/node/
+  // backups): snapshot now, restore or delete existing snapshots
+  body.appendChild(el("h4", "", t("backups_heading")));
+  const mk = el("div", "row");
+  const bk = el("button", "mini", t("backup_now"));
+  const rerender = async () => { body.innerHTML = ""; await renderLibraryTab(body); };
+  bk.onclick = async () => {
+    try {
+      await client.backups.backup(null, state.lib);
+      toast(t("backup_done_toast"), {kind: "ok"});
+      rerender();
+    } catch (e) { toast(e.message, {kind: "error"}); }
+  };
+  mk.appendChild(bk);
+  body.appendChild(mk);
+  // only THIS library's snapshots: restore targets the backup's own
+  // library_id, so listing others here would roll back a library the
+  // user isn't even looking at
+  const backups = (await client.backups.getAll())
+    .filter(b => b.library_id === state.lib);
+  if (!backups.length)
+    body.appendChild(el("p", "meta", t("backups_empty")));
+  for (const b of backups) {
+    const row = el("div", "row");
+    row.dataset.backup = b.id;
+    row.appendChild(el("span", "", "🗄 " + (b.library_name || b.id)));
+    row.appendChild(el("span", "meta", (b.timestamp || "").slice(0, 19)));
+    const rs = el("button", "mini", t("backup_restore"));
+    rs.onclick = async () => {
+      const ok = await confirmDialog(t("backup_restore_title"),
+        t("backup_restore_body", {ts: (b.timestamp || "").slice(0, 19)}),
+        {danger: true, actionLabel: t("backup_restore")});
+      if (!ok) return;
+      try {
+        await client.backups.restore({path: b.path});
+        toast(t("backup_restored_toast"), {kind: "ok"});
+        bus.reloadLibraries?.();
+      } catch (e) { toast(e.message, {kind: "error"}); }
+    };
+    row.appendChild(rs);
+    const del = el("button", "mini", t("delete"));
+    del.onclick = async () => {
+      const ok = await confirmDialog(t("backup_delete_title"),
+        t("backup_delete_body", {ts: (b.timestamp || "").slice(0, 19)}),
+        {danger: true, actionLabel: t("delete")});
+      if (!ok) return;
+      try {
+        await client.backups.delete(b.path);
+        rerender();
+      } catch (e) { toast(e.message, {kind: "error"}); }
+    };
+    row.appendChild(del);
+    body.appendChild(row);
+  }
 }
 
 async function renderLocationsTab(body) {

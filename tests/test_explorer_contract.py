@@ -439,7 +439,9 @@ def test_explorer_ephemeral_network_keys(tmp_path):
                 for call in ("keys.state", "keys.unlock", "keys.add",
                              "keys.mount", "keys.delete",
                              "indexerRules.list", "indexerRules.create",
-                             "indexerRules.delete"):
+                             "indexerRules.delete",
+                             "backups.backup", "backups.getAll",
+                             "backups.restore", "backups.delete"):
                     assert call in set_js, call
                 async with http.get(f"{base}/") as resp:
                     page = await resp.text()
@@ -605,6 +607,22 @@ def test_explorer_ephemeral_network_keys(tmp_path):
                 rules = await _rspc(http, base,
                                     "locations.indexerRules.list", None, lid)
                 assert not any(r_["id"] == rid for r_ in rules)
+
+                # --- Backups section backend: snapshot → mutate →
+                # restore rolls the mutation back → delete snapshot
+                await _rspc(http, base, "backups.backup", None, lid)
+                backups = await _rspc(http, base, "backups.getAll")
+                assert len(backups) == 1 and backups[0]["library_id"] == lid
+                tagged = await _rspc(http, base, "tags.create",
+                                     {"name": "post-backup"}, lid)
+                await _rspc(http, base, "backups.restore",
+                            {"path": backups[0]["path"]})
+                tags = await _rspc(http, base, "tags.list", None, lid)
+                assert not any(tg["id"] == tagged for tg in tags["nodes"]), \
+                    "restore did not roll back the post-backup tag"
+                await _rspc(http, base, "backups.delete",
+                            backups[0]["path"])
+                assert await _rspc(http, base, "backups.getAll") == []
         finally:
             await node.shutdown()
 
