@@ -211,15 +211,43 @@ def _locations(r: Router) -> None:
         scan_location,
     )
 
+    async def _with_online(library, rows):
+        """`online` for LOCALLY-owned locations = the path is reachable
+        (unplugged drive / unmounted share); the reference's sidebar
+        dot (ref:core/src/location/mod.rs online set + interface
+        Sidebar). Rows owned by other instances keep online=None —
+        their connectivity rides p2p.state, and a local isdir on a
+        remote path would mislabel every synced location offline.
+        Probes run OFF the event loop: a hung network mount must stall
+        this request, not the whole node."""
+        rows = [dict(row) for row in rows]
+        local = library.config.instance_id
+
+        def probe(path):
+            return bool(path) and os.path.isdir(path)
+
+        checks = [
+            asyncio.to_thread(probe, row.get("path"))
+            for row in rows if row.get("instance_id") == local
+        ]
+        verdicts = iter(await asyncio.gather(*checks))
+        for row in rows:
+            row["online"] = (next(verdicts)
+                             if row.get("instance_id") == local else None)
+        return rows
+
     @r.query("locations.list", library=True)
-    def list_locations(node, library):
-        return normalise("location", library.db.find("location"))
+    async def list_locations(node, library):
+        return normalise(
+            "location", await _with_online(library, library.db.find("location"))
+        )
 
     @r.query("locations.get", library=True)
-    def get_location(node, library, arg):
+    async def get_location(node, library, arg):
         row = library.db.find_one("location", id=int(arg))
         if row is None:
             raise RspcError.not_found("location")
+        [row] = await _with_online(library, [row])
         return normalise_one("location", row)
 
     @r.mutation("locations.create", library=True)

@@ -98,7 +98,12 @@ async function refreshNav() {
   for (const n of locs.nodes) {
     state.locPaths[n.id] = n.path;
     state.locNames[n.id] = n.name || n.path;
-    const item = el("div", "item", "📂 " + (n.name || n.path));
+    const item = el("div", "item",
+      (n.online === false ? "⚠️ " : "📂 ") + (n.name || n.path));
+    if (n.online === false) {
+      item.style.opacity = "0.55";
+      item.title = t("location_offline_tip");
+    }
     item.onclick = () => { setActive(item);
       Object.assign(state, {loc:n.id, tag:null, cursor:null, path:"/",
                             mode:"browse"});

@@ -677,6 +677,27 @@ def test_explorer_ring3_flows(tmp_path):
                     await asyncio.sleep(0.1)
                 assert len(rows) == 3, "identification never linked objects"
 
+                # locations report reachability for the sidebar dot
+                locs = await _rspc(http, base, "locations.list", None, lid)
+                assert locs["nodes"] and all(
+                    n["online"] is True for n in locs["nodes"])
+                import shutil as _sh
+                # pause the watcher first: a poll landing in the
+                # moved-away window would emit REMOVEs and delete the
+                # rows the later assertions use
+                loc_row = locs["nodes"][0]
+                lib_obj = node.libraries.libraries[
+                    __import__("uuid").UUID(lid)]
+                node.location_manager.pause(lib_obj, loc_row["id"])
+                _sh.move(str(src), str(src) + "-moved")
+                try:
+                    locs = await _rspc(http, base, "locations.list",
+                                       None, lid)
+                    assert all(n["online"] is False for n in locs["nodes"])
+                finally:
+                    _sh.move(str(src) + "-moved", str(src))
+                    node.location_manager.resume(lib_obj, loc_row["id"])
+
                 tag_id = await _rspc(http, base, "tags.create",
                                      {"name": "urgent"}, lid)
                 oids = [r_["object_id"] for r_ in rows]
