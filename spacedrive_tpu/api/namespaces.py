@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import os
 import uuid
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, AsyncIterator
 
 from ..db.database import blob_u64, new_pub_id, now_iso
@@ -197,6 +198,12 @@ def _library(r: Router) -> None:
 # --- locations -----------------------------------------------------------
 
 
+# reachability probes get their own tiny pool (see _with_online): a
+# hung mount must never occupy the shared default executor
+_PROBE_POOL = ThreadPoolExecutor(max_workers=2,
+                                 thread_name_prefix="loc-probe")
+
+
 def _locations(r: Router) -> None:
     from ..location.indexer.rules import (
         IndexerRule,
@@ -218,16 +225,29 @@ def _locations(r: Router) -> None:
         Sidebar). Rows owned by other instances keep online=None —
         their connectivity rides p2p.state, and a local isdir on a
         remote path would mislabel every synced location offline.
-        Probes run OFF the event loop: a hung network mount must stall
-        this request, not the whole node."""
+        Probes run on a DEDICATED 2-thread pool with a short timeout: a
+        hung network mount must cost this request one bounded probe,
+        never the shared to_thread executor the thumbnailer/identifier
+        pipelines live on (a blocked isdir per refresh would exhaust it
+        node-wide). Timed-out probes report offline — a mount that
+        can't answer a stat in a second isn't browsable anyway."""
         rows = [dict(row) for row in rows]
         local = library.config.instance_id
+        loop = asyncio.get_running_loop()
 
-        def probe(path):
-            return bool(path) and os.path.isdir(path)
+        async def probe(path):
+            if not path:
+                return False
+            try:
+                return await asyncio.wait_for(
+                    loop.run_in_executor(_PROBE_POOL, os.path.isdir, path),
+                    timeout=1.0,
+                )
+            except asyncio.TimeoutError:
+                return False
 
         checks = [
-            asyncio.to_thread(probe, row.get("path"))
+            probe(row.get("path"))
             for row in rows if row.get("instance_id") == local
         ]
         verdicts = iter(await asyncio.gather(*checks))

@@ -685,9 +685,10 @@ def test_explorer_ring3_flows(tmp_path):
                 # pause the watcher first: a poll landing in the
                 # moved-away window would emit REMOVEs and delete the
                 # rows the later assertions use
+                import uuid as _uuid
+
                 loc_row = locs["nodes"][0]
-                lib_obj = node.libraries.libraries[
-                    __import__("uuid").UUID(lid)]
+                lib_obj = node.libraries.libraries[_uuid.UUID(lid)]
                 node.location_manager.pause(lib_obj, loc_row["id"])
                 _sh.move(str(src), str(src) + "-moved")
                 try:
