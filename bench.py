@@ -55,9 +55,10 @@ def median_spread(samples: list[float]) -> tuple[float, float, float]:
 
 
 def main() -> None:
-    from spacedrive_tpu import native
+    from spacedrive_tpu import native, telemetry
     from spacedrive_tpu.ops import blake3_jax, configure_compilation_cache
     from spacedrive_tpu.ops.cas import LARGE_CHUNKS, LARGE_MSG_LEN
+    from spacedrive_tpu.telemetry import metrics as tm
 
     import jax
     import jax.numpy as jnp
@@ -88,12 +89,16 @@ def main() -> None:
     jax.block_until_ready(jax.device_put(probe))
 
     def probe_link() -> float:
+        """Probe host→device bandwidth; the telemetry registry is the
+        system of record (bench reads the gauge back for its report,
+        and a live node exposes the same series on /metrics)."""
         best = 0.0
         for _ in range(3):
             t0 = time.perf_counter()
             np.asarray(jnp.sum(jax.device_put(probe)))  # force full arrival
             best = max(best, probe.nbytes / (time.perf_counter() - t0))
-        return best / 1e9
+        tm.BENCH_LINK_PROBE_GBPS.set(best / 1e9)
+        return telemetry.gauge_value("sd_bench_link_probe_gbps")
 
     wait_budget = float(os.environ.get("SD_BENCH_WAIT", "240"))
     waited = 0.0
@@ -139,7 +144,6 @@ def main() -> None:
         jax.block_until_ready(distinct[-1])
 
     chain(chain_k)  # warm/compile
-    marginals = []
     for rep in range(repeats):
         refresh_all(2 * rep)
         t0 = time.perf_counter()
@@ -149,7 +153,11 @@ def main() -> None:
         t0 = time.perf_counter()
         chain(chain_k)
         tk = time.perf_counter() - t0
-        marginals.append(max(1e-9, (tk - t1) / (chain_k - 1)))
+        tm.BENCH_DEVICE_BATCH_SECONDS.observe(
+            max(1e-9, (tk - t1) / (chain_k - 1)))
+    # per-batch device timings come back OUT of the registry — the
+    # reported numbers and the scrapable histogram cannot diverge
+    marginals = telemetry.histogram_recent("sd_bench_device_batch_seconds")
     dev_s, dev_lo, dev_hi = median_spread(marginals)
     dev_gbps = batch_bytes / dev_s / 1e9
     roofline_ok = dev_gbps <= V5E_HBM_GBPS
@@ -164,21 +172,25 @@ def main() -> None:
 
     # --- e2e: host memory → device → digests, pipelined like production
     pipe_depth = 3
-    e2e = []
     e2e_reps = repeats
-    while len(e2e) < e2e_reps:
-        if len(e2e) == 1 and e2e[0] > 5.0:
+    rep_no = 0
+    while rep_no < e2e_reps:
+        done = telemetry.histogram_recent("sd_bench_e2e_batch_seconds")
+        if len(done) == 1 and done[0] > 5.0:
             e2e_reps = max(2, repeats - 3)  # congested: don't burn minutes
         t0 = time.perf_counter()
         acc = None
         for i in range(pipe_depth):
             a = arr.copy()
-            a[:, 1] = (len(e2e) * pipe_depth + i) % 251  # unseen content every rep
+            a[:, 1] = (rep_no * pipe_depth + i) % 251  # unseen content every rep
             w = blake3_jax.hash_batch(a, lens, max_chunks=LARGE_CHUNKS)
             s = jnp.sum(w)
             acc = s if acc is None else acc + s
         np.asarray(acc)
-        e2e.append((time.perf_counter() - t0) / pipe_depth)
+        tm.BENCH_E2E_BATCH_SECONDS.observe(
+            (time.perf_counter() - t0) / pipe_depth)
+        rep_no += 1
+    e2e = telemetry.histogram_recent("sd_bench_e2e_batch_seconds")
     e2e_s, e2e_lo, e2e_hi = median_spread(e2e)
     e2e_fps = n / e2e_s
     # bracket the e2e leg: the tunnel swings on minute scales, so the

@@ -56,6 +56,7 @@ def mount() -> Router:
     _backups(r)
     _auth(r)
     _models(r)
+    _telemetry(r)
     _invalidation(r)
     install_registry(r)
     return r
@@ -1355,6 +1356,11 @@ def _keys(r: Router) -> None:
     @r.mutation("keys.unlock", library=True)
     def unlock(node, library, arg):
         km = _key_manager(library)
+        # snapshot BEFORE clobbering: a wrong-password retry against an
+        # already-unlocked vault must restore the working master, not
+        # lock the manager and yank every mounted key out from under
+        # its consumers (ADVICE r5)
+        prev_master = bytes(km._master) if km.unlocked else None
         km.set_master_password(str(arg["password"]).encode())
         if km.stored:
             # VERIFY before committing: decrypting a stored key proves
@@ -1370,7 +1376,13 @@ def _keys(r: Router) -> None:
             try:
                 km.mount(probe)
             except CryptoError:
-                km.lock()
+                if prev_master is not None:
+                    # mounted keys were never touched (the failed probe
+                    # mounts nothing); restoring the master returns the
+                    # manager to its exact pre-call state
+                    km.set_master_password(prev_master)
+                else:
+                    km.lock()
                 invalidate_query(node, "keys.state", library)
                 raise RspcError.bad_request("wrong master password")
             if probe not in mounted_before \
@@ -1571,6 +1583,22 @@ def _models(r: Router) -> None:
     def list_models(node):
         # ref:crates/ai image_labeler/model listing; one built-in JAX model
         return ["labeler-net-v1"]
+
+
+def _telemetry(r: Router) -> None:
+    """The explorer's diagnostics read path — the same registry the
+    /metrics scrape endpoint renders, so the frontend and Prometheus
+    can never disagree about a number."""
+    from .. import telemetry
+
+    @r.query("telemetry.snapshot")
+    def snapshot(node):
+        return telemetry.snapshot()
+
+    @r.query("telemetry.render")
+    def render(node):
+        # the Prometheus text, for copy/paste diagnostics in the UI
+        return {"text": telemetry.render()}
 
 
 def _invalidation(r: Router) -> None:

@@ -23,12 +23,19 @@ from typing import Any
 
 from aiohttp import WSMsgType, web
 
+from .. import telemetry
 from ..files.isolated_path import full_path_from_db_row
 from .router import Router, RspcError
 
 logger = logging.getLogger(__name__)
 
 CHUNK = 256 * 1024
+
+# Host values a browser can only produce for a genuinely-local page.
+# Anything else on this localhost-bound server means DNS rebinding: a
+# hostile page resolving its own domain to 127.0.0.1 to read
+# /spacedrive/local and the ephemeralFiles.* procedures cross-origin.
+LOCAL_HOSTNAMES = frozenset({"127.0.0.1", "localhost", "::1"})
 
 
 def _json_default(o: Any) -> Any:
@@ -51,10 +58,13 @@ class ApiServer:
     def __init__(self, node: Any, router: Router):
         self.node = node
         self.router = router
-        self.app = web.Application()
+        self._allowed_hosts = set(LOCAL_HOSTNAMES)
+        self._allow_any_host = False
+        self.app = web.Application(middlewares=[self._host_guard])
         self.app.add_routes(
             [
                 web.get("/", self._index),
+                web.get("/metrics", self._metrics),
                 web.get("/static/{path:.*}", self._static),
                 web.get("/rspc/client.js", self._client_js),
                 web.get("/rspc/manifest", self._manifest),
@@ -75,6 +85,15 @@ class ApiServer:
     # --- lifecycle -----------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        if host in ("", "0.0.0.0", "::"):
+            # a DELIBERATE wildcard bind is LAN exposure: clients
+            # legitimately arrive under names we cannot enumerate, so
+            # the rebinding guard (scoped to the default localhost
+            # bind, ADVICE r5) stands down rather than 403 everyone
+            self._allow_any_host = True
+        else:
+            # explicit non-local binds stay reachable by their own name
+            self._allowed_hosts.add(host)
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -86,6 +105,27 @@ class ApiServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+
+    @web.middleware
+    async def _host_guard(self, request: web.Request, handler) -> web.StreamResponse:
+        """Reject requests whose Host header names anything but this
+        machine — closes the DNS-rebinding read path through
+        /spacedrive/local and the ephemeralFiles.* procedures
+        (ADVICE r5). An absent Host (HTTP/1.0) is local tooling."""
+        host = request.headers.get("Host")
+        if host and not self._allow_any_host \
+                and _hostname_of(host) not in self._allowed_hosts:
+            raise web.HTTPForbidden(text="bad host")
+        return await handler(request)
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        """Prometheus scrape endpoint over the process registry."""
+        return web.Response(
+            text=telemetry.render(),
+            content_type="text/plain",
+            charset="utf-8",
+            headers={"X-Prometheus-Format": "0.0.4"},
+        )
 
     async def _index(self, _request: web.Request) -> web.FileResponse:
         """The explorer web UI (role parity: ref:interface/ + apps/web)."""
@@ -430,6 +470,15 @@ class _StreamSink:
                 if task is not fetch:
                     task.cancel()
         return self._chunks.pop(0)
+
+
+def _hostname_of(host: str) -> str:
+    """Hostname from a Host header value: strips :port, unwraps IPv6
+    brackets, lowercases, drops a trailing FQDN dot."""
+    host = host.strip().lower()
+    if host.startswith("["):  # [::1]:port
+        return host.partition("]")[0].lstrip("[")
+    return host.rsplit(":", 1)[0].rstrip(".") if host else host
 
 
 def _sniff_mime(path: str) -> str:

@@ -15,7 +15,10 @@ import enum
 import secrets
 import struct
 
-from cryptography.hazmat.primitives.kdf.argon2 import Argon2id
+try:
+    from cryptography.hazmat.primitives.kdf.argon2 import Argon2id
+except ImportError:  # gated: Argon2id derivation refuses at use below
+    Argon2id = None  # type: ignore
 
 from .. import native
 from .stream import KEY_LEN, CryptoError
@@ -74,6 +77,9 @@ class HashingAlgorithm:
         if len(salt) != SALT_LEN:
             raise CryptoError(f"salt must be {SALT_LEN} bytes")
         if self.kind == self.ARGON2ID:
+            if Argon2id is None:
+                raise CryptoError(
+                    "the `cryptography` package is required for Argon2id")
             memory, iterations, lanes = _test_overrides or _ARGON2[self.params]
             return Argon2id(
                 salt=salt,

@@ -38,13 +38,14 @@ MAX_KEYSLOTS = 2  # ref:header/keyslot.rs
 
 
 def _aead_for(algorithm: Algorithm, key: bytes):
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
-    return (
-        XChaCha20Poly1305(key)
-        if algorithm is Algorithm.XCHACHA20_POLY1305
-        else AESGCM(key)
-    )
+    if algorithm is Algorithm.XCHACHA20_POLY1305:
+        return XChaCha20Poly1305(key)
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ImportError:
+        raise CryptoError(
+            "the `cryptography` package is required for AES-256-GCM")
+    return AESGCM(key)
 
 
 @dataclass

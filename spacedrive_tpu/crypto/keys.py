@@ -155,10 +155,12 @@ class KeyManager:
         derived = sk.hashing_algorithm.hash_password(
             self._require_master(), sk.salt, _test_overrides=self._overrides
         )
+        # constructed OUTSIDE the decrypt try: a crypto-unavailable
+        # refusal (gated AEAD) must surface as itself, not be
+        # misreported as a wrong password
+        aead = _aead_for(sk.algorithm, derived)
         try:
-            key = _aead_for(sk.algorithm, derived).decrypt(
-                sk.nonce, sk.encrypted_key, None
-            )
+            key = aead.decrypt(sk.nonce, sk.encrypted_key, None)
         except Exception as e:
             raise CryptoError("wrong master password for key") from e
         self._mounted[key_uuid] = bytearray(key)

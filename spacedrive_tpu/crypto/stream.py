@@ -16,8 +16,14 @@ import os
 import secrets
 from typing import BinaryIO
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # gated: AEADs refuse at construction time below
+    AESGCM = None  # type: ignore
+
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        """Placeholder so except-clauses stay valid; never raised."""
 
 from .xchacha import XChaCha20Poly1305
 
@@ -60,6 +66,9 @@ class _Stream:
         self.algorithm = algorithm
         self.base_nonce = base_nonce
         self.counter = 0
+        if algorithm is not Algorithm.XCHACHA20_POLY1305 and AESGCM is None:
+            raise CryptoError(
+                "the `cryptography` package is required for AES-256-GCM")
         self._aead = (
             XChaCha20Poly1305(key)
             if algorithm is Algorithm.XCHACHA20_POLY1305

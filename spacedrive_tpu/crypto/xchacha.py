@@ -15,7 +15,10 @@ from __future__ import annotations
 
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # gated: construction refuses below
+    ChaCha20Poly1305 = None  # type: ignore
 
 _MASK = 0xFFFFFFFF
 
@@ -62,6 +65,14 @@ class XChaCha20Poly1305:
     NONCE_LEN = 24
 
     def __init__(self, key: bytes):
+        if ChaCha20Poly1305 is None:
+            # CryptoError so keys/stream/header handlers see a clean
+            # "crypto unavailable" instead of misreading the refusal
+            # as a wrong password (lazy import: stream imports us)
+            from .stream import CryptoError
+
+            raise CryptoError(
+                "the `cryptography` package is required for XChaCha20")
         if len(key) != 32:
             raise ValueError("key must be 32 bytes")
         self._key = key

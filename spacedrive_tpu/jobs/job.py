@@ -22,6 +22,7 @@ from typing import Any, TYPE_CHECKING
 import msgpack
 
 from ..tasks import ExecStatus, Interrupter, InterruptionKind, Task
+from ..telemetry import metrics as _tm
 from .report import JobReport, JobStatus
 
 if TYPE_CHECKING:
@@ -53,6 +54,8 @@ class JobContext:
         self.report = report
         self.manager = manager
         self._started = time.monotonic()
+        self._phase: str | None = None
+        self._phase_started = self._started
 
     def progress(
         self,
@@ -70,10 +73,25 @@ class JobContext:
         if message is not None:
             r.message = message
         if phase is not None:
+            if phase != self._phase:
+                self._close_phase()
+                self._phase = phase
             r.phase = phase
         r.estimate_completion(time.monotonic() - self._started)
         if self.manager is not None:
             self.manager._emit_progress(self)
+
+    def _close_phase(self) -> None:
+        """Observe the elapsed phase into sd_job_phase_seconds; the
+        pre-first-phase stretch records as "init". Called on every
+        phase transition and by the manager when the job settles."""
+        now = time.monotonic()
+        _tm.JOB_PHASE_SECONDS.observe(
+            now - self._phase_started,
+            job=self.report.name,
+            phase=self._phase or "init",
+        )
+        self._phase_started = now
 
 
 class StatefulJob(abc.ABC):

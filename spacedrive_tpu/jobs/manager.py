@@ -87,6 +87,9 @@ class JobManager:
 
     async def _supervise(self, job: StatefulJob, library: Any, handle, ctx: JobContext) -> None:
         result = await handle.wait()
+        # close the job's final phase so sd_job_phase_seconds accounts
+        # the full wall time, not just up to the last transition
+        ctx._close_phase()
         report = ctx.report
         report.status = status_for_result(result.status, bool(job.errors))
         if result.status == TaskStatus.ERROR:

@@ -21,6 +21,7 @@ from ...files.isolated_path import IsolatedFilePathData
 from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
 from ...jobs.manager import register_job
+from ...telemetry import span
 from .rules import load_rules_for_location
 from .walker import walk, walk_single_dir
 
@@ -128,11 +129,12 @@ class IndexerJob(StatefulJob):
         """One bounded walk; leftover dirs become 'walk' continuation
         steps so arbitrarily large locations index completely."""
         rules, iso_factory, fetcher, remover = self._walk_env(ctx)
-        result = walk(
-            root, rules, iso_factory, fetcher, remover,
-            update_notifier=lambda p, n: None,
-            initial_accepted_by_children=accepted,
-        )
+        with span("walk"):
+            result = walk(
+                root, rules, iso_factory, fetcher, remover,
+                update_notifier=lambda p, n: None,
+                initial_accepted_by_children=accepted,
+            )
         steps = self._steps_from_result(result)
         for leftover in result.to_walk:
             steps.append(

@@ -223,9 +223,14 @@ class WindowsNormalizer:
     """
 
     def __init__(self, locked: Callable[[str], bool] = lambda p: False,
-                 is_dir: Callable[[str], bool] = lambda p: False):
+                 is_dir: Callable[[str], bool] = lambda p: False,
+                 exists: Callable[[str], bool] = lambda p: True):
+        # `exists` re-stats a path when its deferred locked-create
+        # finally unblocks: a locked file DELETED before release must
+        # not yield a spurious CREATE after its REMOVE
         self._locked = locked
         self._is_dir = is_dir
+        self._exists = exists
         self._pending_removes: dict[str, _Pending] = {}
         self._from_half: dict[str, _Pending] = {}
         self._to_half: dict[str, _Pending] = {}
@@ -259,8 +264,12 @@ class WindowsNormalizer:
             self._mods.touch(path, is_dir, now)
         elif kind == "remove":
             self._mods.drop(path)
+            # a deferred locked create for a now-removed path is dead:
+            # the writer deleted the file before ever releasing it
+            self._locked_creates.pop(path, None)
             self._pending_removes[path] = _Pending(path, is_dir, now, ident)
         elif kind == "rename_from":
+            self._locked_creates.pop(path, None)
             to = _pop_fresh(self._to_half, now, path=path, ident=ident)
             if to is not None:
                 out.append(WatchEvent(EventKind.RENAME, to.path,
@@ -282,8 +291,12 @@ class WindowsNormalizer:
         for path, p in list(self._locked_creates.items()):
             if not self._locked(path):
                 del self._locked_creates[path]
-                out.append(WatchEvent(EventKind.CREATE, path,
-                                      is_dir=p.is_dir))
+                # re-stat before emitting: "no longer locked" may mean
+                # "no longer exists" (deleted while held), and a CREATE
+                # for a vanished path would contradict its REMOVE
+                if self._exists(path):
+                    out.append(WatchEvent(EventKind.CREATE, path,
+                                          is_dir=p.is_dir))
         for path, p in list(self._pending_removes.items()):
             if now - p.at > REMOVE_GRACE:
                 del self._pending_removes[path]

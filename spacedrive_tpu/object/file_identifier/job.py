@@ -30,6 +30,8 @@ from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
 from ...jobs.manager import register_job
 from ...ops import cas
+from ...telemetry import metrics as _tm
+from ...telemetry import span
 
 logger = logging.getLogger(__name__)
 
@@ -183,7 +185,9 @@ class FileIdentifierJob(StatefulJob):
                 return rows[-1]["id"], window
 
             self._pipeline = WindowPipeline(
-                fetch, d["cursor"], depth=PIPELINE_DEPTH
+                fetch, d["cursor"], depth=PIPELINE_DEPTH,
+                # window[2] = the sampled messages riding the H2D link
+                measure=lambda w: sum(len(m) for m in w[2]),
             )
 
         t0 = time.perf_counter()
@@ -193,14 +197,25 @@ class FileIdentifierJob(StatefulJob):
         rows, metas, messages, msg_rows, finisher = window
         d["cursor"] = rows[-1]["id"]
 
-        cas_ids = await asyncio.to_thread(finisher)
+        _tm.IDENTIFIER_BATCH_FILL.observe(len(rows) / d["chunk_size"])
+        async with span("identify.hash",
+                        nbytes=sum(len(m) for m in messages)) as hash_span:
+            cas_ids = await asyncio.to_thread(finisher)
+        # run_metadata keeps its historical take+finish meaning; the
+        # STAGE metric must cover only the finisher, or feeder wait
+        # (its own series) would masquerade as device-hash time
         hash_time = time.perf_counter() - t0
+        _tm.IDENTIFIER_STAGE_SECONDS.observe(hash_span.duration,
+                                             stage="hash")
 
         by_row_id = {r["id"]: c for r, c in zip(msg_rows, cas_ids)}
 
         t1 = time.perf_counter()
-        created, linked = self._link_objects(library, rows, by_row_id)
+        async with span("identify.db"):
+            created, linked = self._link_objects(library, rows, by_row_id)
         db_time = time.perf_counter() - t1
+        _tm.IDENTIFIER_STAGE_SECONDS.observe(db_time, stage="db")
+        _tm.IDENTIFIER_FILES.inc(len(rows))
 
         errors = [f"unreadable file_path {r['id']}" for m, r in zip(metas, rows) if m is None]
         return StepResult(

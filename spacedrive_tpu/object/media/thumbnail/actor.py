@@ -25,6 +25,8 @@ import os
 import secrets
 from typing import Any, Sequence
 
+from ....telemetry import metrics as _tm
+from ....telemetry import span
 from .process import (
     Decoded,
     ThumbError,
@@ -193,6 +195,7 @@ class Thumbnailer:
                 continue
             if self.store.exists(library_id, cas_id):
                 self.skipped += 1
+                _tm.THUMB_FILES.inc(result="skipped")
                 continue
             norm.append((cas_id, path, ext))
         if not norm:
@@ -329,11 +332,16 @@ class Thumbnailer:
 
         while batch.entries and not self._stopped:
             chunk = batch.entries[:DEVICE_BATCH]
-            decoded = await asyncio.gather(*(_decode(e) for e in chunk))
+            _tm.THUMB_BATCH_FILL.observe(len(chunk) / DEVICE_BATCH)
+            async with span("thumbnail.decode") as decode_span:
+                decoded = await asyncio.gather(*(_decode(e) for e in chunk))
+            _tm.THUMB_STAGE_SECONDS.observe(
+                decode_span.duration, stage="decode")
             device_idx: list[int] = []
             for i, d in enumerate(decoded):
                 if d is None:
                     self.errors += 1
+                    _tm.THUMB_FILES.inc(result="error")
                 elif not self.use_device or needs_cpu_fallback(d):
                     # host-path stragglers (extreme aspect / no device)
                     try:
@@ -344,23 +352,31 @@ class Thumbnailer:
                         self._store_one(batch.library_id, chunk[i][0], webp)
                     except Exception:
                         self.errors += 1
+                        _tm.THUMB_FILES.inc(result="error")
                 else:
                     device_idx.append(i)
             if device_idx:
                 ds = [decoded[i] for i in device_idx]
                 try:
-                    resized = await asyncio.to_thread(resize_decoded, ds)
-                    webps = await asyncio.gather(
-                        *(
-                            asyncio.to_thread(finish, d, r)
-                            for d, r in zip(ds, resized)
+                    async with span(
+                        "thumbnail.device",
+                        nbytes=sum(d.array.nbytes for d in ds),
+                    ) as device_span:
+                        resized = await asyncio.to_thread(resize_decoded, ds)
+                        webps = await asyncio.gather(
+                            *(
+                                asyncio.to_thread(finish, d, r)
+                                for d, r in zip(ds, resized)
+                            )
                         )
-                    )
+                    _tm.THUMB_STAGE_SECONDS.observe(
+                        device_span.duration, stage="device")
                     for i, webp in zip(device_idx, webps):
                         self._store_one(batch.library_id, chunk[i][0], webp)
                 except Exception:
                     logger.exception("device resize batch failed")
                     self.errors += len(device_idx)
+                    _tm.THUMB_FILES.inc(len(device_idx), result="error")
             # consume as we go: the crash/error accounting and the
             # persisted resume state only ever see the remainder
             batch.entries = batch.entries[len(chunk):]
@@ -369,6 +385,7 @@ class Thumbnailer:
     def _store_one(self, library_id: str | None, cas_id: str, webp: bytes) -> None:
         self.store.write(library_id, cas_id, webp)
         self.generated += 1
+        _tm.THUMB_FILES.inc(result="generated")
         if self.event_bus is not None:
             self.event_bus.emit(
                 {

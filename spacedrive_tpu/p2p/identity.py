@@ -11,11 +11,19 @@ from __future__ import annotations
 
 import base64
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # gated: pure-Python RFC 8032 keeps node boot alive
+    from ..utils.ed25519_fallback import Ed25519PrivateKey, Ed25519PublicKey
+
+    serialization = None
+    _HAVE_CRYPTOGRAPHY = False
 
 
 class RemoteIdentity:
@@ -74,6 +82,8 @@ class Identity:
         return cls(Ed25519PrivateKey.from_private_bytes(seed))
 
     def to_bytes(self) -> bytes:
+        if not _HAVE_CRYPTOGRAPHY:
+            return self._key.private_bytes()
         return self._key.private_bytes(
             serialization.Encoding.Raw,
             serialization.PrivateFormat.Raw,
@@ -81,6 +91,8 @@ class Identity:
         )
 
     def to_remote_identity(self) -> RemoteIdentity:
+        if not _HAVE_CRYPTOGRAPHY:
+            return RemoteIdentity(self._key.public_key().public_bytes())
         return RemoteIdentity(
             self._key.public_key().public_bytes(
                 serialization.Encoding.Raw, serialization.PublicFormat.Raw

@@ -35,12 +35,19 @@ import hashlib
 import hmac
 import struct
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # gated: imports stay alive; channels refuse below
+    serialization = X25519PrivateKey = X25519PublicKey = None  # type: ignore
+    ChaCha20Poly1305 = None  # type: ignore
+    _HAVE_CRYPTOGRAPHY = False
 
 PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"
 DHLEN = 32
@@ -54,6 +61,16 @@ IDENTITY_CONTEXT = b"noise-libp2p-static-key:"
 
 class NoiseError(Exception):
     pass
+
+
+def require_crypto() -> None:
+    """Encrypted channels hard-require the real `cryptography` AEADs —
+    no pure-Python degradation for wire security. Raises where a
+    handshake would otherwise start."""
+    if not _HAVE_CRYPTOGRAPHY:
+        raise NoiseError(
+            "the `cryptography` package is required for Noise channels"
+        )
 
 
 def _hkdf(chaining_key: bytes, ikm: bytes, n: int) -> tuple[bytes, ...]:
@@ -92,6 +109,8 @@ class CipherState:
         self.initialize_key(k)
 
     def initialize_key(self, k: bytes | None) -> None:
+        if k is not None:
+            require_crypto()
         self._k = k
         self._aead = ChaCha20Poly1305(k) if k is not None else None
         self._n = 0
@@ -183,6 +202,7 @@ class HandshakeState:
         e: X25519PrivateKey | None = None,
         protocol_name: bytes = PROTOCOL_NAME,
     ):
+        require_crypto()
         self.initiator = initiator
         self.ss = SymmetricState(protocol_name)
         self.ss.mix_hash(prologue)

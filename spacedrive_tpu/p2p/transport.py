@@ -25,7 +25,12 @@ import asyncio
 import struct
 from typing import Awaitable, Callable
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+    )
+except ImportError:  # gated: noise.require_crypto() refuses at handshake
+    X25519PrivateKey = None  # type: ignore
 
 from . import noise
 from .identity import Identity, RemoteIdentity
@@ -120,6 +125,7 @@ async def _client_handshake(
     identity: Identity,
     expect: RemoteIdentity | None,
 ) -> EncryptedStream:
+    noise.require_crypto()
     static = X25519PrivateKey.generate()
     hs = HandshakeState(initiator=True, s=static, prologue=PROTOCOL)
     try:
@@ -148,6 +154,7 @@ async def _server_handshake(
     magic = await reader.readexactly(len(PROTOCOL))
     if magic != PROTOCOL:
         raise HandshakeError("bad protocol magic")
+    noise.require_crypto()
     static = X25519PrivateKey.generate()
     hs = HandshakeState(initiator=False, s=static, prologue=PROTOCOL)
     try:

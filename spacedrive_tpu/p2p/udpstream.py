@@ -46,6 +46,7 @@ import time
 from collections import deque
 from typing import Any
 
+from ..telemetry import metrics as _tm
 from .udp import UdpEndpoint
 
 _HDR = struct.Struct("!BII")
@@ -75,6 +76,55 @@ PROBE_EVERY = 4     # plateau rounds between gentle re-probe rounds
 
 class UdpStreamError(ConnectionError):
     pass
+
+
+class _CountingReader(asyncio.StreamReader):
+    """StreamReader that counts consumed bytes, so the receive-window
+    credit never depends on the CPython-private ``_buffer`` attribute
+    (whose absence used to advertise a permanent zero window —
+    ADVICE r5). Fed bytes are counted by the stream itself at its
+    feed_data call sites (per-segment hot path: no extra Python frame
+    here); this class counts only the cold per-read side
+    (read/readexactly/readuntil/readline)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.bytes_read = 0
+
+    def _count(self, data) -> None:
+        self.bytes_read += len(data)
+
+    async def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            # CPython implements read-all as a loop over
+            # self.read(self._limit) — those inner calls re-enter this
+            # override and count every block, so counting the joined
+            # result too would double bytes_read and silently disable
+            # flow control for the rest of the connection
+            return await super().read(n)
+        data = await super().read(n)
+        self._count(data)
+        return data
+
+    async def readexactly(self, n: int) -> bytes:
+        try:
+            data = await super().readexactly(n)
+        except asyncio.IncompleteReadError as e:
+            self._count(e.partial)
+            raise
+        self._count(data)
+        return data
+
+    async def readuntil(self, separator: bytes = b"\n") -> bytes:
+        # readline() delegates here via self, so this single override
+        # covers both without double counting
+        try:
+            data = await super().readuntil(separator)
+        except asyncio.IncompleteReadError as e:
+            self._count(e.partial)  # EOF consumed the partial tail
+            raise
+        self._count(data)
+        return data
 
 
 class _RateSeekCC:
@@ -241,7 +291,8 @@ class UdpStream:
         self._ep = endpoint
         self.remote = tuple(remote)
         self._owns = owns_endpoint
-        self.reader = asyncio.StreamReader()
+        self.reader = _CountingReader()
+        self._fed_bytes = 0  # bytes handed to the reader (credit side)
         # sender state
         self._next_seq = 0
         # seq → [dgram, first_tx, last_tx, retx_count]
@@ -281,10 +332,16 @@ class UdpStream:
 
     def _unread(self) -> int:
         """Bytes fed to the reader but not yet consumed by the app —
-        the StreamReader's internal buffer IS that count; fall back to
-        a conservative zero-credit estimate if the attr ever vanishes."""
-        buf = getattr(self.reader, "_buffer", None)
-        return len(buf) if buf is not None else RECV_WINDOW * MSS
+        tracked explicitly (our feed counter minus the reader's read
+        counter), never via the CPython-private _buffer attribute. A
+        foreign reader without the counter degrades to FULL credit
+        (correctness over flow control, the pre-rewrite behavior)
+        instead of the permanent zero window the old fallback
+        advertised (ADVICE r5)."""
+        consumed = getattr(self.reader, "bytes_read", None)
+        if consumed is None:
+            return 0
+        return max(0, self._fed_bytes - consumed)
 
     def _rwnd(self) -> int:
         """Segments of credit: reassembly slots not taken by the
@@ -357,6 +414,7 @@ class UdpStream:
                 fin_seen = True
                 self.reader.feed_eof()
             elif payload:
+                self._fed_bytes += len(payload)
                 self.reader.feed_data(payload)
             while self._recv_next in self._reorder:
                 t, p = self._reorder.pop(self._recv_next)
@@ -365,6 +423,7 @@ class UdpStream:
                     fin_seen = True
                     self.reader.feed_eof()
                 elif p:
+                    self._fed_bytes += len(p)
                     self.reader.feed_data(p)
             self._runs_trim()
         elif seq > self._recv_next and len(self._reorder) < 2 * RECV_WINDOW:
@@ -400,6 +459,13 @@ class UdpStream:
 
     def _on_ack(self, ack: int, payload: bytes) -> None:
         now = time.monotonic()
+        if ack > self._next_seq:
+            # a corrupt/forged ACK beyond the flight would desync
+            # _send_base forever (cumulative ACKs could never retire
+            # segments again) — drop it whole; an honest peer cannot
+            # ack what was never sent (ADVICE r5)
+            _tm.UDP_BAD_ACKS.inc()
+            return
         if len(payload) >= _RWND.size:
             self._peer_rwnd = _RWND.unpack_from(payload)[0]
             if self._peer_rwnd > 0:
@@ -424,7 +490,7 @@ class UdpStream:
                     rtt_sample = now - self._rtt_probe[1]
                 self._rtt_probe = None
         if ack > self._send_base:
-            self._send_base = ack
+            self._send_base = min(ack, self._next_seq)
             self._retries = 0
             self._rto_backoff_reset()
         # SACK ranges; the gaps BETWEEN them are the peer's exact hole
@@ -453,6 +519,7 @@ class UdpStream:
         if rtt_sample is not None:
             self._rtt_update(rtt_sample)
             self._cc.on_rtt_sample(rtt_sample)
+            _tm.UDP_ACK_RTT.observe(rtt_sample)
         if delivered:
             self._cc.on_delivered(delivered, self._in_flight())
         if holes:
@@ -515,14 +582,19 @@ class UdpStream:
                 entry[2] = now
                 entry[3] += 1
                 self._cc.retransmitted += 1
+                _tm.UDP_RETRANSMITS.inc()
                 self._ep.sendto(entry[0], self.remote)
                 burst += 1
 
     # --- zero-window persist -------------------------------------------
 
-    def _arm_probe(self) -> None:
+    def _arm_probe(self, rearm: bool = False) -> None:
         if self._probe_timer is not None or self._closed:
             return
+        if not rearm:
+            # count stall EPISODES, not probe re-arms: one long stall
+            # re-arms once per backoff step and must still read as one
+            _tm.UDP_RWND_STALLS.inc()
         self._probe_timer = self._loop.call_later(
             self._probe_ivl, self._on_probe_timer)
 
@@ -538,7 +610,7 @@ class UdpStream:
             return
         self._ep.sendto(_HDR.pack(WPROBE, 0, 0), self.remote)
         self._probe_ivl = min(self._probe_ivl * 2, RTO_MAX)
-        self._arm_probe()
+        self._arm_probe(rearm=True)
 
     # --- sender --------------------------------------------------------
 
@@ -585,6 +657,7 @@ class UdpStream:
             entry[2] = now
             entry[3] += 1
             self._cc.retransmitted += 1
+            _tm.UDP_RETRANSMITS.inc()
             self._ep.sendto(entry[0], self.remote)
             burst += 1
         self._rearm_timer()

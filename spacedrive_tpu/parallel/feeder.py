@@ -24,6 +24,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Generic, TypeVar
 
+from ..telemetry import metrics as _tm
+
 T = TypeVar("T")
 
 
@@ -52,7 +54,13 @@ class WindowPipeline(Generic[T]):
         fetch: Callable[[Any], "tuple[Any, T] | None"],
         start_key: Any,
         depth: int = 3,
+        measure: Callable[[T], int] | None = None,
     ):
+        # `measure(window) -> bytes` attributes each fetched window's
+        # host→device payload to sd_feeder_h2d_bytes_total — the
+        # counter BENCH_r05 was missing when the congested link had to
+        # be diagnosed from print lines
+        self._measure = measure
         self.stats = PipelineStats()
         self._queue: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
@@ -70,14 +78,22 @@ class WindowPipeline(Generic[T]):
             while not self._stop.is_set():
                 t0 = time.perf_counter()
                 item = self._fetch(key)
+                fetch_s = time.perf_counter() - t0
                 with self.stats._lock:
-                    self.stats.read_time += time.perf_counter() - t0
+                    self.stats.read_time += fetch_s
+                _tm.FEEDER_FETCH_SECONDS.observe(fetch_s)
                 if item is None:
                     self._put(None)
                     return
                 key, window = item
+                if self._measure is not None:
+                    try:
+                        _tm.FEEDER_H2D_BYTES.inc(self._measure(window))
+                    except Exception:  # measurement must never kill reads
+                        pass
                 if not self._put(window):
                     return
+                _tm.FEEDER_INFLIGHT.set(self._queue.qsize())
         except BaseException as e:  # surfaced to the consumer on take()
             self._error = e
             self._put(None)
@@ -118,11 +134,15 @@ class WindowPipeline(Generic[T]):
                     window = None
                     break
         waited = time.perf_counter() - t0
+        hit = waited < 0.002
         with self.stats._lock:
-            if waited < 0.002:
+            if hit:
                 self.stats.prefetch_hits += 1
             else:
                 self.stats.prefetch_misses += 1
+        _tm.FEEDER_WAIT_SECONDS.observe(waited)
+        _tm.FEEDER_PREFETCH.inc(result="hit" if hit else "miss")
+        _tm.FEEDER_INFLIGHT.set(self._queue.qsize())
         if window is None:
             self._done = True
             if self._error is not None:

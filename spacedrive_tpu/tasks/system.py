@@ -15,8 +15,10 @@ import asyncio
 import collections
 import logging
 import os
+import time
 from typing import Iterable
 
+from ..telemetry import metrics as _tm
 from .task import (
     ExecStatus,
     Interrupter,
@@ -44,6 +46,7 @@ class _Worker:
     # -- queue ops --
 
     def enqueue(self, handle: TaskHandle) -> None:
+        handle._enqueued_at = time.monotonic()
         if handle.task.priority:
             self.queue.appendleft(handle)
             # suspend a running non-priority task so the priority one
@@ -94,6 +97,18 @@ class _Worker:
 
     async def _execute(self, handle: TaskHandle) -> None:
         task = handle.task
+        now = time.monotonic()
+        enqueued = getattr(handle, "_enqueued_at", None)
+        if enqueued is not None:
+            _tm.TASK_QUEUE_WAIT.observe(now - enqueued)
+        dispatched = getattr(handle, "_dispatched_at", None)
+        if dispatched is not None:
+            # first execution only: a suspended/stolen task re-entering
+            # would double-count its dispatch latency
+            _tm.TASK_DISPATCH_LATENCY.observe(now - dispatched)
+            handle._dispatched_at = None
+        busy = len(self.system._running) + 1  # including us
+        _tm.TASK_BATCH_OCCUPANCY.observe(busy / self.system.worker_count)
         interrupter = Interrupter()
         self.current = handle
         self.current_interrupter = interrupter
@@ -122,6 +137,7 @@ class _Worker:
         elif status == ExecStatus.PAUSED:
             if kind == InterruptionKind.SUSPEND:
                 # transparent preemption: task goes back on our queue
+                handle._enqueued_at = time.monotonic()
                 self.queue.append(handle)
                 self.wakeup.set()
             elif kind == InterruptionKind.CANCEL:
@@ -191,6 +207,8 @@ class TaskSystem:
     def dispatch(self, task: Task) -> TaskHandle:
         self.start()
         handle = TaskHandle(task, self)
+        handle._dispatched_at = time.monotonic()
+        _tm.TASKS_DISPATCHED.inc()
         self._handles[task.id] = handle
         worker = self.workers[self._rr % self.worker_count]
         self._rr += 1
@@ -200,8 +218,11 @@ class TaskSystem:
     def dispatch_many(self, tasks: Iterable[Task]) -> list[TaskHandle]:
         self.start()
         handles = []
+        now = time.monotonic()
         for task in tasks:
             handle = TaskHandle(task, self)
+            handle._dispatched_at = now
+            _tm.TASKS_DISPATCHED.inc()
             self._handles[task.id] = handle
             min(self.workers, key=lambda w: w.load()).enqueue(handle)
             handles.append(handle)

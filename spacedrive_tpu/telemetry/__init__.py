@@ -1,0 +1,60 @@
+"""Telemetry — metrics registry, pipeline spans, snapshot/scrape APIs.
+
+The observability layer for the TPU dispatch path (BENCH_r05's lesson:
+device compute at 610k files/s with e2e at 489 files/s was only
+explainable by ad-hoc prints — now the queue waits, batch occupancy,
+H2D byte counts, and per-phase durations are first-class series).
+
+Surface:
+
+- ``REGISTRY`` / ``counter`` / ``gauge`` / ``histogram`` — the
+  process-wide metrics registry (Prometheus text via ``render()``);
+- ``metrics`` — every predeclared family for the hot paths;
+- ``span(stage, nbytes=0)`` — sync/async context manager recording
+  per-stage wall time and bytes;
+- ``snapshot()`` — the JSON read path (rspc ``telemetry.snapshot``,
+  bench.py);
+- ``render()`` — Prometheus exposition text (the ``/metrics`` route).
+"""
+
+from . import metrics
+from .registry import (
+    BYTE_BUCKETS,
+    MAX_SERIES_PER_FAMILY,
+    OVERFLOW_LABEL,
+    RATIO_BUCKETS,
+    REGISTRY,
+    TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from .snapshot import counter_value, gauge_value, histogram_recent, snapshot
+from .spans import Span, clear_recent, current_span, recent_spans, span
+
+
+def render() -> str:
+    return REGISTRY.render()
+
+
+def counter(name: str, help: str = "", labels=()):
+    return REGISTRY.counter(name, help, labels)
+
+
+def gauge(name: str, help: str = "", labels=()):
+    return REGISTRY.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str = "", labels=(), buckets=TIME_BUCKETS):
+    return REGISTRY.histogram(name, help, labels, buckets)
+
+
+__all__ = [
+    "REGISTRY", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "TIME_BUCKETS", "RATIO_BUCKETS", "BYTE_BUCKETS",
+    "MAX_SERIES_PER_FAMILY", "OVERFLOW_LABEL",
+    "metrics", "span", "Span", "current_span", "recent_spans",
+    "clear_recent", "snapshot", "histogram_recent", "gauge_value",
+    "counter_value", "render", "counter", "gauge", "histogram",
+]

@@ -1,0 +1,159 @@
+"""Metric families for the TPU dispatch path — one definition site.
+
+Naming follows Prometheus conventions with an ``sd_`` prefix:
+``_total`` counters, ``_seconds`` histograms, base-unit gauges. Label
+cardinality stays deliberately tiny (stage/result/job names) — see
+registry.MAX_SERIES_PER_FAMILY for the backstop.
+
+Hot paths import these handles directly (module attribute access, no
+lookup or allocation per event); everything registers on the process
+default ``REGISTRY`` so /metrics, telemetry.snapshot, and bench.py all
+read the same series.
+"""
+
+from __future__ import annotations
+
+from .registry import (
+    BYTE_BUCKETS,
+    RATIO_BUCKETS,
+    REGISTRY,
+    TIME_BUCKETS,
+)
+
+# --- task system (tasks/system.py) -----------------------------------------
+
+TASK_QUEUE_WAIT = REGISTRY.histogram(
+    "sd_task_queue_wait_seconds",
+    "time a task spent queued on a worker before execution started",
+)
+TASK_DISPATCH_LATENCY = REGISTRY.histogram(
+    "sd_task_dispatch_latency_seconds",
+    "dispatch() call to first execution start, per task",
+)
+TASK_BATCH_OCCUPANCY = REGISTRY.histogram(
+    "sd_task_batch_occupancy",
+    "fraction of workers busy when a task starts executing",
+    buckets=RATIO_BUCKETS,
+)
+TASKS_DISPATCHED = REGISTRY.counter(
+    "sd_tasks_dispatched_total", "tasks handed to the task system",
+)
+
+# --- host→device feeder (parallel/feeder.py) --------------------------------
+
+FEEDER_H2D_BYTES = REGISTRY.counter(
+    "sd_feeder_h2d_bytes_total",
+    "bytes staged for host→device transfer by the window pipeline",
+)
+FEEDER_FETCH_SECONDS = REGISTRY.histogram(
+    "sd_feeder_fetch_seconds",
+    "producer-side time to read+dispatch one window",
+)
+FEEDER_WAIT_SECONDS = REGISTRY.histogram(
+    "sd_feeder_wait_seconds",
+    "consumer-side time blocked waiting for the next window",
+)
+FEEDER_INFLIGHT = REGISTRY.gauge(
+    "sd_feeder_inflight_depth",
+    "ready windows parked in the pipeline queue",
+)
+FEEDER_PREFETCH = REGISTRY.counter(
+    "sd_feeder_prefetch_total",
+    "window handoffs by outcome",
+    labels=("result",),  # hit | miss
+)
+
+# --- file identifier (object/file_identifier/job.py) ------------------------
+
+IDENTIFIER_FILES = REGISTRY.counter(
+    "sd_identifier_files_total",
+    "file_paths pushed through cas_id identification",
+)
+IDENTIFIER_BATCH_FILL = REGISTRY.histogram(
+    "sd_identifier_batch_fill_ratio",
+    "rows in an identify window relative to the configured chunk size",
+    buckets=RATIO_BUCKETS,
+)
+IDENTIFIER_STAGE_SECONDS = REGISTRY.histogram(
+    "sd_identifier_stage_seconds",
+    "per-window time split between device hash and DB linking",
+    labels=("stage",),  # hash | db
+)
+
+# --- thumbnailer (object/media/thumbnail/actor.py) --------------------------
+
+THUMB_FILES = REGISTRY.counter(
+    "sd_thumbnailer_files_total",
+    "thumbnail outcomes",
+    labels=("result",),  # generated | skipped | error
+)
+THUMB_BATCH_FILL = REGISTRY.histogram(
+    "sd_thumbnail_batch_fill_ratio",
+    "images in a device chunk relative to DEVICE_BATCH",
+    buckets=RATIO_BUCKETS,
+)
+THUMB_STAGE_SECONDS = REGISTRY.histogram(
+    "sd_thumbnail_stage_seconds",
+    "per-chunk time split: host decode vs device resize+encode",
+    labels=("stage",),  # decode | device
+)
+
+# --- udp stream (p2p/udpstream.py) ------------------------------------------
+
+UDP_RETRANSMITS = REGISTRY.counter(
+    "sd_udp_retransmits_total",
+    "segments re-sent (fast retransmit + RTO bursts)",
+)
+UDP_RWND_STALLS = REGISTRY.counter(
+    "sd_udp_rwnd_stalls_total",
+    "zero-window stalls that armed the persist-probe timer",
+)
+UDP_BAD_ACKS = REGISTRY.counter(
+    "sd_udp_bad_acks_total",
+    "ACKs ignored because they acknowledged beyond the flight",
+)
+UDP_ACK_RTT = REGISTRY.histogram(
+    "sd_udp_ack_rtt_seconds",
+    "clean (Karn-filtered) ACK round-trip samples",
+)
+
+# --- jobs (jobs/job.py + jobs/manager.py) -----------------------------------
+
+JOB_PHASE_SECONDS = REGISTRY.histogram(
+    "sd_job_phase_seconds",
+    "wall time per job phase (phase transitions via ctx.progress)",
+    labels=("job", "phase"),
+)
+
+# --- bench (bench.py) -------------------------------------------------------
+
+BENCH_LINK_PROBE_GBPS = REGISTRY.gauge(
+    "sd_bench_link_probe_gbps",
+    "latest host→device link probe (device_put bandwidth)",
+)
+# bench reads its median/spread back out of these rings, so they must
+# hold every sample of the largest plausible SD_BENCH_REPEATS run —
+# the default 128-sample ring would silently truncate repeats > 128
+BENCH_DEVICE_BATCH_SECONDS = REGISTRY.histogram(
+    "sd_bench_device_batch_seconds",
+    "marginal device compute per chained batch (bench.py)",
+    recent_samples=4096,
+)
+BENCH_E2E_BATCH_SECONDS = REGISTRY.histogram(
+    "sd_bench_e2e_batch_seconds",
+    "end-to-end host→device→digest time per batch (bench.py)",
+    recent_samples=4096,
+)
+
+# --- spans (telemetry/spans.py) ---------------------------------------------
+
+SPAN_SECONDS = REGISTRY.histogram(
+    "sd_span_seconds",
+    "pipeline span wall time by stage",
+    labels=("stage",),
+)
+SPAN_BYTES = REGISTRY.counter(
+    "sd_span_bytes_total",
+    "bytes attributed to pipeline spans by stage",
+    labels=("stage",),
+)

@@ -1,0 +1,120 @@
+"""Pipeline spans — per-stage wall time + byte accounting.
+
+The walk → identify → hash → thumbnail pipeline reports its stage
+timings through spans: a context manager (sync AND async — nesting
+propagates through ``contextvars``, so concurrent asyncio tasks can't
+cross-contaminate parentage) that on exit
+
+- observes ``sd_span_seconds{stage=…}`` and, when bytes were attached,
+  ``sd_span_bytes_total{stage=…}``;
+- appends a record to a bounded in-memory ring the ``telemetry.
+  snapshot`` procedure exposes, so the explorer can show "where did the
+  last index pass spend its time" without a scrape pipeline;
+- debug-logs through the `utils.tracing` logging tree (target
+  ``spacedrive_tpu.telemetry``), honoring SD_LOG filters.
+
+Stages are dotted paths: a span opened inside another records as
+``parent.child`` (e.g. ``identify.hash``), keeping label cardinality
+proportional to the pipeline's actual shape.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from . import metrics
+
+logger = logging.getLogger(__name__)
+
+RECENT_SPANS = 256
+
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "sd_current_span", default=None
+)
+_recent: deque[dict[str, Any]] = deque(maxlen=RECENT_SPANS)
+_recent_lock = threading.Lock()
+
+
+class Span:
+    """One timed pipeline stage. Use via ``span(...)``:
+
+        with span("identify.hash", nbytes=len(batch)):
+            ...
+        async with span("walk"):
+            ...
+    """
+
+    __slots__ = ("stage", "nbytes", "path", "_t0", "_token", "duration")
+
+    def __init__(self, stage: str, nbytes: int = 0):
+        self.stage = stage
+        self.nbytes = int(nbytes)
+        self.path = stage  # parent-prefixed on enter
+        self._t0 = 0.0
+        self._token: contextvars.Token | None = None
+        self.duration: float | None = None
+
+    def add_bytes(self, n: int) -> None:
+        """Attribute more bytes mid-span (e.g. per-file in a loop)."""
+        self.nbytes += int(n)
+
+    # -- sync protocol --
+
+    def __enter__(self) -> "Span":
+        parent = _current.get()
+        if parent is not None:
+            self.path = f"{parent.path}.{self.stage}"
+        self._token = _current.set(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.duration = time.perf_counter() - self._t0
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        metrics.SPAN_SECONDS.observe(self.duration, stage=self.path)
+        if self.nbytes:
+            metrics.SPAN_BYTES.inc(self.nbytes, stage=self.path)
+        rec = {
+            "stage": self.path,
+            "seconds": self.duration,
+            "bytes": self.nbytes,
+            "error": exc_type.__name__ if exc_type is not None else None,
+        }
+        with _recent_lock:
+            _recent.append(rec)
+        logger.debug("span %s: %.3fms%s", self.path, self.duration * 1e3,
+                     f" {self.nbytes}B" if self.nbytes else "")
+
+    # -- async protocol (same semantics; contextvars carry across await) --
+
+    async def __aenter__(self) -> "Span":
+        return self.__enter__()
+
+    async def __aexit__(self, exc_type, exc, tb) -> None:
+        self.__exit__(exc_type, exc, tb)
+
+
+def span(stage: str, nbytes: int = 0) -> Span:
+    return Span(stage, nbytes)
+
+
+def current_span() -> Span | None:
+    return _current.get()
+
+
+def recent_spans() -> list[dict[str, Any]]:
+    """Most-recent-last completed spans (bounded ring)."""
+    with _recent_lock:
+        return list(_recent)
+
+
+def clear_recent() -> None:
+    with _recent_lock:
+        _recent.clear()

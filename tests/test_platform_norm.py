@@ -181,3 +181,51 @@ def test_macos_concurrent_renames_do_not_mispair():
         (EventKind.RENAME, "/dst/a.txt", "/src/a.txt"),
         (EventKind.RENAME, "/dst/b.txt", "/src/b.txt"),
     ]
+
+
+def test_windows_locked_create_deleted_before_release():
+    """ADVICE r5: a locked file DELETED before its writer ever released
+    it used to leave the deferred create behind — locked() returns
+    False for a missing path, so tick() emitted a spurious CREATE
+    *after* the REMOVE. The remove must drop the deferred create, and
+    tick() must re-stat before emitting."""
+    locked = {"/w/held.tmp"}
+    on_disk = {"/w/held.tmp"}
+    w = WindowsNormalizer(locked=lambda p: p in locked,
+                          exists=lambda p: p in on_disk)
+    assert w.on_raw("create", "/w/held.tmp", now=0.0) == []  # deferred
+    # the writer deletes the file while still holding the handle
+    locked.clear()
+    on_disk.clear()
+    assert w.on_raw("remove", "/w/held.tmp", now=0.05) == []  # grace-held
+    assert _kinds(w.tick(0.3)) == [(EventKind.REMOVE, "/w/held.tmp", None)]
+    # no spurious CREATE ever surfaces for the vanished path
+    assert w.tick(1.0) == []
+    assert w.tick(5.0) == []
+
+
+def test_windows_locked_create_dropped_on_rename_from():
+    """Same staleness class via the rename path: a locked create whose
+    path is renamed away must not resurrect as a CREATE of the OLD
+    path."""
+    locked = {"/w/moving.tmp"}
+    w = WindowsNormalizer(locked=lambda p: p in locked,
+                          exists=lambda p: p != "/w/moving.tmp")
+    assert w.on_raw("create", "/w/moving.tmp", now=0.0) == []
+    locked.clear()
+    assert w.on_raw("rename_from", "/w/moving.tmp", now=0.05) == []
+    evs = w.on_raw("rename_to", "/w/moved.txt", now=0.06)
+    assert _kinds(evs) == [(EventKind.RENAME, "/w/moved.txt",
+                           "/w/moving.tmp")]
+    assert w.tick(1.0) == []  # the stale deferred create is gone
+
+
+def test_windows_locked_create_still_emits_when_file_survives():
+    """The re-stat must not break the happy path: released AND still
+    present -> CREATE surfaces exactly as before."""
+    locked = {"/w/ok.tmp"}
+    w = WindowsNormalizer(locked=lambda p: p in locked,
+                          exists=lambda p: True)
+    assert w.on_raw("create", "/w/ok.tmp", now=0.0) == []
+    locked.clear()
+    assert _kinds(w.tick(0.2)) == [(EventKind.CREATE, "/w/ok.tmp", None)]

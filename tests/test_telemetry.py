@@ -1,0 +1,250 @@
+"""Telemetry subsystem: registry semantics, spans, Prometheus text,
+and the dispatch-path instrumentation populated by a real dry-run
+identify+thumbnail pass (BENCH_r05's missing observability layer)."""
+
+import asyncio
+import os
+import re
+
+import pytest
+
+from spacedrive_tpu import telemetry
+from spacedrive_tpu.telemetry import metrics as tm
+from spacedrive_tpu.telemetry.registry import (
+    MAX_SERIES_PER_FAMILY,
+    OVERFLOW_LABEL,
+    MetricsRegistry,
+)
+
+
+# --- registry semantics ---------------------------------------------------
+
+
+def test_counter_monotonic_and_render():
+    r = MetricsRegistry()
+    c = r.counter("t_requests_total", "requests", labels=("route",))
+    c.inc(route="/a")
+    c.inc(2, route="/a")
+    c.inc(route="/b")
+    assert c.value(route="/a") == 3
+    with pytest.raises(ValueError):
+        c.inc(-1, route="/a")
+    text = r.render()
+    assert "# TYPE t_requests_total counter" in text
+    assert 't_requests_total{route="/a"} 3' in text
+    assert 't_requests_total{route="/b"} 1' in text
+
+
+def test_unlabeled_counter_renders_zero_before_first_event():
+    # absence means "not wired"; zero means "wired, idle" — the four
+    # acceptance metrics must be scrapeable before traffic arrives
+    r = MetricsRegistry()
+    r.counter("t_idle_total", "idle")
+    assert "t_idle_total 0" in r.render()
+
+
+def test_gauge_set_inc_dec():
+    r = MetricsRegistry()
+    g = r.gauge("t_depth", "queue depth")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 3
+    assert "t_depth 3" in r.render()
+
+
+def test_histogram_bucketing_and_exposition():
+    r = MetricsRegistry()
+    h = r.histogram("t_lat_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    text = r.render()
+    # cumulative bucket counts, +Inf, sum and count
+    assert 't_lat_seconds_bucket{le="0.01"} 2' in text
+    assert 't_lat_seconds_bucket{le="0.1"} 3' in text
+    assert 't_lat_seconds_bucket{le="1"} 4' in text
+    assert 't_lat_seconds_bucket{le="+Inf"} 5' in text
+    assert "t_lat_seconds_count 5" in text
+    assert h.stats()["count"] == 5
+    assert h.recent() == [0.005, 0.005, 0.05, 0.5, 5.0]
+
+
+def test_label_cardinality_cap_folds_into_overflow():
+    r = MetricsRegistry()
+    c = r.counter("t_hot_total", "hot path", labels=("key",))
+    for i in range(MAX_SERIES_PER_FAMILY + 50):
+        c.inc(key=f"k{i}")
+    fam = r.get("t_hot_total")
+    # the family cannot grow past the cap (+ nothing lost: overflow
+    # absorbs the excess)
+    assert len(fam._series) <= MAX_SERIES_PER_FAMILY + 1
+    assert c.value(key=OVERFLOW_LABEL) == 50
+
+
+def test_unknown_label_names_raise():
+    r = MetricsRegistry()
+    c = r.counter("t_l_total", "labeled", labels=("a",))
+    with pytest.raises(ValueError):
+        c.inc(b=1)
+
+
+def test_type_conflict_raises_and_registration_is_idempotent():
+    r = MetricsRegistry()
+    c1 = r.counter("t_same_total", "x")
+    assert r.counter("t_same_total") is c1
+    with pytest.raises(ValueError):
+        r.gauge("t_same_total")
+
+
+def test_reset_zeroes_but_keeps_default_series():
+    r = MetricsRegistry()
+    c = r.counter("t_r_total", "x")
+    c.inc(5)
+    r.reset()
+    assert c.value() == 0
+    assert "t_r_total 0" in r.render()
+
+
+def test_registry_is_thread_safe_under_contention():
+    import threading
+
+    r = MetricsRegistry()
+    c = r.counter("t_mt_total", "contended")
+
+    def spin():
+        for _ in range(5000):
+            c.inc()
+
+    threads = [threading.Thread(target=spin) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8 * 5000
+
+
+def test_label_escaping_in_exposition():
+    r = MetricsRegistry()
+    c = r.counter("t_esc_total", "x", labels=("p",))
+    c.inc(p='we"ird\\path\n')
+    assert 't_esc_total{p="we\\"ird\\\\path\\n"} 1' in r.render()
+
+
+# --- spans ----------------------------------------------------------------
+
+
+def test_span_nesting_under_asyncio():
+    async def run():
+        telemetry.clear_recent()
+
+        async def pipeline(tag):
+            async with telemetry.span(tag):
+                await asyncio.sleep(0.01)
+                with telemetry.span("inner", nbytes=7) as sp:
+                    # contextvars: each task sees only its own parent
+                    assert telemetry.current_span() is sp
+                    assert sp.path == f"{tag}.inner"
+
+        await asyncio.gather(pipeline("a"), pipeline("b"))
+
+    asyncio.run(run())
+    stages = {s["stage"] for s in telemetry.recent_spans()}
+    assert {"a", "b", "a.inner", "b.inner"} <= stages
+    # byte accounting reached the counter
+    assert tm.SPAN_BYTES.value(stage="a.inner") >= 7
+
+
+def test_span_records_duration_and_error():
+    telemetry.clear_recent()
+    with pytest.raises(RuntimeError):
+        with telemetry.span("boom"):
+            raise RuntimeError("x")
+    rec = telemetry.recent_spans()[-1]
+    assert rec["stage"] == "boom"
+    assert rec["error"] == "RuntimeError"
+    assert rec["seconds"] >= 0
+
+
+# --- dispatch-path instrumentation (dry-run identify+thumbnail) -----------
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    from PIL import Image
+
+    d = tmp_path / "corpus"
+    d.mkdir()
+    (d / "alpha.txt").write_bytes(b"a" * 5000)
+    (d / "beta.bin").write_bytes(os.urandom(2000))
+    Image.new("RGB", (64, 48), (40, 200, 40)).save(d / "real.png")
+    return str(d)
+
+
+def _metric_value(text: str, name: str) -> float | None:
+    m = re.search(rf"^{name}(?:{{[^}}]*}})? (\S+)$", text, re.M)
+    return float(m.group(1)) if m else None
+
+
+def test_dry_run_index_pass_populates_dispatch_and_feeder_metrics(
+    tmp_path, corpus
+):
+    async def run():
+        import aiohttp
+
+        from spacedrive_tpu.location.locations import (
+            LocationCreateArgs, scan_location,
+        )
+        from spacedrive_tpu.node import Node
+
+        before_h2d = tm.FEEDER_H2D_BYTES.value()
+        before_occ = tm.TASK_BATCH_OCCUPANCY.stats()["count"]
+
+        node = Node(os.path.join(tmp_path, "node"), use_device=False)
+        node.config.config.p2p.enabled = False
+        await node.start()
+        lib = await node.create_library("telemetry-lib")
+        loc = LocationCreateArgs(path=corpus, name="corpus").create(lib)
+        await scan_location(lib, loc, node.jobs)
+        await node.jobs.wait_idle()
+        await node.thumbnailer.wait_library_batch(str(lib.id))
+        try:
+            port = await node.start_api()
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                    f"http://127.0.0.1:{port}/metrics"
+                ) as resp:
+                    assert resp.status == 200
+                    assert resp.content_type == "text/plain"
+                    text = await resp.text()
+                async with http.post(
+                    f"http://127.0.0.1:{port}/rspc/telemetry.snapshot",
+                    json={},
+                ) as resp:
+                    snap = (await resp.json())["result"]
+        finally:
+            await node.shutdown()
+
+        # the acceptance set: all present, all non-empty after the pass
+        assert _metric_value(text, "sd_feeder_h2d_bytes_total") > before_h2d
+        assert _metric_value(text, "sd_task_batch_occupancy_count") \
+            > before_occ
+        assert "sd_task_batch_occupancy_bucket" in text
+        assert "sd_job_phase_seconds_bucket" in text
+        assert _metric_value(text, "sd_udp_retransmits_total") is not None
+
+        # job phases observed for the chain (indexer → identifier → …)
+        phases = snap["metrics"]["sd_job_phase_seconds"]["series"]
+        assert sum(s["count"] for s in phases) > 0
+        jobs_seen = {s["labels"]["job"] for s in phases}
+        assert "indexer" in jobs_seen or "file_identifier" in jobs_seen
+
+        # pipeline spans flowed: walk + identify stages at minimum
+        stages = {s["stage"] for s in snap["spans"]}
+        assert "walk" in stages
+        assert "identify.hash" in stages
+
+        # identifier throughput counters moved
+        ident = snap["metrics"]["sd_identifier_files_total"]["series"]
+        assert ident and ident[0]["value"] > 0
+
+    asyncio.run(run())

@@ -349,3 +349,92 @@ def test_forged_ack_flood_is_bounded():
         sb.close()
 
     asyncio.run(run())
+
+
+def test_forged_ack_beyond_flight_is_dropped_whole():
+    """ADVICE r5: an ACK acknowledging past _next_seq is corrupt or
+    forged — processing it used to push _send_base beyond the flight,
+    after which honest cumulative ACKs could never retire segments and
+    the stream died at MAX_RETRIES. It must be ignored entirely, and
+    the transfer must still complete afterwards."""
+
+    async def run():
+        a, b = UdpEndpoint(), UdpEndpoint()
+        addr_a = await a.bind("127.0.0.1")
+        addr_b = await b.bind("127.0.0.1")
+        sa, sb = UdpStream(a, addr_b), UdpStream(b, addr_a)
+        payload = os.urandom(200_000)
+        sa.write(payload)
+        for _ in range(8):
+            await asyncio.sleep(0)
+        assert sa._next_seq > 0
+        base_before = sa._send_base
+        # forged cumulative ack far beyond anything ever sent
+        evil = _HDR.pack(ACK, 0, sa._next_seq + 50_000) + _RWND.pack(4096)
+        sa._on_datagram(evil, addr_b)
+        assert sa._send_base == base_before  # untouched
+        assert sa._send_base <= sa._next_seq
+        # sender state stayed coherent: delivery completes normally
+        got = await asyncio.wait_for(_consume(sb.reader, len(payload)), 30)
+        assert got == payload
+        assert sa._send_base <= sa._next_seq
+        sa.close()
+        sb.close()
+
+    asyncio.run(run())
+
+
+def test_unread_accounting_without_private_buffer():
+    """ADVICE r5: the receive-window credit used to reach into
+    StreamReader._buffer (CPython-private) and advertised a PERMANENT
+    zero window when the attr was absent — stalling transfers forever.
+    The counting reader tracks fed-minus-read explicitly, and a
+    foreign reader without the counter degrades to full credit
+    (bounded-buffering loss, not a wedged stream)."""
+
+    async def run():
+        a, b = UdpEndpoint(), UdpEndpoint()
+        addr_a = await a.bind("127.0.0.1")
+        addr_b = await b.bind("127.0.0.1")
+        sa, sb = UdpStream(a, addr_b), UdpStream(b, addr_a)
+        # exact fed-minus-read across the read paths the transport uses
+        sa.write(b"z" * 10_000)
+        await asyncio.sleep(0.2)
+        assert sb._unread() == 10_000
+        await sb.reader.readexactly(4_000)
+        assert sb._unread() == 6_000
+        await sb.reader.read(6_000)
+        assert sb._unread() == 0
+        # full window credit available again — not a zero window
+        assert sb._rwnd() > RECV_WINDOW // 2
+        # read-all (n=-1) must not double-count: CPython's read(-1)
+        # loops over read(limit) internally, and counting both the
+        # blocks and the join would inflate bytes_read and pin
+        # _unread() at 0 for the rest of the connection
+        sa.write(b"w" * 5_000)
+        await asyncio.sleep(0.2)
+        assert sb._unread() == 5_000
+        drain = asyncio.ensure_future(sb.reader.read(-1))
+        await asyncio.sleep(0.05)
+        sa.close()  # EOF lets read-all return
+        got = await asyncio.wait_for(drain, 10)
+        assert got == b"w" * 5_000
+        assert sb.reader.bytes_read == 10_000 + 5_000  # not double-counted
+        assert sb._unread() == 0
+        # hostile case: a reader with NO _buffer and NO counter must
+        # not advertise rwnd=0 forever (old behavior); it degrades to
+        # full credit instead
+        class OpaqueReader:
+            def feed_data(self, data):
+                pass
+
+            def feed_eof(self):
+                pass
+
+        sb.reader = OpaqueReader()
+        assert sb._unread() == 0
+        assert sb._rwnd() > 0
+        sa.close()
+        sb.close()
+
+    asyncio.run(run())
