@@ -1,0 +1,15 @@
+# Developer/CI entry points. `make lint` and tests/test_sdlint.py's
+# whole-tree gate invoke the same command, so they cannot drift apart.
+
+PY ?= python
+
+.PHONY: lint test tier1
+
+lint:
+	$(PY) -m tools.sdlint spacedrive_tpu --format=json
+
+test: tier1
+
+tier1:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider

@@ -1536,18 +1536,28 @@ def _backups(r: Router) -> None:
     @r.mutation("backups.restore")
     async def restore(node, arg):
         """ref:backups.rs `start_restore` — close, overwrite, reload."""
-        with zipfile.ZipFile(arg["path"]) as z:
-            header = json.loads(z.read("header.json"))
-            lib_id = uuid.UUID(header["library_id"])
-            await node.close_library(lib_id)  # full teardown, not just close
-            config_path, db_path = node.libraries.paths(lib_id)
-            for suffix in ("-wal", "-shm"):
-                if os.path.exists(db_path + suffix):
-                    os.remove(db_path + suffix)
-            with z.open("library.db") as src, open(db_path, "wb") as dst:
-                shutil.copyfileobj(src, dst)
-            with z.open("library.sdlibrary") as src, open(config_path, "wb") as dst:
-                shutil.copyfileobj(src, dst)
+
+        def read_header() -> dict:
+            with zipfile.ZipFile(arg["path"]) as z:
+                return json.loads(z.read("header.json"))
+
+        def overwrite(db_path: str, config_path: str) -> None:
+            # bulk DB copy — runs via asyncio.to_thread (sdlint SD001)
+            with zipfile.ZipFile(arg["path"]) as z:
+                for suffix in ("-wal", "-shm"):
+                    if os.path.exists(db_path + suffix):
+                        os.remove(db_path + suffix)
+                with z.open("library.db") as src, open(db_path, "wb") as dst:
+                    shutil.copyfileobj(src, dst)
+                with z.open("library.sdlibrary") as src, \
+                        open(config_path, "wb") as dst:
+                    shutil.copyfileobj(src, dst)
+
+        header = await asyncio.to_thread(read_header)
+        lib_id = uuid.UUID(header["library_id"])
+        await node.close_library(lib_id)  # full teardown, not just close
+        config_path, db_path = node.libraries.paths(lib_id)
+        await asyncio.to_thread(overwrite, db_path, config_path)
         lib = node.libraries.load(lib_id)
         await node._init_library(lib)
         invalidate_query(node, "library.list")

@@ -16,6 +16,7 @@ from typing import Any
 
 from ..db.database import now_iso
 from ..tasks import TaskStatus, TaskSystem
+from ..utils.tasks import supervise
 from .job import JobContext, JobRunnerTask, StatefulJob, status_for_result
 from .report import JobProgressEvent, JobReport, JobStatus
 
@@ -79,10 +80,11 @@ class JobManager:
         self._active[job.id] = (handle, ctx)
         # keep a strong ref: the loop only weak-refs tasks and a GC'd
         # supervisor would drop final status writes + job chaining
-        sup = asyncio.ensure_future(self._supervise(job, library, handle, ctx))
-        self._supervisors.add(sup)
+        sup = supervise(
+            asyncio.ensure_future(self._supervise(job, library, handle, ctx)),
+            self._supervisors, logger, f"job supervisor ({report.name})",
+        )
         self._supervisor_by_job[job.id] = sup
-        sup.add_done_callback(self._supervisors.discard)
         sup.add_done_callback(lambda _t, jid=job.id: self._supervisor_by_job.pop(jid, None))
 
     async def _supervise(self, job: StatefulJob, library: Any, handle, ctx: JobContext) -> None:

@@ -26,6 +26,7 @@ from typing import Any
 
 from ..db.database import now_iso
 from ..files.isolated_path import IsolatedFilePathData
+from ..utils.tasks import supervise
 from .locations import deep_rescan_sub_path, light_scan_location
 from .watcher import EventKind, WatchEvent, new_watcher
 
@@ -53,6 +54,10 @@ class LocationManager:
         self._watched: dict[tuple[str, int], _Watched] = {}
         self.ignore_paths: set[str] = set()
         self.events_applied = 0
+        # in-flight debounced rescans: retained so they can't be
+        # GC-cancelled mid-flush and shutdown can drain them (sdlint SD003)
+        self._flush_tasks: set[asyncio.Task] = set()
+        self._shutting_down = False
 
     # --- registration (ref:manager/mod.rs:36-60) -----------------------
 
@@ -96,11 +101,17 @@ class LocationManager:
         return (str(library.id), location_id) in self._watched
 
     async def shutdown(self) -> None:
+        self._shutting_down = True
         for entry in self._watched.values():
             entry.watcher.stop()
             if entry.flush_handle is not None:
                 entry.flush_handle.cancel()
         self._watched.clear()
+        if self._flush_tasks:
+            # let in-flight rescans settle rather than strand them
+            # half-applied; _flush_done drains the set as they finish
+            await asyncio.gather(*list(self._flush_tasks),
+                                 return_exceptions=True)
 
     # --- event application (ref:watcher/utils.rs) ----------------------
 
@@ -230,8 +241,18 @@ class LocationManager:
             entry.flush_handle.cancel()
         loop = asyncio.get_running_loop()
         entry.flush_handle = loop.call_later(
-            DEBOUNCE, lambda: loop.create_task(self._flush(entry))
+            DEBOUNCE, self._spawn_flush, loop, entry
         )
+
+    def _spawn_flush(self, loop: asyncio.AbstractEventLoop,
+                     entry: _Watched) -> None:
+        if self._shutting_down:
+            # the debounce timer may fire in the same tick shutdown()
+            # runs: its cancel() no-ops on a fired handle and the drain
+            # below would miss a flush spawned after its gather snapshot
+            return
+        supervise(loop.create_task(self._flush(entry)), self._flush_tasks,
+                  logger, "debounced rescan")
 
     async def _flush(self, entry: _Watched) -> None:
         dirs, entry.dirty_dirs = entry.dirty_dirs, set()

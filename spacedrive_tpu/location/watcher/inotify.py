@@ -15,11 +15,15 @@ import asyncio
 import ctypes
 import ctypes.util
 import errno
+import logging
 import os
 import struct
 from typing import Awaitable, Callable
 
+from ...utils.tasks import supervise
 from .events import EventKind, WatchEvent
+
+logger = logging.getLogger(__name__)
 
 IN_ACCESS = 0x0001
 IN_MODIFY = 0x0002
@@ -65,6 +69,10 @@ class InotifyWatcher:
         self._path_wds: dict[str, int] = {}
         self._pending_from: dict[int, tuple[str, bool, asyncio.TimerHandle]] = {}
         self._loop: asyncio.AbstractEventLoop | None = None
+        # async emit-handler tasks: retained so a failing handler surfaces
+        # through its done-callback instead of as a GC-time unraisable
+        # warning (sdlint SD003)
+        self._emit_tasks: set[asyncio.Task] = set()
 
     # --- lifecycle -----------------------------------------------------
 
@@ -225,7 +233,8 @@ class InotifyWatcher:
         result = self.emit(event)
         if asyncio.iscoroutine(result):
             assert self._loop is not None
-            self._loop.create_task(result)
+            supervise(self._loop.create_task(result), self._emit_tasks,
+                      logger, "watcher emit handler")
 
 
 def available() -> bool:

@@ -9,6 +9,7 @@ consistent even with watching disabled.
 
 from __future__ import annotations
 
+import asyncio
 import os
 import shutil
 
@@ -17,6 +18,15 @@ from ...jobs import StatefulJob
 from ...jobs.job import JobContext, StepResult
 from ...jobs.manager import register_job
 from . import get_location_path, get_many_files_datas
+
+
+def _delete_path(step: dict) -> None:
+    if os.path.islink(step["full_path"]):
+        os.remove(step["full_path"])  # never follow links
+    elif step["is_dir"]:
+        shutil.rmtree(step["full_path"])
+    else:
+        os.remove(step["full_path"])
 
 
 @register_job
@@ -43,12 +53,9 @@ class FileDeleterJob(StatefulJob):
     async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
         errors = []
         try:
-            if os.path.islink(step["full_path"]):
-                os.remove(step["full_path"])  # never follow links
-            elif step["is_dir"]:
-                shutil.rmtree(step["full_path"])
-            else:
-                os.remove(step["full_path"])
+            # rmtree of a deep tree can run for seconds — keep it off
+            # the event loop so other jobs/streams keep making progress
+            await asyncio.to_thread(_delete_path, step)
         except FileNotFoundError:
             pass  # already gone — the DB row still needs removal
         except OSError as e:

@@ -11,6 +11,7 @@ window keeps the jobs' own writes from echoing back as events.
 
 from __future__ import annotations
 
+import asyncio
 import os
 
 from ...crypto.header import decrypt_file, encrypt_file
@@ -22,6 +23,15 @@ from ...jobs.manager import register_job
 from . import get_location_path, get_many_files_datas, watcher_pause
 
 ENCRYPTED_EXT = "sdenc"
+
+
+def _read_preview(path: str) -> bytes | None:
+    """Blocking thumbnail read — runs via asyncio.to_thread."""
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
 
 
 @register_job
@@ -64,9 +74,7 @@ class FileEncryptorJob(StatefulJob):
                 thumb = node.thumbnailer.store.path_for(
                     str(ctx.library.id), step["cas_id"]
                 )
-                if os.path.exists(thumb):
-                    with open(thumb, "rb") as f:
-                        preview = f.read()
+                preview = await asyncio.to_thread(_read_preview, thumb)
         with watcher_pause(ctx, self.init["location_id"]):
             encrypt_file(
                 src,

@@ -155,7 +155,9 @@ class Transfer:
             while remaining > 0:
                 if self.cancelled.is_set():
                     raise TransferCancelled()
-                data = fh.read(min(bs, remaining))
+                # disk reads off the loop: a cold 1MiB block from a slow
+                # volume would otherwise stall every other stream
+                data = await asyncio.to_thread(fh.read, min(bs, remaining))
                 if not data:
                     raise EOFError(f"file {req.name} shorter than advertised")
                 w.u64(offset).u32(len(data)).raw(data)
@@ -193,7 +195,7 @@ class Transfer:
                     w.u8(1)
                     await w.flush()
                     raise TransferCancelled()
-                out.write(data)
+                await asyncio.to_thread(out.write, data)
                 w.u8(0)
                 await w.flush()
                 remaining -= length

@@ -18,6 +18,7 @@ from typing import Any
 
 from ..node.config import BackendFeature, P2PDiscoveryState
 from ..sync.ingest import IngestActor
+from ..utils.tasks import supervise
 from .identity import RemoteIdentity
 from .mdns import MdnsDiscovery
 from .operations import SpacedropManager, respond_file
@@ -54,9 +55,8 @@ class P2PManager:
                      lib_id: uuid.UUID) -> None:
         if self._shutting_down or not loop.is_running():
             return
-        task = loop.create_task(self._alert_peers(lib_id))
-        self._alert_tasks.add(task)
-        task.add_done_callback(self._alert_tasks.discard)
+        supervise(loop.create_task(self._alert_peers(lib_id)),
+                  self._alert_tasks, logger, "sync alert fan-out")
 
     # --- lifecycle -----------------------------------------------------
 

@@ -93,8 +93,12 @@ class SpacedropManager:
                 on_progress=lambda pct: self._on_progress(requests.id, pct),
                 cancelled=cancel,
             )
-            files = [open(p, "rb") for p in paths]
+            files: list = []
             try:
+                # opened inside the try: a failing open midway must not
+                # leak the handles already opened
+                for p in paths:
+                    files.append(await asyncio.to_thread(open, p, "rb"))
                 await transfer.send(stream, files)
             finally:
                 for f in files:
@@ -149,11 +153,14 @@ class SpacedropManager:
             on_progress=lambda pct: self._on_progress(req.id, pct),
             cancelled=cancel,
         )
-        sinks = [
-            open(os.path.join(dest, os.path.basename(r.name)), "wb")
-            for r in requests.requests
-        ]
+        sinks: list = []
         try:
+            # opened inside the try: a failing open midway must not leak
+            # the handles already opened
+            for r in requests.requests:
+                sinks.append(await asyncio.to_thread(
+                    open, os.path.join(dest, os.path.basename(r.name)), "wb"
+                ))
             await transfer.receive(stream, sinks)
         finally:
             self._cancel.pop(req.id, None)
@@ -238,5 +245,8 @@ async def respond_file(stream: Any, req: FileRequest, libraries: Any) -> None:
         block_size=bs,
         requests=[SpaceblockRequest(name="file", size=size, range=req.range)],
     )
-    with open(path, "rb") as fh:
+    fh = await asyncio.to_thread(open, path, "rb")
+    try:
         await Transfer(requests).send(stream, [fh])
+    finally:
+        fh.close()

@@ -66,6 +66,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Awaitable, Callable
 
+from ..utils.tasks import supervise
 from .identity import Identity, RemoteIdentity
 from .transport import EncryptedStream, _client_handshake, _server_handshake
 
@@ -639,15 +640,13 @@ class RelayClient:
                     msg = await read_frame(reader)
                     event = msg.get("event")
                     if event == "incoming":
-                        task = asyncio.create_task(self._accept(msg["conn"]))
-                        self._accepts.add(task)
-                        task.add_done_callback(self._accepts.discard)
+                        supervise(asyncio.create_task(self._accept(msg["conn"])),
+                                  self._accepts, logger, "relayed accept")
                     elif event == "peers":
                         self._ingest_peers(msg.get("peers", []))
                     elif event == "punch":
-                        task = asyncio.create_task(self._punch_accept(msg))
-                        self._accepts.add(task)
-                        task.add_done_callback(self._accepts.discard)
+                        supervise(asyncio.create_task(self._punch_accept(msg)),
+                                  self._accepts, logger, "punch accept")
                     elif event == "punch_addr":
                         fut = self._punch_waits.pop(msg.get("conn", ""), None)
                         if fut is not None and not fut.done():

@@ -325,6 +325,9 @@ class UdpStream:
         self._closed = False
         self._pending_writes: deque[bytes] = deque()
         self._sender_task: asyncio.Task | None = None
+        # close() fires _graceful_close in the background; the handle is
+        # retained so the task can't be GC-cancelled mid-FIN (sdlint SD003)
+        self._close_task: asyncio.Task | None = None
         self._loop = asyncio.get_running_loop()
         endpoint.set_receiver(self._on_datagram)
 
@@ -743,7 +746,7 @@ class UdpStream:
         if self._closed or self._fin_sent:
             return
         self._fin_sent = True
-        self._loop.create_task(self._graceful_close())
+        self._close_task = self._loop.create_task(self._graceful_close())
 
     async def _graceful_close(self) -> None:
         try:

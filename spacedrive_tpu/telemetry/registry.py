@@ -108,6 +108,19 @@ class _Family:
             self._series[key] = series
         return series
 
+    def _peek(self, labels: dict[str, Any]) -> Any:
+        """Series for a label set WITHOUT creating it; caller holds the
+        lock. Read paths must use this: a probing read (dashboard,
+        snapshot helper, typo'd label) must not mint a permanent series
+        or eat into the family's cardinality cap."""
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {tuple(labels)}"
+            )
+        key = tuple(str(labels[n]) for n in self.label_names)
+        return self._series.get(key)
+
     def _reset(self) -> None:
         keep = self._series.keys() if not self.label_names else ()
         fresh = {k: self._new_series() for k in keep}
@@ -128,7 +141,8 @@ class Counter(_Family):
 
     def value(self, **labels: Any) -> float:
         with self._lock:
-            return self._resolve(labels).value
+            s = self._peek(labels)
+            return s.value if s is not None else 0.0
 
 
 class Gauge(_Family):
@@ -150,7 +164,8 @@ class Gauge(_Family):
 
     def value(self, **labels: Any) -> float:
         with self._lock:
-            return self._resolve(labels).value
+            s = self._peek(labels)
+            return s.value if s is not None else 0.0
 
 
 class Histogram(_Family):
@@ -186,11 +201,14 @@ class Histogram(_Family):
         """Raw recent observations — the in-process read path bench.py
         and telemetry.snapshot share with the scrape endpoint."""
         with self._lock:
-            return list(self._resolve(labels).recent)
+            s = self._peek(labels)
+            return list(s.recent) if s is not None else []
 
     def stats(self, **labels: Any) -> dict[str, float]:
         with self._lock:
-            s = self._resolve(labels)
+            s = self._peek(labels)
+            if s is None:
+                return {"sum": 0.0, "count": 0}
             return {"sum": s.sum, "count": s.count}
 
 

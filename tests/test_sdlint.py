@@ -1,0 +1,706 @@
+"""sdlint self-tests: per-rule positive/negative fixtures plus the
+whole-tree gate.
+
+Every shipped rule must (a) fire on a minimal reproduction of the bug
+class it encodes and (b) stay silent on the clean idiom this repo
+actually uses — the negative fixtures are the spec for what the rules
+must NOT nag about. The gate test invokes the exact same entry point as
+`make lint` (`python -m tools.sdlint spacedrive_tpu --format=json`), so
+tier-1 and CI cannot drift apart.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.sdlint import Baseline, analyze_paths
+from tools.sdlint.baseline import BaselineError, DEFAULT_BASELINE
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def run_on(tmp_path, source, rules=None):
+    f = tmp_path / "fixture.py"
+    f.write_text(textwrap.dedent(source))
+    findings, errors = analyze_paths([f], rules)
+    assert not errors, errors
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --- SD001 async-blocking-call --------------------------------------------
+
+
+def test_sd001_flags_blocking_calls_in_async(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import subprocess, time
+
+        async def pump():
+            time.sleep(1)
+            subprocess.run(["ls"])
+            with open("/tmp/x") as f:
+                return f.read()
+        """,
+        ["SD001"],
+    )
+    assert len(findings) == 3
+    assert rules_of(findings) == ["SD001"]
+
+
+def test_sd001_silent_on_clean_async(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio, time
+
+        async def pump():
+            await asyncio.sleep(1)
+            data = await asyncio.to_thread(open, "/tmp/x")
+
+            def sync_helper():
+                # runs via to_thread, not on the loop
+                time.sleep(1)
+
+            return await asyncio.to_thread(sync_helper)
+
+        def plain():
+            time.sleep(1)  # not async: fine
+        """,
+        ["SD001"],
+    )
+    assert findings == []
+
+
+# --- SD002 sync-lock-across-await -----------------------------------------
+
+
+def test_sd002_flags_await_under_threading_lock(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio, threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad(self):
+                with self._lock:
+                    await asyncio.sleep(0)
+
+            async def also_bad(self):
+                self._lock.acquire()
+        """,
+        ["SD002"],
+    )
+    assert len(findings) == 2
+
+
+def test_sd002_asyncio_lock_not_mistaken_for_threading_lock(tmp_path):
+    """A same-named `asyncio.Lock` on another class (or an awaited
+    `.acquire()`) must not resolve as the module's threading lock."""
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio, threading
+
+        class SyncThing:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        class AsyncThing:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def go(self):
+                await self._lock.acquire()
+                try:
+                    await asyncio.sleep(0)
+                finally:
+                    self._lock.release()
+        """,
+        ["SD002"],
+    )
+    assert findings == []
+
+
+def test_sd002_silent_on_asyncio_lock_and_await_free_sections(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio, threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._alock = asyncio.Lock()
+
+            async def ok(self):
+                with self._lock:
+                    x = 1  # no await while held
+                async with self._alock:
+                    await asyncio.sleep(0)
+                got = self._lock.acquire(False)  # non-blocking probe
+                return x, got
+        """,
+        ["SD002"],
+    )
+    assert findings == []
+
+
+# --- SD003 orphaned-task ---------------------------------------------------
+
+
+def test_sd003_flags_dropped_and_lambda_spawns(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio
+
+        def kick(loop, coro, entry):
+            asyncio.create_task(coro())
+            loop.call_later(1.0, lambda: loop.create_task(coro()))
+        """,
+        ["SD003"],
+    )
+    assert len(findings) == 2
+
+
+def test_sd003_silent_on_retained_tasks(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio
+
+        class Actor:
+            def __init__(self):
+                self._tasks = set()
+
+            def spawn(self, coro):
+                task = asyncio.create_task(coro())
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+
+            async def direct(self, coro):
+                await asyncio.create_task(coro())
+                return asyncio.gather(asyncio.create_task(coro()))
+        """,
+        ["SD003"],
+    )
+    assert findings == []
+
+
+# --- SD004 lock-order-cycle ------------------------------------------------
+
+
+def test_sd004_flags_abba_cycle_through_helper_call(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def path1():
+            with _a:
+                with _b:
+                    pass
+
+        def path2():
+            with _b:
+                helper()
+
+        def helper():
+            with _a:
+                pass
+        """,
+        ["SD004"],
+    )
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+
+
+def test_sd004_multi_item_with_orders_left_to_right(tmp_path):
+    """`with a, b:` acquires a before b — it must create the same
+    ordering edge as the nested form, so the opposite nesting elsewhere
+    is a cycle."""
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def path1():
+            with _a, _b:
+                pass
+
+        def path2():
+            with _b:
+                with _a:
+                    pass
+        """,
+        ["SD004"],
+    )
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+
+
+def test_sd004_flags_nested_nonreentrant_self_deadlock(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """,
+        ["SD004"],
+    )
+    assert len(findings) == 1
+    assert "self-deadlock" in findings[0].message
+
+
+def test_sd004_callback_closure_does_not_fabricate_edges(tmp_path):
+    """A lock acquired inside a nested def defined while another lock is
+    held is NOT acquired there — the closure runs later. No cycle."""
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def schedule():
+            def callback():
+                with _b:
+                    pass
+            return callback
+
+        def path1():
+            with _a:
+                schedule()  # only defines the _b closure
+
+        def path2():
+            with _b:
+                with _a:
+                    pass
+        """,
+        ["SD004"],
+    )
+    assert findings == []
+
+
+def test_sd004_with_item_call_runs_before_lock_is_held(tmp_path):
+    """`with helper(), _a:` evaluates helper() BEFORE _a is acquired —
+    no held->acquired edge, no phantom cycle with a consistent
+    `_b before _a` order elsewhere."""
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def helper():
+            with _b:
+                pass
+            return open("/dev/null")
+
+        def path1():
+            with helper(), _a:
+                pass
+
+        def path2():
+            with _b:
+                with _a:
+                    pass
+        """,
+        ["SD004"],
+    )
+    assert findings == []
+
+
+def test_sd004_silent_on_consistent_order_and_rlock(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        class C:
+            def __init__(self):
+                self._r = threading.RLock()
+
+            def reenter(self):
+                with self._r:
+                    self.helper()
+
+            def helper(self):
+                with self._r:  # RLock: reentry is the point
+                    pass
+
+        def path1():
+            with _a:
+                with _b:
+                    pass
+
+        def path2():
+            with _a:  # same global order everywhere
+                with _b:
+                    pass
+        """,
+        ["SD004"],
+    )
+    assert findings == []
+
+
+# --- SD005 host-sync-in-jit ------------------------------------------------
+
+
+def test_sd005_flags_host_sync_inside_jit(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import functools
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = (x + 1)
+            y.block_until_ready()
+            return float(x)
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def g(x, n):
+            return x.item()
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = jax.device_get(x_ref[...])
+
+        out = pl.pallas_call(kernel, out_shape=None)
+        """,
+        ["SD005"],
+    )
+    assert len(findings) == 4
+
+
+def test_sd005_silent_outside_jit_and_on_static_args(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import functools
+        import jax
+
+        def host_wrapper(x):
+            # not jitted: sync is the point here
+            return jax.device_get(compiled(x).block_until_ready())
+
+        @functools.partial(jax.jit, static_argnames=("scale",))
+        def f(x, scale):
+            return x * float(scale)  # static: a Python number at trace time
+        """,
+        ["SD005"],
+    )
+    assert findings == []
+
+
+# --- SD006 tracer-branch ---------------------------------------------------
+
+
+def test_sd006_flags_python_branch_on_tracer(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            while x.sum() > 0:
+                x = x - 1
+            return x
+        """,
+        ["SD006"],
+    )
+    assert len(findings) == 2
+
+
+def test_sd006_silent_on_static_branches(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            if n > 2:  # static arg
+                return x
+            if x is None:  # identity check resolves at trace time
+                return x
+            if x.shape[0] > 4 and x.ndim == 2:  # shapes are static
+                return x
+            if len(x) > 3:  # len == shape[0]
+                return x
+            return x
+        """,
+        ["SD006"],
+    )
+    assert findings == []
+
+
+# --- SD007 metric-label-cardinality ---------------------------------------
+
+
+def test_sd007_flags_unbounded_label_values(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def record(path, labels, FILES, BYTES, SECONDS, RETRIES):
+            FILES.inc(result=f"error:{path}")
+            BYTES.inc(1, stage=str(path))
+            SECONDS.observe(0.1, stage=path)
+            RETRIES.inc(**labels)
+        """,
+        ["SD007"],
+    )
+    assert len(findings) == 4
+
+
+def test_sd007_silent_on_bounded_labels(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def record(ok, FILES, helper):
+            FILES.inc(result="generated")
+            FILES.inc(result="hit" if ok else "miss")  # two-constant domain
+            helper.inc(result=f"{ok}")  # not a metric handle (lowercase)
+        """,
+        ["SD007"],
+    )
+    assert findings == []
+
+
+# --- SD008 unclosed-on-exception ------------------------------------------
+
+
+def test_sd008_flags_happy_path_only_close(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def transfer(lock, path):
+            lock.acquire()
+            do_work()
+            lock.release()  # skipped if do_work raises
+
+        def read(path):
+            f = open(path)
+            data = f.read()
+            f.close()
+            return data
+        """,
+        ["SD008"],
+    )
+    assert len(findings) == 2
+
+
+def test_sd008_silent_on_finally_and_with(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def transfer(lock, path):
+            lock.acquire()
+            try:
+                do_work()
+            finally:
+                lock.release()
+            with open(path) as f:
+                return f.read()
+
+        class Span:
+            def __enter__(self):
+                return self
+
+            async def __aenter__(self):
+                return self.__enter__()  # protocol delegation, not a leak
+        """,
+        ["SD008"],
+    )
+    assert findings == []
+
+
+# --- baseline semantics ----------------------------------------------------
+
+
+def test_baseline_requires_justifications(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({
+        "version": 1,
+        "entries": [{"key": "SD001:x.py:time.sleep(1)", "justification": ""}],
+    }))
+    with pytest.raises(BaselineError):
+        Baseline.load(bl)
+    # non-strict load (the --write-baseline path) tolerates the TODO
+    assert Baseline.load(bl, strict=False).entries
+
+
+def test_baseline_split_suppresses_and_reports_stale(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import time
+
+        async def pump():
+            time.sleep(1)
+        """,
+        ["SD001"],
+    )
+    assert len(findings) == 1
+    bl = Baseline(entries={
+        findings[0].key: "fixture",
+        "SD001:gone.py:time.sleep(2)": "stale entry",
+    })
+    unbaselined, suppressed, stale = bl.split(findings)
+    assert unbaselined == []
+    assert len(suppressed) == 1
+    assert stale == ["SD001:gone.py:time.sleep(2)"]
+
+
+def test_duplicate_lines_get_distinct_baseline_keys(tmp_path):
+    """A new byte-identical copy of a baselined line must get a fresh
+    key — one suppression must not cover every future duplicate."""
+    findings = run_on(
+        tmp_path,
+        """
+        import time
+
+        async def one():
+            time.sleep(1)
+
+        async def two():
+            time.sleep(1)
+        """,
+        ["SD001"],
+    )
+    assert len(findings) == 2
+    assert findings[0].key != findings[1].key
+    assert findings[1].key.endswith("#2")
+    # suppressing only the first occurrence leaves the second unbaselined
+    bl = Baseline(entries={findings[0].key: "grandfathered"})
+    unbaselined, suppressed, _ = bl.split(findings)
+    assert len(suppressed) == 1 and len(unbaselined) == 1
+
+
+def test_write_baseline_merges_instead_of_wiping(tmp_path):
+    """A scoped --write-baseline run must keep entries it didn't
+    analyze — wiping the project baseline from a subdirectory run would
+    silently delete every justification outside that subtree."""
+    findings = run_on(
+        tmp_path,
+        """
+        import time
+
+        async def pump():
+            time.sleep(1)
+        """,
+        ["SD001"],
+    )
+    bl_path = tmp_path / "baseline.json"
+    existing = Baseline(
+        entries={"SD007:elsewhere.py:METRIC.inc(stage=path)": "bounded"}
+    )
+    existing.write(bl_path, findings)
+    merged = Baseline.load(bl_path, strict=False)
+    assert findings[0].key in merged.entries  # new entry added (empty TODO)
+    assert (
+        merged.entries["SD007:elsewhere.py:METRIC.inc(stage=path)"]
+        == "bounded"
+    )  # unrelated entry + justification preserved
+
+
+def test_baseline_keys_survive_line_moves(tmp_path):
+    src = """
+    import time
+
+    async def pump():
+        time.sleep(1)
+    """
+    before = run_on(tmp_path, src, ["SD001"])
+    after = run_on(tmp_path, "# a new comment shifts every line\n"
+                   + textwrap.dedent(src), ["SD001"])
+    assert before[0].line != after[0].line
+    assert before[0].key == after[0].key
+
+
+# --- the gate (same entry point as `make lint` / CI) -----------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.sdlint", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=180,
+    )
+
+
+def test_whole_tree_gate_zero_unbaselined_findings():
+    proc = _run_cli("spacedrive_tpu", "--format=json")
+    doc = json.loads(proc.stdout)
+    assert proc.returncode == 0, (
+        "unbaselined sdlint findings:\n"
+        + "\n".join(
+            f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+            for f in doc["findings"]
+        )
+    )
+    assert doc["ok"] is True
+    assert doc["counts"]["unbaselined"] == 0
+    # the baseline must not rot: every entry still matches a finding
+    assert doc["stale_baseline_keys"] == []
+
+
+def test_checked_in_baseline_entries_all_justified():
+    bl = Baseline.load(DEFAULT_BASELINE)  # strict: raises on empty reason
+    for key, justification in bl.entries.items():
+        assert len(justification) > 10, f"thin justification for {key}"
+
+
+def test_cli_exit_codes_and_rule_listing(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    proc = _run_cli(str(bad), "--no-baseline")
+    assert proc.returncode == 1
+    assert "SD001" in proc.stdout
+
+    proc = _run_cli(str(bad), "--no-baseline", "--rules", "SD003")
+    assert proc.returncode == 0  # only the orphan rule ran: clean
+
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in ("SD001", "SD004", "SD008"):
+        assert rid in proc.stdout

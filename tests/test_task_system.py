@@ -215,3 +215,44 @@ async def test_shutdown_returns_unfinished():
     statuses = [ (await h.wait()).status for h in handles ]
     assert all(s in (TaskStatus.SHUTDOWN, TaskStatus.DONE) for s in statuses)
     assert any(s == TaskStatus.SHUTDOWN for s in statuses)
+
+
+def test_supervise_helper_retains_and_retrieves():
+    """utils.tasks.supervise — the canonical SD003 remediation: retains
+    the handle, discards on completion, and retrieves+logs the exception
+    so it can never become an unraisable GC warning."""
+    import logging
+
+    from spacedrive_tpu.utils.tasks import supervise
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("test.supervise")
+    logger.addHandler(Capture())
+    logger.setLevel(logging.ERROR)
+
+    async def run():
+        tasks: set = set()
+
+        async def ok():
+            return 42
+
+        async def boom():
+            raise RuntimeError("nope")
+
+        t1 = supervise(asyncio.get_running_loop().create_task(ok()),
+                       tasks, logger, "ok task")
+        t2 = supervise(asyncio.get_running_loop().create_task(boom()),
+                       tasks, logger, "boom task")
+        assert tasks == {t1, t2}
+        await asyncio.gather(t1, t2, return_exceptions=True)
+        await asyncio.sleep(0)  # let done-callbacks run
+        assert not tasks  # drained
+
+    asyncio.run(run())
+    assert any("boom task failed" in m for m in records)
+    assert not any("ok task" in m for m in records)

@@ -81,6 +81,26 @@ def test_label_cardinality_cap_folds_into_overflow():
     assert c.value(key=OVERFLOW_LABEL) == 50
 
 
+def test_reads_do_not_mint_series():
+    """Regression (sdlint SD007's hazard on the read side): probing an
+    unseen label set via value()/recent()/stats() must return a default
+    WITHOUT creating a permanent series — a dashboard or snapshot helper
+    polling a typo'd label must not eat the family's cardinality cap."""
+    r = MetricsRegistry()
+    c = r.counter("t_ro_total", "reads", labels=("key",))
+    g = r.gauge("t_ro_depth", "reads", labels=("key",))
+    h = r.histogram("t_ro_seconds", "reads", labels=("key",))
+    c.inc(key="real")
+    assert c.value(key="typo") == 0.0
+    assert g.value(key="typo") == 0.0
+    assert h.recent(key="typo") == []
+    assert h.stats(key="typo") == {"sum": 0.0, "count": 0}
+    for fam_name in ("t_ro_total", "t_ro_depth", "t_ro_seconds"):
+        fam = r.get(fam_name)
+        assert all("typo" not in k for k in fam._series), fam._series
+    assert c.value(key="real") == 1.0  # real series still reads back
+
+
 def test_unknown_label_names_raise():
     r = MetricsRegistry()
     c = r.counter("t_l_total", "labeled", labels=("a",))

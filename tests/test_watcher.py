@@ -278,3 +278,70 @@ def test_polling_watch_5k_files_smoke(tmp_path):
 def test_polling_watch_100k_files_bounded(tmp_path):
     snap_s, rescan_s = _scale_watch_run(tmp_path, 100_000, budget_s=60.0)
     print(f"100k snapshot {snap_s:.1f}s, idle rescan {rescan_s:.1f}s")
+
+
+# --- orphaned-task regressions (found by sdlint SD003) ---------------------
+
+
+def test_inotify_async_emit_handler_failure_is_supervised(caplog):
+    """Regression: `_emit` used to fire-and-forget the handler coroutine
+    (`self._loop.create_task(result)` with the handle dropped), so a
+    failing async handler was GC-cancellable and its exception surfaced
+    only as an unraisable warning. Now the task is retained and its
+    exception retrieved + logged (this suite escalates unraisables to
+    errors, so the orphaned form cannot pass here)."""
+    from spacedrive_tpu.location.watcher.inotify import InotifyWatcher
+
+    async def run():
+        async def boom(event):
+            raise RuntimeError("handler exploded")
+
+        w = InotifyWatcher("/tmp", boom)
+        w._loop = asyncio.get_running_loop()
+        w._emit(WatchEvent(EventKind.CREATE, "/tmp/x", is_dir=False))
+        assert len(w._emit_tasks) == 1  # retained, not orphaned
+        for _ in range(10):
+            await asyncio.sleep(0)
+            if not w._emit_tasks:
+                break
+        assert not w._emit_tasks  # drained by the done-callback
+
+    import logging
+
+    with caplog.at_level(logging.ERROR,
+                         logger="spacedrive_tpu.location.watcher.inotify"):
+        asyncio.run(run())
+    assert any("emit handler failed" in r.message for r in caplog.records)
+
+
+def test_location_manager_flush_task_supervised(caplog):
+    """Regression: the debounce timer spawned `_flush` via
+    `lambda: loop.create_task(...)` — the handle vanished into the
+    call_later callback's discarded return value. Now flushes are
+    tracked in `_flush_tasks` and failures are retrieved + logged."""
+    from spacedrive_tpu.location.manager import LocationManager, _Watched
+
+    async def run():
+        mgr = LocationManager(node=None)
+
+        async def failing_flush(entry):
+            raise RuntimeError("rescan exploded")
+
+        mgr._flush = failing_flush
+        entry = _Watched(library=None, location={}, watcher=None)
+        loop = asyncio.get_running_loop()
+        mgr._spawn_flush(loop, entry)
+        assert len(mgr._flush_tasks) == 1  # retained, not orphaned
+        for _ in range(10):
+            await asyncio.sleep(0)
+            if not mgr._flush_tasks:
+                break
+        assert not mgr._flush_tasks
+        await mgr.shutdown()  # drains cleanly with nothing in flight
+
+    import logging
+
+    with caplog.at_level(logging.ERROR,
+                         logger="spacedrive_tpu.location.manager"):
+        asyncio.run(run())
+    assert any("debounced rescan failed" in r.message for r in caplog.records)

@@ -1,0 +1,26 @@
+"""sdlint — project-native static analysis for spacedrive_tpu.
+
+An AST-based checker that encodes THIS codebase's concurrency and JAX
+invariants as enforced rules (in the spirit of RacerD's compositional
+concurrency analysis and ruff's flake8-async family), so that every PR
+toward the ROADMAP north-star — more sharding, more actors, more async
+— is checked mechanically instead of discovered as an unraisable
+warning at 2am.
+
+Run it the way CI does:
+
+    python -m tools.sdlint spacedrive_tpu
+
+Rule catalog, rationale and the baseline-suppression workflow live in
+docs/static-analysis.md.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    RULES,
+    analyze_paths,
+    iter_python_files,
+)
+from .baseline import Baseline  # noqa: F401
+
+__all__ = ["Finding", "RULES", "analyze_paths", "iter_python_files", "Baseline"]

@@ -1,0 +1,89 @@
+"""Checked-in baseline of grandfathered findings.
+
+Every entry suppresses findings whose key (``rule:path:normalized
+source line``) matches, and MUST carry a one-line justification — a
+suppression without a written reason is just a bug with paperwork.
+Keys are content-addressed (the normalized source line, not the line
+number), so edits elsewhere in a file don't invalidate the baseline;
+editing the flagged line itself does, which is exactly when the
+suppression should be re-reviewed.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+from .core import Finding
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+class BaselineError(ValueError):
+    pass
+
+
+@dataclass
+class Baseline:
+    entries: dict[str, str]  # key -> justification
+    path: Path | None = None
+
+    @classmethod
+    def load(cls, path: Path | str, *, strict: bool = True) -> "Baseline":
+        path = Path(path)
+        if not path.exists():
+            return cls(entries={}, path=path)
+        data = json.loads(path.read_text(encoding="utf-8"))
+        if data.get("version") != 1:
+            raise BaselineError(f"{path}: unknown baseline version")
+        entries: dict[str, str] = {}
+        for ent in data.get("entries", []):
+            key = ent.get("key", "")
+            just = (ent.get("justification") or "").strip()
+            if not key:
+                raise BaselineError(f"{path}: entry without a key")
+            if strict and not just:
+                raise BaselineError(
+                    f"{path}: baseline entry lacks a justification: {key}"
+                )
+            entries[key] = just
+        return cls(entries=entries, path=path)
+
+    def split(
+        self, findings: list[Finding]
+    ) -> tuple[list[Finding], list[Finding], list[str]]:
+        """-> (unbaselined, suppressed, stale_keys)."""
+        unbaselined: list[Finding] = []
+        suppressed: list[Finding] = []
+        used: set[str] = set()
+        for f in findings:
+            if f.key in self.entries:
+                suppressed.append(f)
+                used.add(f.key)
+            else:
+                unbaselined.append(f)
+        stale = sorted(set(self.entries) - used)
+        return unbaselined, suppressed, stale
+
+    def write(self, path: Path | str, findings: list[Finding]) -> None:
+        """Merge ``findings`` into the baseline: existing entries (and
+        their justifications) are always kept — a scoped run
+        (``sdlint some/subdir --write-baseline``) must never wipe
+        suppressions it didn't analyze. New entries start with an empty
+        justification, which the strict loader refuses until a human
+        fills the reason in; truly stale entries are surfaced by the
+        whole-tree gate and removed by hand."""
+        path = Path(path)
+        entries = []
+        for key in sorted({f.key for f in findings} | set(self.entries)):
+            entries.append(
+                {
+                    "key": key,
+                    "justification": self.entries.get(key, ""),
+                }
+            )
+        path.write_text(
+            json.dumps({"version": 1, "entries": entries}, indent=2) + "\n",
+            encoding="utf-8",
+        )

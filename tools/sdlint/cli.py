@@ -1,0 +1,135 @@
+"""``python -m tools.sdlint`` — the gate tier-1, the Makefile, and CI
+all share.
+
+Exit codes: 0 clean (every finding baselined), 1 unbaselined findings,
+2 usage/parse/baseline errors. ``--format=json`` emits a machine-stable
+document; text mode is for humans at the terminal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .baseline import Baseline, BaselineError, DEFAULT_BASELINE
+from .core import RULES, analyze_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.sdlint",
+        description="spacedrive_tpu static analysis (async + JAX invariants)",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to analyze")
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    p.add_argument(
+        "--baseline",
+        default=str(DEFAULT_BASELINE),
+        help="baseline JSON (default: tools/sdlint/baseline.json)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline to cover current findings (existing "
+        "justifications are kept; new entries need one filled in)",
+    )
+    p.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        from . import rules as _rules  # noqa: F401 - trigger registration
+
+        for rid in sorted(RULES):
+            r = RULES[rid]
+            print(f"{rid}  {r.name}\n      {r.summary}")
+        return 0
+
+    if not args.paths:
+        print("error: no paths given (try: python -m tools.sdlint "
+              "spacedrive_tpu)", file=sys.stderr)
+        return 2
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        from . import rules as _rules  # noqa: F401
+
+        unknown = set(rule_ids) - set(RULES)
+        if unknown:
+            print(f"error: unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    findings, errors = analyze_paths(args.paths, rule_ids)
+    if errors:
+        for err in errors:
+            print(f"error: {err}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        baseline = Baseline.load(args.baseline, strict=False)
+        baseline.write(args.baseline, findings)
+        print(f"wrote {len({f.key for f in findings})} entries to "
+              f"{args.baseline}")
+        missing = sum(
+            1
+            for key in {f.key for f in findings}
+            if not baseline.entries.get(key, "")
+        )
+        if missing:
+            print(f"note: {missing} entries need a justification before "
+                  f"the gate passes")
+        return 0
+
+    if args.no_baseline:
+        unbaselined, suppressed, stale = findings, [], []
+    else:
+        try:
+            baseline = Baseline.load(args.baseline)
+        except (BaselineError, json.JSONDecodeError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        unbaselined, suppressed, stale = baseline.split(findings)
+
+    if args.fmt == "json":
+        doc = {
+            "findings": [f.to_dict() for f in unbaselined],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "stale_baseline_keys": stale,
+            "counts": {
+                "unbaselined": len(unbaselined),
+                "suppressed": len(suppressed),
+                "stale": len(stale),
+            },
+            "ok": not unbaselined,
+        }
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in unbaselined:
+            print(f.render())
+        for key in stale:
+            print(f"warning: stale baseline entry (no longer matches): {key}")
+        n, s = len(unbaselined), len(suppressed)
+        print(f"sdlint: {n} finding{'s' if n != 1 else ''}"
+              f" ({s} baselined{', ' + str(len(stale)) + ' stale' if stale else ''})")
+
+    return 1 if unbaselined else 0

@@ -1,0 +1,406 @@
+"""Analysis core: findings, the rule registry, and the file pipeline.
+
+Everything here is dependency-free stdlib (``ast`` + ``dataclasses``)
+so the linter runs in the barest CI container — the same constraint the
+engine itself honors for its optional-dependency fallbacks.
+
+Two rule shapes exist:
+
+- **file rules** see one parsed module at a time through a
+  :class:`FileContext` (tree, source lines, parent links, and the
+  module's sync-lock inventory);
+- **project rules** see every module at once through a
+  :class:`ProjectContext` — that is what the lock-ordering analysis
+  needs to chase ``self.foo()`` calls made while a lock is held.
+
+Findings are keyed by ``rule:path:normalized-source-line`` rather than
+line *numbers*, so a checked-in baseline survives unrelated edits above
+a grandfathered site.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+SNIPPET_MAX = 160
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # posix-style path as given on the command line
+    line: int
+    col: int
+    message: str
+    snippet: str  # whitespace-normalized source line (baseline key part)
+    # occurrence index among same-rule findings with an identical snippet
+    # in the same file (line order). Keeps keys line-move-stable while a
+    # NEW byte-identical copy of a baselined line still gets a fresh,
+    # unbaselined key instead of riding the old suppression.
+    ordinal: int = 0
+
+    @property
+    def key(self) -> str:
+        suffix = f"#{self.ordinal + 1}" if self.ordinal else ""
+        return f"{self.rule}:{self.path}:{self.snippet}{suffix}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "key": self.key,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class Rule:
+    id: str
+    name: str
+    summary: str
+    check_file: Callable[["FileContext"], Iterable[Finding]] | None = None
+    check_project: Callable[["ProjectContext"], Iterable[Finding]] | None = None
+
+
+#: rule id -> Rule; populated by the ``@rule`` decorator at import time
+RULES: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, name: str, summary: str, *, project: bool = False):
+    """Register a checker. ``project=True`` marks a whole-tree rule."""
+
+    def wrap(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = Rule(
+            id=rule_id,
+            name=name,
+            summary=summary,
+            check_file=None if project else fn,
+            check_project=fn if project else None,
+        )
+        return fn
+
+    return wrap
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+}
+_REENTRANT_FACTORIES = {"threading.RLock"}
+# coroutine-native primitives: same attribute names, zero loop hazard —
+# tracked so `self._lock = asyncio.Lock()` never resolves as a sync lock
+_ASYNC_LOCK_FACTORIES = {
+    "asyncio.Lock",
+    "asyncio.Semaphore",
+    "asyncio.BoundedSemaphore",
+    "asyncio.Condition",
+}
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def walk_shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node``'s subtree without descending into nested function
+    definitions or lambdas (their bodies run in a different context);
+    ``node`` itself is yielded even when it is a def."""
+    stack: list[ast.AST] = [node]
+    while stack:
+        cur = stack.pop()
+        if cur is not node and isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+@dataclass(frozen=True)
+class LockInfo:
+    """One sync-primitive instance discovered in a module."""
+
+    owner: str | None  # enclosing class name, None for module level
+    attr: str  # attribute or variable name (``_lock``)
+    reentrant: bool
+    line: int
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # ``Class.method`` or ``func`` within the module
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    owner: str | None
+
+
+class FileContext:
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._parents: dict[ast.AST, ast.AST] | None = None
+        self._locks: list[LockInfo] | None = None
+        self._async_lock_attrs: set[tuple[str | None, str]] | None = None
+        self._functions: list[FunctionInfo] | None = None
+
+    # -- lazy indexes ------------------------------------------------------
+
+    @property
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    @property
+    def sync_locks(self) -> list[LockInfo]:
+        """``X = threading.Lock()`` / ``self._lock = threading.RLock()`` /
+        dataclass ``field(default_factory=threading.Lock)`` sites."""
+        if self._locks is not None:
+            return self._locks
+        locks: list[LockInfo] = []
+        async_attrs: set[tuple[str | None, str]] = set()
+
+        def factory_of(value: ast.AST) -> str | None:
+            if isinstance(value, ast.Call):
+                name = call_name(value)
+                if name in _LOCK_FACTORIES or name in _ASYNC_LOCK_FACTORIES:
+                    return name
+                # field(default_factory=threading.Lock)
+                if name in ("field", "dataclasses.field"):
+                    for kw in value.keywords:
+                        if kw.arg == "default_factory":
+                            fac = dotted_name(kw.value)
+                            if fac in _LOCK_FACTORIES or fac in _ASYNC_LOCK_FACTORIES:
+                                return fac
+            return None
+
+        for node in ast.walk(self.tree):
+            targets: list[ast.AST] = []
+            value: ast.AST | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            fac = factory_of(value)
+            if fac is None:
+                continue
+            for tgt in targets:
+                attr = None
+                if isinstance(tgt, ast.Name):
+                    attr = tgt.id
+                elif isinstance(tgt, ast.Attribute):
+                    attr = tgt.attr
+                if attr is None:
+                    continue
+                owner = self.enclosing_class(node)
+                if fac in _ASYNC_LOCK_FACTORIES:
+                    async_attrs.add((owner, attr))
+                    continue
+                locks.append(
+                    LockInfo(
+                        owner=owner,
+                        attr=attr,
+                        reentrant=fac in _REENTRANT_FACTORIES,
+                        line=node.lineno,
+                    )
+                )
+        self._locks = locks
+        self._async_lock_attrs = async_attrs
+        return locks
+
+    @property
+    def functions(self) -> list[FunctionInfo]:
+        if self._functions is not None:
+            return self._functions
+        out: list[FunctionInfo] = []
+
+        def visit(node: ast.AST, owner: str | None, prefix: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    out.append(FunctionInfo(qual, child, owner))
+                    visit(child, owner, f"{qual}.")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name, f"{child.name}.")
+                else:
+                    visit(child, owner, prefix)
+
+        visit(self.tree, None, "")
+        self._functions = out
+        return out
+
+    # -- queries -----------------------------------------------------------
+
+    def enclosing_class(self, node: ast.AST) -> str | None:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a lock created inside a method still belongs to the class
+                cur = self.parents.get(cur)
+                continue
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def lock_for_expr(
+        self, expr: ast.AST, at: ast.AST | None = None
+    ) -> LockInfo | None:
+        """Resolve ``self._lock`` / bare ``_LOCK`` to a known sync lock.
+
+        ``at`` anchors class-scoped resolution: a lock declared on the
+        use site's own class wins, and an asyncio primitive declared
+        there shadows a same-named sync lock elsewhere in the module
+        (``asyncio.Lock`` across ``await`` is the correct idiom, not a
+        finding)."""
+        attr = None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+        elif isinstance(expr, ast.Name):
+            attr = expr.id
+        if attr is None:
+            return None
+        locks = self.sync_locks  # also populates _async_lock_attrs
+        async_attrs = self._async_lock_attrs or set()
+        if at is not None:
+            owner = self.enclosing_class(at)
+            if (owner, attr) in async_attrs:
+                return None
+            for lock in locks:
+                if lock.attr == attr and lock.owner == owner:
+                    return lock
+        if any(a == attr for _, a in async_attrs):
+            # the attr names an async primitive somewhere and no
+            # same-class sync declaration claimed it: too ambiguous
+            return None
+        for lock in locks:
+            if lock.attr == attr:
+                return lock
+        return None
+
+    def finding(self, rule_id: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        raw = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+        snippet = " ".join(raw.split())[:SNIPPET_MAX]
+        return Finding(rule_id, self.path, line, col, message, snippet)
+
+
+@dataclass
+class ProjectContext:
+    files: list[FileContext] = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------
+# pipeline
+
+
+def iter_python_files(path: Path) -> Iterator[Path]:
+    if path.is_file():
+        if path.suffix == ".py":
+            yield path
+        return
+    for sub in sorted(path.rglob("*.py")):
+        if "__pycache__" in sub.parts:
+            continue
+        yield sub
+
+
+def analyze_paths(
+    paths: Iterable[str | Path],
+    rule_ids: Iterable[str] | None = None,
+) -> tuple[list[Finding], list[str]]:
+    """Parse every .py under ``paths`` and run the selected rules.
+
+    Returns ``(findings, errors)`` — errors are human-readable parse
+    failures; the CLI treats any as fatal so a syntax error can't
+    silently shrink coverage.
+    """
+    # rule modules self-register on import; imported here (not at module
+    # top) to dodge the rules->core->rules import cycle
+    from . import rules as _rules  # noqa: F401
+
+    selected = [
+        RULES[rid]
+        for rid in sorted(RULES)
+        if rule_ids is None or rid in set(rule_ids)
+    ]
+    project = ProjectContext()
+    findings: list[Finding] = []
+    errors: list[str] = []
+
+    for root in paths:
+        root = Path(root)
+        for file in iter_python_files(root):
+            rel = file.as_posix()
+            try:
+                source = file.read_text(encoding="utf-8")
+                tree = ast.parse(source, filename=rel)
+            except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+                errors.append(f"{rel}: {exc}")
+                continue
+            project.files.append(FileContext(rel, source, tree))
+
+    for ctx in project.files:
+        for r in selected:
+            if r.check_file is not None:
+                findings.extend(r.check_file(ctx))
+    for r in selected:
+        if r.check_project is not None:
+            findings.extend(r.check_project(project))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    # the occurrence unit is the LINE: multiple findings on one line
+    # (e.g. two hazardous labels in one record call) share its ordinal
+    lines_seen: dict[tuple[str, str, str], dict[int, int]] = {}
+    for i, f in enumerate(findings):
+        group = lines_seen.setdefault((f.rule, f.path, f.snippet), {})
+        if f.line not in group:
+            group[f.line] = len(group)
+        if group[f.line]:
+            findings[i] = replace(f, ordinal=group[f.line])
+    return findings, errors

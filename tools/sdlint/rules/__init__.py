@@ -1,0 +1,6 @@
+"""Rule modules self-register into ``core.RULES`` on import."""
+
+from . import asyncrules  # noqa: F401  SD001-SD003
+from . import lockorder  # noqa: F401  SD004
+from . import jaxrules  # noqa: F401  SD005-SD006
+from . import telemetryrules  # noqa: F401  SD007-SD008

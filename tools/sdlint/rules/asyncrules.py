@@ -1,0 +1,182 @@
+"""Concurrency rules for the asyncio side of the engine.
+
+SD001  blocking call inside ``async def``
+SD002  ``await`` while holding a ``threading`` lock / blocking acquire
+SD003  ``create_task`` whose handle is dropped (orphaned task)
+
+The repo escalates unraisable-task warnings to test failures
+(pytest.ini); these rules catch the same bug class before it ever runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    FileContext,
+    Finding,
+    call_name,
+    dotted_name,
+    rule,
+    walk_shallow,
+)
+
+# Direct calls that park the event loop. Names are matched against the
+# full dotted call target, so ``await asyncio.sleep`` never trips it.
+BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_output": "use `await asyncio.create_subprocess_exec(...)`",
+    "os.system": "use `await asyncio.create_subprocess_shell(...)`",
+    "os.waitpid": "use an asyncio child watcher",
+    "socket.create_connection": "use `await asyncio.open_connection(...)`",
+    "socket.getaddrinfo": "use `await loop.getaddrinfo(...)`",
+    "socket.gethostbyname": "use `await loop.getaddrinfo(...)`",
+    "urllib.request.urlopen": "use an async HTTP client or run_in_executor",
+    "requests.get": "use an async HTTP client or run_in_executor",
+    "requests.post": "use an async HTTP client or run_in_executor",
+    "requests.request": "use an async HTTP client or run_in_executor",
+    "shutil.copyfile": "use `await asyncio.to_thread(shutil.copyfile, ...)`",
+    "shutil.copytree": "use `await asyncio.to_thread(shutil.copytree, ...)`",
+    "shutil.rmtree": "use `await asyncio.to_thread(shutil.rmtree, ...)`",
+    "open": "bulk file IO belongs in `asyncio.to_thread` / the task system",
+}
+
+# create_task spellings: ``asyncio.create_task``, ``loop.create_task``,
+# ``self._loop.create_task``, plus ensure_future.
+_SPAWN_TAILS = ("create_task", "ensure_future")
+
+
+def _is_spawn(call: ast.Call) -> bool:
+    name = call_name(call)
+    if name is None:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    return tail in _SPAWN_TAILS
+
+
+@rule(
+    "SD001",
+    "async-blocking-call",
+    "blocking call (sleep / subprocess / sync socket or file IO) inside "
+    "`async def` parks the whole event loop",
+)
+def check_blocking(ctx: FileContext) -> Iterator[Finding]:
+    for info in ctx.functions:
+        fn = info.node
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in BLOCKING_CALLS:
+                yield ctx.finding(
+                    "SD001",
+                    node,
+                    f"blocking `{name}(...)` inside async "
+                    f"`{info.qualname}` — {BLOCKING_CALLS[name]}",
+                )
+
+
+@rule(
+    "SD002",
+    "sync-lock-across-await",
+    "holding a `threading` lock across `await` (or blocking-acquiring one "
+    "in a coroutine) can deadlock the loop against worker threads",
+)
+def check_lock_await(ctx: FileContext) -> Iterator[Finding]:
+    if not ctx.sync_locks:
+        return
+    for info in ctx.functions:
+        fn = info.node
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in walk_shallow(fn):
+            # `with self._lock:` whose body awaits
+            if isinstance(node, ast.With):
+                held = [
+                    item.context_expr
+                    for item in node.items
+                    if ctx.lock_for_expr(item.context_expr, at=node) is not None
+                ]
+                if not held:
+                    continue
+                for inner in walk_shallow(node):
+                    if isinstance(inner, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                        lock_name = dotted_name(held[0]) or "lock"
+                        yield ctx.finding(
+                            "SD002",
+                            node,
+                            f"`await` at line {inner.lineno} while holding "
+                            f"sync lock `{lock_name}` in async "
+                            f"`{info.qualname}` — release before awaiting "
+                            f"or use `asyncio.Lock`",
+                        )
+                        break
+            # blocking lock.acquire() on the loop thread
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+                # `await x.acquire()` is an asyncio primitive by
+                # construction — a threading lock would TypeError
+                and not isinstance(ctx.parents.get(node), ast.Await)
+                and ctx.lock_for_expr(node.func.value, at=node) is not None
+                and not _nonblocking_acquire(node)
+            ):
+                lock_name = dotted_name(node.func.value) or "lock"
+                yield ctx.finding(
+                    "SD002",
+                    node,
+                    f"blocking `{lock_name}.acquire()` in async "
+                    f"`{info.qualname}` — pass blocking=False or move off "
+                    f"the loop thread",
+                )
+
+
+def _nonblocking_acquire(call: ast.Call) -> bool:
+    if call.args and isinstance(call.args[0], ast.Constant):
+        if call.args[0].value in (False, 0):
+            return True
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant):
+            if kw.value.value in (False, 0):
+                return True
+        if kw.arg == "timeout":
+            return True  # bounded wait: not an unbounded loop stall
+    return False
+
+
+@rule(
+    "SD003",
+    "orphaned-task",
+    "`create_task(...)` whose handle is dropped is GC-cancellable and its "
+    "exceptions surface only as unraisable warnings",
+)
+def check_orphan_task(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_spawn(node)):
+            continue
+        parent = ctx.parents.get(node)
+        orphaned = False
+        how = ""
+        if isinstance(parent, ast.Expr):
+            orphaned = True
+            how = "result discarded"
+        elif isinstance(parent, ast.Lambda) and parent.body is node:
+            # e.g. call_later(..., lambda: loop.create_task(coro())):
+            # the callback's return value goes nowhere
+            orphaned = True
+            how = "spawned from a callback lambda, handle unreachable"
+        if orphaned:
+            yield ctx.finding(
+                "SD003",
+                node,
+                f"orphaned `{call_name(node)}(...)` ({how}) — retain the "
+                f"task (e.g. in a set with `add_done_callback(discard)`) "
+                f"or await/supervise it",
+            )

@@ -1,0 +1,237 @@
+"""JAX tracing rules for the ops/ and parallel/ hot paths.
+
+SD005  host-device sync inside a jitted / pallas function
+SD006  Python control flow branching on a (likely) tracer value
+
+Jit contexts are discovered three ways: ``@jax.jit`` decorators
+(including ``functools.partial(jax.jit, ...)``), explicit ``jax.jit(fn)``
+wrapping of a local def, and kernels handed to ``pallas_call``. Nested
+defs inside a jit body are traced too, so these rules walk the full
+subtree (unlike the async rules, which stop at def boundaries).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, call_name, dotted_name, rule
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_PALLAS_TAILS = {"pallas_call"}
+
+# attribute access on a tracer that is static at trace time → fine to
+# branch on
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+
+_HOST_SYNC_CALLS = {
+    "jax.device_get": "forces a device->host copy",
+    "np.asarray": "materializes the array on host",
+    "np.array": "materializes the array on host",
+    "numpy.asarray": "materializes the array on host",
+    "numpy.array": "materializes the array on host",
+}
+_HOST_SYNC_TAILS = {
+    "block_until_ready": "stalls the device pipeline",
+    "item": "forces a device->host scalar copy",
+    "tolist": "forces a device->host copy",
+}
+
+
+class JitContext:
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                 static: set[str], kind: str):
+        self.fn = fn
+        self.static = static  # param names that are static (not traced)
+        self.kind = kind  # "jit" | "pallas"
+
+    @property
+    def traced_params(self) -> set[str]:
+        args = self.fn.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        return {n for n in names if n not in self.static and n != "self"}
+
+
+def _static_names_from_call(call: ast.Call, fn_args: ast.arguments) -> set[str]:
+    """static_argnames / static_argnums kwargs -> param-name set."""
+    out: set[str] = set()
+    positional = [a.arg for a in fn_args.posonlyargs + fn_args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+        elif kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    if 0 <= el.value < len(positional):
+                        out.add(positional[el.value])
+    return out
+
+
+def find_jit_contexts(ctx: FileContext) -> list[JitContext]:
+    by_name: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+    for info in ctx.functions:
+        by_name.setdefault(info.node.name, info.node)
+    out: list[JitContext] = []
+    seen: set[ast.AST] = set()
+
+    def add(fn, static, kind):
+        if fn not in seen:
+            seen.add(fn)
+            out.append(JitContext(fn, static, kind))
+
+    # decorator forms
+    for info in ctx.functions:
+        fn = info.node
+        for dec in fn.decorator_list:
+            if dotted_name(dec) in _JIT_NAMES:
+                add(fn, set(), "jit")
+            elif isinstance(dec, ast.Call):
+                name = call_name(dec)
+                if name in _JIT_NAMES:  # @jax.jit(static_argnames=...)
+                    add(fn, _static_names_from_call(dec, fn.args), "jit")
+                elif name in _PARTIAL_NAMES and dec.args and (
+                    dotted_name(dec.args[0]) in _JIT_NAMES
+                ):  # @functools.partial(jax.jit, static_argnames=...)
+                    add(fn, _static_names_from_call(dec, fn.args), "jit")
+
+    # jax.jit(fn) wrapping and pallas_call(kernel, ...) handoff
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name in _JIT_NAMES and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name) and target.id in by_name:
+                fn = by_name[target.id]
+                add(fn, _static_names_from_call(node, fn.args), "jit")
+        elif name is not None and name.rsplit(".", 1)[-1] in _PALLAS_TAILS:
+            if node.args and isinstance(node.args[0], ast.Name):
+                if node.args[0].id in by_name:
+                    add(by_name[node.args[0].id], set(), "pallas")
+    return out
+
+
+@rule(
+    "SD005",
+    "host-sync-in-jit",
+    "host-device synchronization inside a jitted/pallas body defeats "
+    "async dispatch (and usually fails to trace at all)",
+)
+def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    for jit in find_jit_contexts(ctx):
+        params = jit.traced_params
+        for node in ast.walk(jit.fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in _HOST_SYNC_CALLS:
+                yield ctx.finding(
+                    "SD005",
+                    node,
+                    f"`{name}(...)` inside {jit.kind} `{jit.fn.name}` "
+                    f"{_HOST_SYNC_CALLS[name]} — keep the body pure device "
+                    f"compute",
+                )
+                continue
+            if isinstance(node.func, ast.Attribute):
+                tail = node.func.attr
+                if tail in _HOST_SYNC_TAILS:
+                    yield ctx.finding(
+                        "SD005",
+                        node,
+                        f"`.{tail}()` inside {jit.kind} `{jit.fn.name}` "
+                        f"{_HOST_SYNC_TAILS[tail]} — move it outside the "
+                        f"traced body",
+                    )
+                    continue
+            if (
+                name in ("float", "int", "bool")
+                and node.args
+                and _mentions_params(node.args[0], params)
+            ):
+                yield ctx.finding(
+                    "SD005",
+                    node,
+                    f"`{name}(...)` on a traced value inside {jit.kind} "
+                    f"`{jit.fn.name}` forces host materialization — use "
+                    f"`.astype(...)` / keep it a tracer",
+                )
+
+
+@rule(
+    "SD006",
+    "tracer-branch",
+    "Python `if`/`while` on a traced value re-triggers compilation per "
+    "value (or raises ConcretizationError) — use lax.cond/select",
+)
+def check_tracer_branch(ctx: FileContext) -> Iterator[Finding]:
+    for jit in find_jit_contexts(ctx):
+        params = jit.traced_params
+        if not params:
+            continue
+        for node in ast.walk(jit.fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            offender = _tracer_use_in_test(ctx, node.test, params)
+            if offender is not None:
+                kw = "if" if isinstance(node, ast.If) else "while"
+                yield ctx.finding(
+                    "SD006",
+                    node,
+                    f"`{kw}` on traced `{offender}` inside {jit.kind} "
+                    f"`{jit.fn.name}` — branch with `lax.cond`/`lax.select` "
+                    f"or mark the argument static",
+                )
+
+
+def _mentions_params(node: ast.AST, params: set[str]) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in params for n in ast.walk(node)
+    )
+
+
+def _tracer_use_in_test(
+    ctx: FileContext, test: ast.AST, params: set[str]
+) -> str | None:
+    """Name of a param used non-statically in ``test``, else None.
+
+    Static (allowed) uses: ``x.shape``/``.ndim``/``.dtype``/``.size``,
+    ``len(x)``, ``isinstance(x, ...)``, and ``x is None`` identity
+    checks — all resolved at trace time.
+    """
+    # parent links scoped to the test expression
+    parents: dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(test):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in params):
+            continue
+        cur, child = parents.get(node), node
+        ok = False
+        while cur is not None:
+            if isinstance(cur, ast.Attribute) and cur.attr in _STATIC_ATTRS:
+                ok = True
+                break
+            if isinstance(cur, ast.Call) and call_name(cur) in (
+                "len",
+                "isinstance",
+                "hasattr",
+            ):
+                ok = True
+                break
+            if isinstance(cur, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in cur.ops
+            ):
+                ok = True
+                break
+            child, cur = cur, parents.get(cur)
+        if not ok:
+            return node.id
+    return None

@@ -1,0 +1,247 @@
+"""SD004 — lock-ordering cycles.
+
+A deliberately simple compositional analysis in the RacerD spirit: for
+every function we summarize *which locks it can acquire* (directly or
+via same-module callees), then replay each function tracking the stack
+of locks currently held. Every ``held -> newly-acquired`` pair becomes
+an edge in a project-wide lock graph; a strongly-connected component of
+size > 1 is a potential AB/BA deadlock, and a self-edge on a
+non-reentrant ``threading.Lock`` is a guaranteed one.
+
+Call resolution is intentionally shallow — ``self.method()``, bare
+module functions, and ``ClassName.method`` within one module — because
+that is where real ordering bugs in this codebase live (tasks/, p2p/,
+telemetry/ each keep their locks module-private).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    FileContext,
+    Finding,
+    LockInfo,
+    ProjectContext,
+    call_name,
+    rule,
+    walk_shallow,
+)
+
+
+def _lock_id(ctx: FileContext, lock: LockInfo) -> str:
+    owner = lock.owner or "<module>"
+    return f"{ctx.path}::{owner}.{lock.attr}"
+
+
+class _ModuleLocks:
+    """Per-module lock inventory + function summaries."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.functions = {info.qualname: info for info in ctx.functions}
+        self._summaries: dict[str, set[str]] = {}
+        self._in_progress: set[str] = set()
+
+    def resolve_lock(self, expr: ast.AST, site: ast.AST) -> LockInfo | None:
+        """Prefer the lock declared on the class the use site lives in;
+        same-named locks on other classes are a fallback."""
+        lock = self.ctx.lock_for_expr(expr, at=site)
+        if lock is None:
+            return None
+        owner = self.ctx.enclosing_class(site)
+        for cand in self.ctx.sync_locks:
+            if cand.attr == lock.attr and cand.owner == owner:
+                return cand
+        return lock
+
+    def resolve_call(self, call: ast.Call, site: ast.AST) -> str | None:
+        """-> qualname of a same-module callee, or None."""
+        name = call_name(call)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            owner = self.ctx.enclosing_class(site)
+            if owner is not None and f"{owner}.{parts[1]}" in self.functions:
+                return f"{owner}.{parts[1]}"
+            return None
+        if name in self.functions:
+            return name
+        return None
+
+    def locks_acquired(self, qualname: str) -> set[str]:
+        """Transitive set of lock ids ``qualname`` can acquire."""
+        if qualname in self._summaries:
+            return self._summaries[qualname]
+        if qualname in self._in_progress:  # recursion guard
+            return set()
+        self._in_progress.add(qualname)
+        acquired: set[str] = set()
+        fn = self.functions[qualname].node
+        # shallow walk, matching the replay in check_lock_order: a lock
+        # taken inside a nested def is acquired when the closure RUNS,
+        # not when the enclosing function does — counting it here would
+        # fabricate held->acquired edges (and phantom cycles)
+        for node in walk_shallow(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = self.resolve_lock(item.context_expr, node)
+                    if lock is not None:
+                        acquired.add(_lock_id(self.ctx, lock))
+            elif isinstance(node, ast.Call):
+                callee = self.resolve_call(node, node)
+                if callee is not None:
+                    acquired |= self.locks_acquired(callee)
+        self._in_progress.discard(qualname)
+        self._summaries[qualname] = acquired
+        return acquired
+
+
+@rule(
+    "SD004",
+    "lock-order-cycle",
+    "two locks acquired in opposite orders on different paths (or a "
+    "non-reentrant lock re-acquired while held) can deadlock",
+    project=True,
+)
+def check_lock_order(project: ProjectContext) -> Iterator[Finding]:
+    # edges[(held, acquired)] = (ctx, representative AST site)
+    edges: dict[tuple[str, str], tuple[FileContext, ast.AST]] = {}
+    reentrant: dict[str, bool] = {}
+
+    for ctx in project.files:
+        if not ctx.sync_locks:
+            continue
+        mod = _ModuleLocks(ctx)
+        for lock in ctx.sync_locks:
+            reentrant[_lock_id(ctx, lock)] = lock.reentrant
+
+        def visit(node: ast.AST, held: list[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                visit_node(child, held)
+
+        def visit_node(child: ast.AST, held: list[str]) -> None:
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                got = 0
+                for item in child.items:
+                    # the item expression evaluates BEFORE its lock is
+                    # held: `with helper(), _a:` runs helper() lock-free
+                    visit_node(item.context_expr, held)
+                    lock = mod.resolve_lock(item.context_expr, child)
+                    if lock is None:
+                        continue
+                    lid = _lock_id(ctx, lock)
+                    for h in held:
+                        edges.setdefault((h, lid), (ctx, child))
+                    # items acquire left-to-right: `with a, b:` orders
+                    # a before b just like nested withs
+                    held.append(lid)
+                    got += 1
+                for stmt in child.body:
+                    visit_node(stmt, held)
+                del held[len(held) - got:]
+            elif isinstance(child, ast.Call):
+                callee = mod.resolve_call(child, child)
+                if callee is not None and held:
+                    for lid in mod.locks_acquired(callee):
+                        for h in held:
+                            edges.setdefault((h, lid), (ctx, child))
+                visit(child, held)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def does not run where it is defined
+                visit(child, [])
+            else:
+                visit(child, held)
+
+        visit(ctx.tree, [])
+
+    # self-edges: re-acquiring a non-reentrant lock while held
+    for (a, b), (ctx, site) in sorted(edges.items()):
+        if a == b and not reentrant.get(a, True):
+            yield ctx.finding(
+                "SD004",
+                site,
+                f"non-reentrant lock `{a.split('::')[1]}` acquired while "
+                f"already held — guaranteed self-deadlock (use RLock or "
+                f"restructure)",
+            )
+
+    # AB/BA cycles via SCC (Tarjan, iterative)
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+    for comp in _sccs(graph):
+        if len(comp) < 2:
+            continue
+        comp_sorted = sorted(comp)
+        # anchor the finding at the lexically-first edge inside the SCC
+        anchor = min(
+            (
+                (ctx, site, (a, b))
+                for (a, b), (ctx, site) in edges.items()
+                if a in comp and b in comp
+            ),
+            key=lambda t: (t[0].path, t[1].lineno),
+        )
+        ctx, site, _ = anchor
+        names = " -> ".join(lid.split("::")[1] for lid in comp_sorted)
+        yield ctx.finding(
+            "SD004",
+            site,
+            f"lock-order cycle between {{{names}}} — different code paths "
+            f"acquire these locks in opposite orders; pick one global "
+            f"order",
+        )
+
+
+def _sccs(graph: dict[str, set[str]]) -> list[set[str]]:
+    """Iterative Tarjan strongly-connected components."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[set[str]] = []
+    counter = [0]
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work: list[tuple[str, Iterator[str]]] = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp: set[str] = set()
+                while True:
+                    top = stack.pop()
+                    on_stack.discard(top)
+                    comp.add(top)
+                    if top == node:
+                        break
+                out.append(comp)
+    return out

@@ -1,0 +1,188 @@
+"""Telemetry hygiene rules.
+
+SD007  label-cardinality hazards on metric record calls
+SD008  manually-opened resource (lock/span/file) not closed on the
+       exception path
+
+SD007 keys off this repo's conventions: metric handles are ALL_CAPS
+module attributes (``metrics.SPAN_SECONDS``, ``THUMB_FILES``) and label
+values ride as keyword arguments to ``.inc()/.observe()/.set()``. The
+registry caps series per family as a backstop, but a capped-out family
+silently drops samples — better to catch the f-string at review time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, call_name, dotted_name, rule, walk_shallow
+
+_RECORD_METHODS = {"inc", "observe", "set", "labels", "dec"}
+
+
+def _is_metric_handle(expr: ast.AST) -> bool:
+    """ALL_CAPS last path segment — the repo's metric-handle idiom."""
+    name = dotted_name(expr)
+    if name is None:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    return tail.isupper() and len(tail) > 1
+
+
+def _label_hazard(value: ast.AST) -> str | None:
+    if isinstance(value, ast.JoinedStr):
+        return "f-string label value"
+    if isinstance(value, ast.Constant):
+        return None
+    if isinstance(value, ast.BinOp) and isinstance(
+        value.op, (ast.Add, ast.Mod)
+    ):
+        return "string-built label value"
+    if isinstance(value, ast.Call):
+        name = call_name(value)
+        if name == "str" or (name or "").endswith(".format"):
+            return "stringified label value"
+        return "computed label value"
+    if isinstance(value, (ast.Name, ast.Attribute, ast.Subscript)):
+        return "variable label value"
+    if isinstance(value, ast.IfExp):
+        # `"hit" if ok else "miss"` — bounded by construction
+        if _label_hazard(value.body) is None and _label_hazard(value.orelse) is None:
+            return None
+        return "conditional label value"
+    return "dynamic label value"
+
+
+@rule(
+    "SD007",
+    "metric-label-cardinality",
+    "non-constant label values on counters/histograms can explode series "
+    "cardinality until the registry cap silently drops samples",
+)
+def check_label_cardinality(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _RECORD_METHODS
+            and _is_metric_handle(node.func.value)
+        ):
+            continue
+        handle = dotted_name(node.func.value)
+        for kw in node.keywords:
+            if kw.arg is None:  # **labels — unauditable by construction
+                yield ctx.finding(
+                    "SD007",
+                    node,
+                    f"`**` label expansion on `{handle}.{node.func.attr}` — "
+                    f"cardinality unauditable; pass explicit labels",
+                )
+                continue
+            hazard = _label_hazard(kw.value)
+            if hazard is not None:
+                yield ctx.finding(
+                    "SD007",
+                    node,
+                    f"{hazard} `{kw.arg}=...` on `{handle}."
+                    f"{node.func.attr}` — label domains must be small and "
+                    f"fixed (enum-like), or baselined with a bound "
+                    f"justification",
+                )
+
+
+# -- SD008 ------------------------------------------------------------------
+
+# (opener-attr, {closer-attrs}) pairs for manual resource protocols
+_PAIRS = {
+    "acquire": {"release"},
+    "__enter__": {"__exit__"},
+}
+_OPEN_BUILTIN_CLOSERS = {"close"}
+
+
+@rule(
+    "SD008",
+    "unclosed-on-exception",
+    "manually paired open/close (acquire/release, __enter__/__exit__, "
+    "open/close) where the close is not in a `finally` leaks the resource "
+    "on the exception path",
+)
+def check_unclosed(ctx: FileContext) -> Iterator[Finding]:
+    for info in ctx.functions:
+        fn = info.node
+        if fn.name in ("__enter__", "__aenter__", "__exit__", "__aexit__"):
+            # context-protocol delegation (async __aenter__ calling the
+            # sync __enter__) — the pairing lives at the caller's `with`
+            continue
+        opens: list[tuple[str, str, ast.AST]] = []  # (receiver, opener, site)
+        closes: list[tuple[str, str, ast.AST]] = []  # (receiver, closer, site)
+
+        # shallow walk: pairing an open with a close across function
+        # boundaries would be meaningless
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                recv = dotted_name(node.func.value)
+                if recv is None:
+                    continue
+                if node.func.attr in _PAIRS:
+                    opens.append((recv, node.func.attr, node))
+                elif node.func.attr in (
+                    {"release", "__exit__"} | _OPEN_BUILTIN_CLOSERS
+                ):
+                    closes.append((recv, node.func.attr, node))
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if call_name(node.value) == "open":
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            opens.append((tgt.id, "open", node.value))
+
+        for recv, opener, site in opens:
+            closers = (
+                _OPEN_BUILTIN_CLOSERS if opener == "open" else _PAIRS[opener]
+            )
+            matching = [
+                (r, c, n) for (r, c, n) in closes if r == recv and c in closers
+            ]
+            if not matching:
+                if opener == "acquire":
+                    # cross-method lock protocols (acquire in one method,
+                    # release in another) are a deliberate pattern here —
+                    # only same-function pairs are auditable
+                    continue
+                yield ctx.finding(
+                    "SD008",
+                    site,
+                    f"`{recv}.{opener}()`-style open in `{info.qualname}` "
+                    f"with no close in this function — use `with` or close "
+                    f"in a `finally`",
+                )
+                continue
+            if not any(_in_finally(ctx, n, fn) for (_, _, n) in matching):
+                yield ctx.finding(
+                    "SD008",
+                    site,
+                    f"`{recv}` opened via `.{opener}()` in "
+                    f"`{info.qualname}` but only closed on the happy path — "
+                    f"move the close into `finally` (or use `with`)",
+                )
+
+
+def _in_finally(ctx: FileContext, node: ast.AST, stop: ast.AST) -> bool:
+    cur = node
+    parent = ctx.parents.get(cur)
+    while parent is not None and cur is not stop:
+        if isinstance(parent, ast.Try) and any(
+            cur is stmt or _contains(stmt, cur) for stmt in parent.finalbody
+        ):
+            return True
+        cur, parent = parent, ctx.parents.get(parent)
+    return False
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(root))
