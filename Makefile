@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: lint test tier1
+.PHONY: lint test tier1 trace-smoke debug-bundle
 
 lint:
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
@@ -13,3 +13,15 @@ test: tier1
 tier1:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# observability smoke: boot a node, index, assert /metrics + /trace +
+# debug bundle are live and secret-free
+trace-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_observability_smoke.py \
+		tests/test_trace.py -q -p no:cacheprovider
+
+# offline redacted diagnostic bundle (add SDX_URL=http://... for a live
+# node's bundle instead)
+debug-bundle:
+	env JAX_PLATFORMS=cpu $(PY) -m spacedrive_tpu debug-bundle \
+		$(if $(SDX_URL),--url $(SDX_URL)) --out debug-bundle.json

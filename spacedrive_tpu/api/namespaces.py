@@ -1610,6 +1610,22 @@ def _telemetry(r: Router) -> None:
         # the Prometheus text, for copy/paste diagnostics in the UI
         return {"text": telemetry.render()}
 
+    @r.query("telemetry.trace_export")
+    def trace_export(node, arg=None):
+        # Chrome-trace JSON (Perfetto-loadable); arg {trace_id?} filters
+        trace_id = (arg or {}).get("trace_id") if isinstance(arg, dict) else None
+        return telemetry.trace_export(trace_id)
+
+    @r.query("telemetry.events")
+    def events(node):
+        # the flight recorder's rings, most-recent-last
+        return telemetry.events.all_events()
+
+    @r.query("telemetry.debug_bundle")
+    def debug_bundle(node):
+        # the redacted support artifact (see telemetry.bundle)
+        return telemetry.debug_bundle(node)
+
 
 def _invalidation(r: Router) -> None:
     @r.subscription("invalidation.listen")

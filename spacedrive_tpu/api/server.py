@@ -65,6 +65,7 @@ class ApiServer:
             [
                 web.get("/", self._index),
                 web.get("/metrics", self._metrics),
+                web.get("/trace", self._trace),
                 web.get("/static/{path:.*}", self._static),
                 web.get("/rspc/client.js", self._client_js),
                 web.get("/rspc/manifest", self._manifest),
@@ -125,6 +126,15 @@ class ApiServer:
             content_type="text/plain",
             charset="utf-8",
             headers={"X-Prometheus-Format": "0.0.4"},
+        )
+
+    async def _trace(self, request: web.Request) -> web.Response:
+        """Chrome-trace-event JSON of the completed-span ring — download
+        and load straight into Perfetto (ui.perfetto.dev) or
+        chrome://tracing. `?trace_id=<hex>` filters to one trace."""
+        return web.json_response(
+            telemetry.trace_export(request.query.get("trace_id") or None),
+            headers={"Content-Disposition": "inline; filename=sd-trace.json"},
         )
 
     async def _index(self, _request: web.Request) -> web.FileResponse:

@@ -467,6 +467,72 @@ def cmd_bench(_args: argparse.Namespace) -> int:
     return 0
 
 
+def _write_or_print(doc: str, out: str | None) -> None:
+    if out:
+        with open(out, "w") as f:
+            f.write(doc + "\n")
+        print(f"wrote {out} ({len(doc)} bytes)", file=sys.stderr)
+    else:
+        print(doc)
+
+
+def cmd_trace_export(args: argparse.Namespace) -> int:
+    """Fetch a running node's Chrome-trace JSON (GET /trace) — load the
+    output in Perfetto (ui.perfetto.dev) or chrome://tracing."""
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/trace"
+    if args.trace_id:
+        url += f"?trace_id={args.trace_id}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = resp.read().decode()
+    except (urllib.error.URLError, OSError) as e:
+        print(f"trace-export: cannot reach {url}: {e}", file=sys.stderr)
+        print("is a node running? start one with `sdx serve`", file=sys.stderr)
+        return 1
+    # refuse to write a non-trace artifact (a proxy error page, a
+    # different server on that port) — with a message, not a traceback
+    try:
+        parsed = json.loads(doc)
+        events = parsed["traceEvents"]
+    except (ValueError, TypeError, KeyError):
+        print(f"trace-export: {url} did not return Chrome-trace JSON "
+              f"(is that really an sdx node?)", file=sys.stderr)
+        return 1
+    print(f"trace-export: {len(events)} events", file=sys.stderr)
+    _write_or_print(json.dumps(parsed, indent=2), args.out)
+    return 0
+
+
+def cmd_debug_bundle(args: argparse.Namespace) -> int:
+    """The redacted debug bundle: from a running node (--url) with live
+    metrics/rings, or offline straight off the data dir."""
+    from .telemetry.bundle import render_bundle
+
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/rspc/telemetry.debug_bundle"
+        req = urllib.request.Request(
+            url, data=b"{}", headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError) as e:
+            print(f"debug-bundle: cannot reach {url}: {e}", file=sys.stderr)
+            return 1
+        doc = json.dumps(payload.get("result"), indent=2)
+    else:
+        doc = render_bundle(data_dir=args.data_dir)
+    _write_or_print(doc, args.out)
+    return 0
+
+
 # --- argument parsing -----------------------------------------------------
 
 
@@ -588,6 +654,27 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("bench", help="run the headline benchmark")
 
+    te = sub.add_parser(
+        "trace-export",
+        help="export a running node's span ring as Perfetto-loadable "
+             "Chrome-trace JSON",
+    )
+    te.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="the node's HTTP API origin (sdx serve)")
+    te.add_argument("--trace-id", default=None,
+                    help="filter to one trace id (hex)")
+    te.add_argument("--out", help="write JSON here instead of stdout")
+
+    db = sub.add_parser(
+        "debug-bundle",
+        help="redacted diagnostic bundle: config (secrets stripped), "
+             "metrics, spans, flight-recorder rings, versions/env",
+    )
+    db.add_argument("--url", default=None,
+                    help="pull the bundle from a running node instead of "
+                         "building offline from --data-dir")
+    db.add_argument("--out", help="write JSON here instead of stdout")
+
     dk = sub.add_parser(
         "desktop",
         help="managed desktop host: single instance, browser UI, "
@@ -633,6 +720,10 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_labeler(args)
     if args.cmd == "bench":
         return cmd_bench(args)
+    if args.cmd == "trace-export":
+        return cmd_trace_export(args)
+    if args.cmd == "debug-bundle":
+        return cmd_debug_bundle(args)
     if args.cmd == "desktop":
         from . import desktop
 

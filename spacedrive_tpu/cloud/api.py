@@ -9,11 +9,13 @@ non-2xx like the reference's `Result<_, rspc::Error>` surface.
 
 from __future__ import annotations
 
+import json as _json
 from typing import Any
 
 import aiohttp
 
-from .relay import b64, unb64
+from ..telemetry import trace as _trace
+from .relay import TRACE_HEADER, b64, unb64
 
 
 class CloudApiError(Exception):
@@ -30,9 +32,13 @@ class CloudClient:
     ) -> Any:
         if self._session is None:
             self._session = aiohttp.ClientSession()
+        # trace context rides an HTTP header so relay-side spans join
+        # the pushing/pulling node's trace
+        wire = _trace.wire_current()
+        headers = {TRACE_HEADER: _json.dumps(wire)} if wire else None
         try:
             async with self._session.request(
-                method, f"{self.origin}{path}", json=json
+                method, f"{self.origin}{path}", json=json, headers=headers
             ) as resp:
                 if resp.status >= 400:
                     raise CloudApiError(

@@ -24,9 +24,27 @@ from __future__ import annotations
 
 import base64
 import itertools
+import json as _json
 from typing import Any
 
 from aiohttp import web
+
+from ..telemetry import span as _span
+from ..telemetry import trace as _trace
+
+# HTTP header carrying the telemetry.trace wire dict (JSON) so relay
+# spans join the calling node's trace
+TRACE_HEADER = "X-SD-Trace"
+
+
+def _request_trace(request: web.Request) -> "_trace.TraceContext | None":
+    raw = request.headers.get(TRACE_HEADER)
+    if not raw:
+        return None
+    try:
+        return _trace.TraceContext.from_wire(_json.loads(raw))
+    except ValueError:
+        return None
 
 
 class CloudRelay:
@@ -112,33 +130,35 @@ class CloudRelay:
     async def _push(self, request: web.Request) -> web.Response:
         lib = self._lib(request)
         body = await request.json()
-        instance = body["instance_uuid"]
-        if instance not in lib["instances"]:
-            raise web.HTTPBadRequest(text="unknown instance")
-        cid = next(self._collection_ids)
-        lib["collections"].append(
-            {
-                "id": cid,
-                "instance_uuid": instance,
-                "contents": body["contents"],  # base64 packed ops
-            }
-        )
-        return web.json_response({"id": cid})
+        with _trace.use(_request_trace(request)), _span("relay.push"):
+            instance = body["instance_uuid"]
+            if instance not in lib["instances"]:
+                raise web.HTTPBadRequest(text="unknown instance")
+            cid = next(self._collection_ids)
+            lib["collections"].append(
+                {
+                    "id": cid,
+                    "instance_uuid": instance,
+                    "contents": body["contents"],  # base64 packed ops
+                }
+            )
+            return web.json_response({"id": cid})
 
     async def _pull(self, request: web.Request) -> web.Response:
         """Collections from OTHER instances after the caller's cursors:
         body {instance_uuid, cursors: {instance_uuid: last_seen_id}}."""
         lib = self._lib(request)
         body = await request.json()
-        me = body["instance_uuid"]
-        cursors = {k: int(v) for k, v in body.get("cursors", {}).items()}
-        out = [
-            c
-            for c in lib["collections"]
-            if c["instance_uuid"] != me
-            and c["id"] > cursors.get(c["instance_uuid"], 0)
-        ]
-        return web.json_response(out[: int(body.get("count", 100))])
+        with _trace.use(_request_trace(request)), _span("relay.pull"):
+            me = body["instance_uuid"]
+            cursors = {k: int(v) for k, v in body.get("cursors", {}).items()}
+            out = [
+                c
+                for c in lib["collections"]
+                if c["instance_uuid"] != me
+                and c["id"] > cursors.get(c["instance_uuid"], 0)
+            ]
+            return web.json_response(out[: int(body.get("count", 100))])
 
 
 def b64(data: bytes) -> str:

@@ -23,6 +23,7 @@ from ..sync.crdt import CompressedCRDTOperations
 from ..sync.hlc import NTP64
 from ..sync.ingest import receive_crdt_operation
 from ..sync.manager import SyncManager, _record_id_blob
+from ..telemetry import span as _span
 from .api import CloudApiError, CloudClient
 
 logger = logging.getLogger(__name__)
@@ -127,9 +128,12 @@ class CloudSync:
             if not ops:
                 return
             packed = CompressedCRDTOperations.compress(ops).pack()
-            await self.client.push_ops(
-                str(self.library.id), str(me), packed
-            )
+            # the span installs a trace context, so the push carries it
+            # to the relay (X-SD-Trace) and relay.push joins this trace
+            async with _span("cloud.send", nbytes=len(packed)):
+                await self.client.push_ops(
+                    str(self.library.id), str(me), packed
+                )
             self._sent_timestamp = ops[-1].timestamp
             self.sent_ops += len(ops)
             if len(ops) < OPS_PER_REQUEST:

@@ -23,6 +23,7 @@ import msgpack
 
 from ..tasks import ExecStatus, Interrupter, InterruptionKind, Task
 from ..telemetry import metrics as _tm
+from ..telemetry import trace as _trace
 from .report import JobReport, JobStatus
 
 if TYPE_CHECKING:
@@ -111,6 +112,10 @@ class StatefulJob(abc.ABC):
         self.errors: list[str] = []
         self.initialized = False
         self.next_jobs: list["StatefulJob"] = []
+        # distributed-trace context: minted/inherited at ingest, carried
+        # through pause/resume (it serializes with the job state) and
+        # down job chains, so one user action = one trace end to end
+        self.trace_ctx: "_trace.TraceContext | None" = None
 
     # --- contract ---
 
@@ -150,6 +155,8 @@ class StatefulJob(abc.ABC):
                 "errors": self.errors,
                 "initialized": self.initialized,
                 "next_jobs": [j.serialize_state() for j in self.next_jobs],
+                # a resumed job continues its original trace
+                "trace": self.trace_ctx.to_wire() if self.trace_ctx else None,
             },
             use_bin_type=True,
         )
@@ -169,6 +176,7 @@ class StatefulJob(abc.ABC):
         job.next_jobs = [
             StatefulJob.deserialize_state(r, registry) for r in obj.get("next_jobs", [])
         ]
+        job.trace_ctx = _trace.TraceContext.from_wire(obj.get("trace"))
         return job
 
 
@@ -188,6 +196,14 @@ class JobRunnerTask(Task):
     async def run(self, interrupter: Interrupter) -> ExecStatus:
         job, ctx = self.job, self.ctx
         report = ctx.report
+        # normally the task system installed the dispatch-time context;
+        # a directly-driven runner (tests, ad-hoc tools) still continues
+        # the job's own trace
+        trace_token = (
+            _trace.set_current(job.trace_ctx)
+            if _trace.current() is None and job.trace_ctx is not None
+            else None
+        )
         try:
             if not job.initialized:
                 await job.init_job(ctx)
@@ -223,6 +239,8 @@ class JobRunnerTask(Task):
             logger.exception("job %s failed", job.NAME)
             raise JobError(str(e)) from e
         finally:
+            if trace_token is not None:
+                _trace.reset_current(trace_token)
             # runs on DONE, pause, cancel, and failure alike — jobs
             # release runtime-only resources (thread pools, prefetch
             # buffers) here, never in finalize (which pause skips)

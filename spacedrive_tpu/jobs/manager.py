@@ -16,6 +16,8 @@ from typing import Any
 
 from ..db.database import now_iso
 from ..tasks import TaskStatus, TaskSystem
+from ..telemetry import trace as _trace
+from ..telemetry.events import JOB_EVENTS
 from ..utils.tasks import supervise
 from .job import JobContext, JobRunnerTask, StatefulJob, status_for_result
 from .report import JobProgressEvent, JobReport, JobStatus
@@ -60,6 +62,11 @@ class JobManager:
     # --- ingest & drive (ref:manager.rs:101-178) ---
 
     async def ingest(self, job: StatefulJob, library: Any, parent: JobReport | None = None) -> None:
+        # the job's trace: the caller's (an rspc mutation, a watcher
+        # flush, a parent job) when one is active, else a fresh root —
+        # the whole chain and every batch it coalesces runs under it
+        if job.trace_ctx is None:
+            job.trace_ctx = _trace.current() or _trace.new_context()
         report = JobReport(
             id=job.id,
             name=job.NAME,
@@ -68,6 +75,7 @@ class JobManager:
             status=JobStatus.QUEUED,
         )
         report.create(library.db)
+        JOB_EVENTS.emit("queued", job=job.NAME, id=str(job.id))
         self._dispatch(job, library, report)
 
     def _dispatch(self, job: StatefulJob, library: Any, report: JobReport) -> None:
@@ -75,8 +83,13 @@ class JobManager:
         report.status = JobStatus.RUNNING
         report.started_at = report.started_at or now_iso()
         report.update(library.db)
+        JOB_EVENTS.emit("running", job=job.NAME, id=str(job.id))
         runner = JobRunnerTask(job, ctx)
-        handle = self.system.dispatch(runner)
+        # dispatch under the job's context so the task-system boundary
+        # carries it (cold resume re-enters here with the deserialized
+        # context and the resumed job continues its original trace)
+        with _trace.use(job.trace_ctx):
+            handle = self.system.dispatch(runner)
         self._active[job.id] = (handle, ctx)
         # keep a strong ref: the loop only weak-refs tasks and a GC'd
         # supervisor would drop final status writes + job chaining
@@ -108,6 +121,11 @@ class JobManager:
         self._emit_progress(ctx)
         self._active.pop(job.id, None)
         logger.info("job %s -> %s", job.NAME, report.status.name)
+        JOB_EVENTS.emit(
+            "settled", job=job.NAME, id=str(job.id),
+            status=report.status.name,
+            errors=len(report.errors_text),
+        )
 
         self._notify_outcome(job, library, report)
 
@@ -115,6 +133,10 @@ class JobManager:
         if report.status in (JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS):
             self._invalidate_on_complete(job, library)
             for next_job in job.next_jobs:
+                # chained jobs continue the originating trace: the
+                # indexer → identifier → media chain is ONE user action
+                if next_job.trace_ctx is None:
+                    next_job.trace_ctx = job.trace_ctx
                 await self.ingest(next_job, library, parent=report)
 
     @staticmethod
@@ -200,6 +222,7 @@ class JobManager:
         report.status = JobStatus.PAUSED
         report.data = runner.job.serialize_state()
         report.update(ctx.library.db)
+        JOB_EVENTS.emit("paused", job=report.name, id=str(job_id))
         self._emit_progress(ctx)
 
     async def resume(self, job_id: uuid.UUID) -> None:
@@ -209,6 +232,7 @@ class JobManager:
             report = entry[1].report
             report.status = JobStatus.RUNNING
             report.update(entry[1].library.db)
+            JOB_EVENTS.emit("resumed", job=report.name, id=str(job_id))
 
     async def cancel(self, job_id: uuid.UUID) -> None:
         entry = self._active.get(job_id)

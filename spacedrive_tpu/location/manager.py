@@ -26,6 +26,7 @@ from typing import Any
 
 from ..db.database import now_iso
 from ..files.isolated_path import IsolatedFilePathData
+from ..telemetry.events import WATCHER_EVENTS
 from ..utils.tasks import supervise
 from .locations import deep_rescan_sub_path, light_scan_location
 from .watcher import EventKind, WatchEvent, new_watcher
@@ -258,6 +259,13 @@ class LocationManager:
         dirs, entry.dirty_dirs = entry.dirty_dirs, set()
         deep, entry.deep_dirs = entry.deep_dirs, set()
         entry.flush_handle = None
+        # flight-recorder record of the burst: when an index storm hits,
+        # "what watcher activity preceded it" is the first question
+        WATCHER_EVENTS.emit(
+            "burst_flush",
+            location=entry.location.get("id"),
+            shallow_dirs=len(dirs), deep_dirs=len(deep),
+        )
         # a deep scan of an ancestor covers shallow/deep scans below it
         def covered(sub: str, by: str) -> bool:
             return by == "/" or sub == by or sub.startswith(by.rstrip("/") + "/")

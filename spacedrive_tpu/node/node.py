@@ -18,8 +18,9 @@ from ..jobs.manager import JobManager
 from ..object.media.thumbnail.actor import Thumbnailer
 from ..object.orphan_remover import OrphanRemoverActor
 from ..tasks.system import TaskSystem
+from ..telemetry.events import LoopLagMonitor
 from ..utils.events import EventBus
-from ..utils.tracing import init_logger
+from ..utils.tracing import init_logger, install_loop_excepthook
 from .actors import Actors
 from .config import BackendFeature, ConfigManager, NodeConfig
 from .library import Libraries, Library
@@ -84,6 +85,7 @@ class Node:
         from ..api.namespaces import mount
 
         self.router = mount()  # ref:lib.rs Node::new returns (node, router)
+        self.loop_monitor = LoopLagMonitor()
         self._started = False
 
     # --- identity ------------------------------------------------------
@@ -117,6 +119,12 @@ class Node:
         if self._started:
             return
         self._started = True
+        # observability: orphaned-task crashes reach the log + error
+        # ring, and the loop-lag sampler feeds the flight recorder
+        import asyncio
+
+        install_loop_excepthook(asyncio.get_running_loop())
+        self.loop_monitor.start()
         # bind the thumbnailer to THIS loop up front: enqueues arrive
         # from worker threads (non-indexed walker) and can only wake the
         # actor thread-safely once it knows its owning loop
@@ -239,6 +247,7 @@ class Node:
             if cloud is not None:
                 await cloud.shutdown()
                 await cloud.client.close()
+        await self.loop_monitor.stop()
         await self.thumbnailer.shutdown()
         if self.image_labeler is not None:
             await self.image_labeler.shutdown()

@@ -32,6 +32,7 @@ from ...jobs.manager import register_job
 from ...ops import cas
 from ...telemetry import metrics as _tm
 from ...telemetry import span
+from ...telemetry import profiler as _profiler
 
 logger = logging.getLogger(__name__)
 
@@ -62,6 +63,7 @@ class FileIdentifierJob(StatefulJob):
     INVALIDATES = ("search.paths", "search.objects")
     IS_BATCHED = True
     _pipeline = None  # runtime-only window pipeline (never serialized)
+    _profiling = False  # holds one jax-profiler refcount while running
 
     async def init_job(self, ctx: JobContext) -> None:
         library = ctx.library
@@ -170,6 +172,13 @@ class FileIdentifierJob(StatefulJob):
 
         library = ctx.library
         d = self.data
+        if not self._profiling:
+            # optional device profile around the pipeline driver
+            # (SD_JAX_PROFILE=<logdir>; no-op on CPU-only CI). Armed
+            # lazily like the pipeline below, so a pause (whose cleanup
+            # released the profiler hold) re-arms on resume instead of
+            # truncating the capture at the first preemption.
+            self._profiling = _profiler.profile_start("identify")
         if self._pipeline is None:
             # The producer chains cursor windows back-to-back: window
             # N+1's disk reads and device dispatch start as soon as N's
@@ -192,6 +201,7 @@ class FileIdentifierJob(StatefulJob):
 
         t0 = time.perf_counter()
         window = await asyncio.to_thread(self._pipeline.take)
+        take_time = time.perf_counter() - t0
         if window is None:
             return StepResult()
         rows, metas, messages, msg_rows, finisher = window
@@ -216,6 +226,13 @@ class FileIdentifierJob(StatefulJob):
         db_time = time.perf_counter() - t1
         _tm.IDENTIFIER_STAGE_SECONDS.observe(db_time, stage="db")
         _tm.IDENTIFIER_FILES.inc(len(rows))
+        # the per-batch device vs host split the TPU capacity model
+        # needs: finisher = device materialization; window wait + DB
+        # linking = host
+        _tm.PIPELINE_DEVICE_SECONDS.observe(hash_span.duration,
+                                            pipeline="identify")
+        _tm.PIPELINE_HOST_SECONDS.observe(take_time + db_time,
+                                          pipeline="identify")
 
         errors = [f"unreadable file_path {r['id']}" for m, r in zip(metas, rows) if m is None]
         return StepResult(
@@ -312,6 +329,9 @@ class FileIdentifierJob(StatefulJob):
     def cleanup(self) -> None:
         """Every exit path (done/pause/cancel/fail) stops the window
         pipeline and keeps its stats."""
+        if self._profiling:
+            self._profiling = False
+            _profiler.profile_stop()
         if self._pipeline is not None:
             stats = self._pipeline.stats
             self.run_metadata["prefetch_hits"] = stats.prefetch_hits

@@ -27,6 +27,7 @@ from typing import Any, Sequence
 
 from ....telemetry import metrics as _tm
 from ....telemetry import span
+from ....telemetry import trace as _trace
 from .process import (
     Decoded,
     ThumbError,
@@ -202,6 +203,9 @@ class Thumbnailer:
             return 0
         batch = Batch(library_id=library_id, entries=norm, background=background)
         batch.id = next(self._batch_ids)
+        # the actor worker is a separate task: the batch carries the
+        # enqueueing trace (media job, watcher, ephemeral walk) across
+        batch.trace = _trace.wire_current()
         if background:
             self._bg.append(batch)
         else:
@@ -313,6 +317,10 @@ class Thumbnailer:
             self._cond.notify_all()
 
     async def _process_batch(self, batch: Batch) -> None:
+        with _trace.use(_trace.TraceContext.from_wire(batch.trace)):
+            await self._process_batch_traced(batch)
+
+    async def _process_batch_traced(self, batch: Batch) -> None:
         parallelism = (
             self._bg_parallelism if batch.background else self._fg_parallelism
         )
@@ -337,6 +345,8 @@ class Thumbnailer:
                 decoded = await asyncio.gather(*(_decode(e) for e in chunk))
             _tm.THUMB_STAGE_SECONDS.observe(
                 decode_span.duration, stage="decode")
+            _tm.PIPELINE_HOST_SECONDS.observe(
+                decode_span.duration, pipeline="thumbnail")
             device_idx: list[int] = []
             for i, d in enumerate(decoded):
                 if d is None:
@@ -371,6 +381,8 @@ class Thumbnailer:
                         )
                     _tm.THUMB_STAGE_SECONDS.observe(
                         device_span.duration, stage="device")
+                    _tm.PIPELINE_DEVICE_SECONDS.observe(
+                        device_span.duration, pipeline="thumbnail")
                     for i, webp in zip(device_idx, webps):
                         self._store_one(batch.library_id, chunk[i][0], webp)
                 except Exception:

@@ -27,12 +27,16 @@ class Batch:
     entries: list[tuple[str, str, str]]  # (cas_id, path, extension)
     background: bool = False
     id: int = 0  # process-local rendezvous handle; not persisted
+    # originating trace context (wire dict) — persisted, so a batch
+    # resumed after a crash still reports into the trace that queued it
+    trace: dict | None = None
 
     def to_wire(self) -> dict:
         return {
             "library_id": self.library_id,
             "entries": [list(e) for e in self.entries],
             "background": self.background,
+            "trace": self.trace,
         }
 
     @classmethod
@@ -41,6 +45,7 @@ class Batch:
             library_id=d.get("library_id"),
             entries=[tuple(e) for e in d.get("entries", [])],
             background=bool(d.get("background", False)),
+            trace=d.get("trace") if isinstance(d.get("trace"), dict) else None,
         )
 
 

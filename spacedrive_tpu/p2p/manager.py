@@ -18,6 +18,9 @@ from typing import Any
 
 from ..node.config import BackendFeature, P2PDiscoveryState
 from ..sync.ingest import IngestActor
+from ..telemetry import span as _span
+from ..telemetry import trace as _trace
+from ..telemetry.events import P2P_EVENTS
 from ..utils.tasks import supervise
 from .identity import RemoteIdentity
 from .mdns import MdnsDiscovery
@@ -199,23 +202,42 @@ class P2PManager:
 
     async def _handle_stream(self, stream: Any) -> None:
         header = await Header.read(stream)
+        P2P_EVENTS.emit(
+            "stream_open",
+            header=header.type.name,
+            peer=str(getattr(stream, "remote_identity", "?")),
+        )
+        # join the initiator's trace when the header carried one — the
+        # responder's spans (and any ingest work they cause) report
+        # into the trace of the node that started the operation
+        wire_ctx = _trace.TraceContext.from_wire(header.trace)
+        with _trace.use(wire_ctx):
+            await self._handle_stream_traced(stream, header, wire_ctx)
+
+    async def _handle_stream_traced(
+        self, stream: Any, header: Header,
+        wire_ctx: "_trace.TraceContext | None",
+    ) -> None:
         if header.type == HeaderType.PING:
             w = Writer(stream)
             w.u8(0xAA)
             await w.flush()
         elif header.type == HeaderType.SPACEDROP:
-            await self.spacedrop.handle_inbound(stream, header.spacedrop)
+            with _span("p2p.spacedrop_receive"):
+                await self.spacedrop.handle_inbound(stream, header.spacedrop)
         elif header.type == HeaderType.SYNC:
-            w = Writer(stream)
-            w.u8(0x01)
-            await w.flush()
-            actor = self.ingest_actors.get(header.library_id)
-            if actor is not None:
-                actor.notify()
+            with _span("p2p.sync_notify"):
+                w = Writer(stream)
+                w.u8(0x01)
+                await w.flush()
+                actor = self.ingest_actors.get(header.library_id)
+                if actor is not None:
+                    actor.notify(trace_ctx=wire_ctx)
         elif header.type == HeaderType.SYNC_REQUEST:
             lib = self.node.libraries.get(header.library_id)
             if lib is not None:
-                await respond_sync_request(stream, lib.sync)
+                with _span("p2p.sync_serve"):
+                    await respond_sync_request(stream, lib.sync)
         elif header.type == HeaderType.FILE:
             if self.node.is_feature_enabled(BackendFeature.FILES_OVER_P2P):
                 await respond_file(stream, header.file, self.node.libraries)

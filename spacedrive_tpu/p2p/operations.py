@@ -19,6 +19,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from ..telemetry import trace as _trace
 from .block import BlockSize, Range, SpaceblockRequest, SpaceblockRequests, Transfer
 from .identity import RemoteIdentity
 from .protocol import FileRequest, Header, HeaderType
@@ -82,7 +83,10 @@ class SpacedropManager:
         cancel = asyncio.Event()
         self._cancel[requests.id] = cancel
         try:
-            await Header(HeaderType.SPACEDROP, spacedrop=requests).write(stream)
+            await Header(
+                HeaderType.SPACEDROP, spacedrop=requests,
+                trace=_trace.wire_current(),
+            ).write(stream)
             decision = await asyncio.wait_for(
                 Reader(stream).u8(), SPACEDROP_TIMEOUT
             )

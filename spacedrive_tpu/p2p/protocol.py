@@ -45,6 +45,14 @@ class Header:
     library_id: uuid.UUID | None = None  # SYNC / SYNC_REQUEST
     spacedrop: SpaceblockRequests | None = None  # SPACEDROP
     file: FileRequest | None = None  # FILE
+    # distributed-trace context (telemetry.trace wire dict) riding the
+    # sync and spacedrop openers, so the remote node's spans join the
+    # initiator's trace; {} on the wire means "no context". NOTE: this
+    # protocol has no version negotiation (SYNC_REQUEST/RSPC/PAIRING
+    # were likewise added flag-day) — every peer in a mesh must run the
+    # same wire revision; a cross-revision handshake would have to land
+    # before any rolling-upgrade story.
+    trace: dict | None = None
 
     async def write(self, stream: Any) -> None:
         w = Writer(stream)
@@ -52,9 +60,11 @@ class Header:
         if self.type in (HeaderType.SYNC, HeaderType.SYNC_REQUEST):
             assert self.library_id is not None
             w.uuid(self.library_id)
+            w.msgpack(self.trace or {})
         elif self.type == HeaderType.SPACEDROP:
             assert self.spacedrop is not None
             w.msgpack(self.spacedrop.to_wire())
+            w.msgpack(self.trace or {})
         elif self.type == HeaderType.FILE:
             assert self.file is not None
             w.uuid(self.file.library_id)
@@ -67,9 +77,11 @@ class Header:
         r = Reader(stream)
         t = HeaderType(await r.u8())
         if t in (HeaderType.SYNC, HeaderType.SYNC_REQUEST):
-            return cls(t, library_id=await r.uuid())
+            lib_id = await r.uuid()
+            return cls(t, library_id=lib_id, trace=(await r.msgpack()) or None)
         if t == HeaderType.SPACEDROP:
-            return cls(t, spacedrop=SpaceblockRequests.from_wire(await r.msgpack()))
+            sd = SpaceblockRequests.from_wire(await r.msgpack())
+            return cls(t, spacedrop=sd, trace=(await r.msgpack()) or None)
         if t == HeaderType.FILE:
             return cls(
                 t,

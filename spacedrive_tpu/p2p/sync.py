@@ -17,6 +17,7 @@ from typing import Any
 from ..sync.crdt import CRDTOperation
 from ..sync.hlc import NTP64
 from ..sync.manager import SyncManager
+from ..telemetry import trace as _trace
 from .identity import RemoteIdentity
 from .protocol import Header, HeaderType
 from .wire import Reader, Writer
@@ -27,7 +28,10 @@ async def alert_new_ops(p2p: Any, identity: RemoteIdentity, library_id: uuid.UUI
     notification that this library has new ops."""
     stream = await p2p.new_stream(identity)
     try:
-        await Header(HeaderType.SYNC, library_id=library_id).write(stream)
+        await Header(
+            HeaderType.SYNC, library_id=library_id,
+            trace=_trace.wire_current(),
+        ).write(stream)
         await Reader(stream).u8()  # 1-byte ack so the write isn't racing close
     finally:
         await stream.close()
@@ -44,7 +48,10 @@ async def request_ops_from_peer(
     send watermarks, receive one op page + has_more."""
     stream = await p2p.new_stream(identity)
     try:
-        await Header(HeaderType.SYNC_REQUEST, library_id=library_id).write(stream)
+        await Header(
+            HeaderType.SYNC_REQUEST, library_id=library_id,
+            trace=_trace.wire_current(),
+        ).write(stream)
         w = Writer(stream)
         w.msgpack(
             {

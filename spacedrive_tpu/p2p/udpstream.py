@@ -47,6 +47,7 @@ from collections import deque
 from typing import Any
 
 from ..telemetry import metrics as _tm
+from ..telemetry.events import P2P_EVENTS
 from .udp import UdpEndpoint
 
 _HDR = struct.Struct("!BII")
@@ -468,6 +469,7 @@ class UdpStream:
             # segments again) — drop it whole; an honest peer cannot
             # ack what was never sent (ADVICE r5)
             _tm.UDP_BAD_ACKS.inc()
+            P2P_EVENTS.emit("bad_ack", remote=str(self.remote), ack=ack)
             return
         if len(payload) >= _RWND.size:
             self._peer_rwnd = _RWND.unpack_from(payload)[0]
@@ -598,6 +600,7 @@ class UdpStream:
             # count stall EPISODES, not probe re-arms: one long stall
             # re-arms once per backoff step and must still read as one
             _tm.UDP_RWND_STALLS.inc()
+            P2P_EVENTS.emit("rwnd_stall", remote=str(self.remote))
         self._probe_timer = self._loop.call_later(
             self._probe_ivl, self._on_probe_timer)
 
@@ -647,6 +650,12 @@ class UdpStream:
             return
         self._rto = min(self._rto * 2, RTO_MAX)
         self._cc.on_rto(self._retries)
+        # episode-level flight-recorder record (per-segment emits would
+        # tax the hot path the CC benchmark measures)
+        P2P_EVENTS.emit(
+            "rto_timeout", remote=str(self.remote),
+            retries=self._retries, outstanding=len(self._unacked),
+        )
         now = time.monotonic()
         # re-send a burst from the earliest holes — with lossy links
         # (acks drop too) repairing one segment per RTO crawls
@@ -669,6 +678,8 @@ class UdpStream:
         if self._closed:
             return
         self._closed = True
+        P2P_EVENTS.emit("stream_failed", remote=str(self.remote),
+                        error=str(exc)[:200])
         self.reader.set_exception(exc)
         self._fin_acked.set()
         # unblock anything parked on a full window (drain/_drain_pending/
@@ -759,6 +770,8 @@ class UdpStream:
             pass
         finally:
             self._closed = True
+            P2P_EVENTS.emit("stream_closed", remote=str(self.remote),
+                            retransmits=self._cc.retransmitted)
             self._fin_acked.set()  # give-up still unblocks wait_closed()
             if self._timer is not None:
                 self._timer.cancel()

@@ -25,6 +25,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Generic, TypeVar
 
 from ..telemetry import metrics as _tm
+from ..telemetry import span as _span
+from ..telemetry import trace as _trace
 
 T = TypeVar("T")
 
@@ -67,6 +69,9 @@ class WindowPipeline(Generic[T]):
         self._done = False
         self._fetch = fetch
         self._error: BaseException | None = None
+        # the producer thread starts with empty contextvars — carry the
+        # constructing task's trace across so feeder.fetch spans join it
+        self._trace_ctx = _trace.current()
         self._thread = threading.Thread(
             target=self._run, args=(start_key,), name="sd-window-pipeline",
             daemon=True,
@@ -74,10 +79,13 @@ class WindowPipeline(Generic[T]):
         self._thread.start()
 
     def _run(self, key: Any) -> None:
+        if self._trace_ctx is not None:
+            _trace.set_current(self._trace_ctx)
         try:
             while not self._stop.is_set():
                 t0 = time.perf_counter()
-                item = self._fetch(key)
+                with _span("feeder.fetch"):
+                    item = self._fetch(key)
                 fetch_s = time.perf_counter() - t0
                 with self.stats._lock:
                     self.stats.read_time += fetch_s
@@ -122,17 +130,18 @@ class WindowPipeline(Generic[T]):
                 raise self._error
             return None
         t0 = time.perf_counter()
-        while True:
-            try:
-                window = self._queue.get(timeout=0.1)
-                break
-            except _queue.Empty:
-                # close() may race a full queue (its sentinel is dropped
-                # on Full); poll the stop flag so a drained consumer
-                # can't block forever on a dead producer
-                if self._stop.is_set():
-                    window = None
+        with _span("feeder.wait"):
+            while True:
+                try:
+                    window = self._queue.get(timeout=0.1)
                     break
+                except _queue.Empty:
+                    # close() may race a full queue (its sentinel is
+                    # dropped on Full); poll the stop flag so a drained
+                    # consumer can't block forever on a dead producer
+                    if self._stop.is_set():
+                        window = None
+                        break
         waited = time.perf_counter() - t0
         hit = waited < 0.002
         with self.stats._lock:

@@ -22,6 +22,8 @@ import logging
 import uuid
 from typing import Any, Awaitable, Callable, Iterable
 
+from ..telemetry import span as _span
+from ..telemetry import trace as _trace
 from .apply import apply_op
 from .crdt import CRDTOperation, DELETE
 from .hlc import NTP64
@@ -172,9 +174,14 @@ class IngestActor:
         self._stopped = False
         self._idle = asyncio.Event()
         self._idle.set()
+        # trace of the most recent notifier (a p2p SYNC header): the
+        # pull it triggers reports into the initiating node's trace
+        self._notify_trace: "_trace.TraceContext | None" = None
 
     # --- actor API (ref:ingest.rs Event::Notification) ---
-    def notify(self) -> None:
+    def notify(self, trace_ctx: "_trace.TraceContext | None" = None) -> None:
+        if trace_ctx is not None:
+            self._notify_trace = trace_ctx
         self._notify.set()
         self._ensure_started()
 
@@ -221,8 +228,10 @@ class IngestActor:
             waited = 0.0
             self._notify.clear()
             self._idle.clear()
+            tick_trace, self._notify_trace = self._notify_trace, None
             try:
-                await self._tick()
+                with _trace.use(tick_trace):
+                    await self._tick()
             except Exception:
                 logger.exception("sync ingest tick failed")
             finally:
@@ -236,11 +245,13 @@ class IngestActor:
                 timestamps, self.ops_per_request
             )
             self.state = State.INGESTING
-            for op in ops:
-                if receive_crdt_operation(self.sync, op):
-                    self.applied += 1
-                else:
-                    self.rejected += 1
+            if ops:
+                with _span("sync.ingest"):
+                    for op in ops:
+                        if receive_crdt_operation(self.sync, op):
+                            self.applied += 1
+                        else:
+                            self.rejected += 1
             if ops and self.sync.event_bus is not None:
                 self.sync.event_bus.emit(("SyncMessage", "Ingested"))
             if not has_more:

@@ -19,6 +19,7 @@ import time
 from typing import Iterable
 
 from ..telemetry import metrics as _tm
+from ..telemetry import trace as _trace
 from .task import (
     ExecStatus,
     Interrupter,
@@ -95,6 +96,29 @@ class _Worker:
                 return handle
         return None
 
+    @staticmethod
+    def _trace_dispatch(handle: TaskHandle,
+                        now: float) -> "_trace.TraceContext | None":
+        """The execution-side half of dispatch propagation: record a
+        synthetic "task.dispatch" span covering the queue wait, and
+        return the context the task body should run under (child of the
+        dispatcher's span). None when the dispatcher had no trace."""
+        ctx = getattr(handle, "_trace_ctx", None)
+        if ctx is None:
+            return None
+        span_id = _trace.new_span_id()
+        enqueued = getattr(handle, "_enqueued_at", None)
+        wait = max(0.0, now - enqueued) if enqueued is not None else 0.0
+        _trace.record_span({
+            "stage": "task.dispatch",
+            "seconds": wait,
+            "t0": time.time() - wait,
+            "trace_id": ctx.trace_id,
+            "span_id": span_id,
+            "parent_id": ctx.span_id,
+        })
+        return _trace.TraceContext(ctx.trace_id, span_id)
+
     async def _execute(self, handle: TaskHandle) -> None:
         task = handle.task
         now = time.monotonic()
@@ -109,6 +133,15 @@ class _Worker:
             handle._dispatched_at = None
         busy = len(self.system._running) + 1  # including us
         _tm.TASK_BATCH_OCCUPANCY.observe(busy / self.system.worker_count)
+        # Trace propagation across the dispatch boundary: the worker
+        # coroutine has its own contextvars, so the causality captured
+        # at dispatch() rides the handle. A synthetic "task.dispatch"
+        # span records the queue wait, and everything the task opens
+        # nests under it via the ambient context.
+        exec_ctx = self._trace_dispatch(handle, now)
+        trace_token = (
+            _trace.set_current(exec_ctx) if exec_ctx is not None else None
+        )
         interrupter = Interrupter()
         self.current = handle
         self.current_interrupter = interrupter
@@ -128,6 +161,8 @@ class _Worker:
             self.current_interrupter = None
             self.current_coro = None
             self.system._running.pop(task.id, None)
+            if trace_token is not None:
+                _trace.reset_current(trace_token)
 
         kind = interrupter.check()
         if status == ExecStatus.DONE:
@@ -208,6 +243,9 @@ class TaskSystem:
         self.start()
         handle = TaskHandle(task, self)
         handle._dispatched_at = time.monotonic()
+        # batches carry the trace of the caller that coalesced them;
+        # the worker re-installs it before running the task body
+        handle._trace_ctx = _trace.current()
         _tm.TASKS_DISPATCHED.inc()
         self._handles[task.id] = handle
         worker = self.workers[self._rr % self.worker_count]
@@ -219,9 +257,11 @@ class TaskSystem:
         self.start()
         handles = []
         now = time.monotonic()
+        ctx = _trace.current()
         for task in tasks:
             handle = TaskHandle(task, self)
             handle._dispatched_at = now
+            handle._trace_ctx = ctx
             _tm.TASKS_DISPATCHED.inc()
             self._handles[task.id] = handle
             min(self.workers, key=lambda w: w.load()).enqueue(handle)

@@ -14,10 +14,15 @@ Surface:
   per-stage wall time and bytes;
 - ``snapshot()`` — the JSON read path (rspc ``telemetry.snapshot``,
   bench.py);
-- ``render()`` — Prometheus exposition text (the ``/metrics`` route).
+- ``render()`` — Prometheus exposition text (the ``/metrics`` route);
+- ``trace`` / ``trace_export()`` — distributed trace ids on every span,
+  exported as Chrome-trace JSON (the ``/trace`` route);
+- ``events`` — flight-recorder rings; ``debug_bundle()`` — the redacted
+  support artifact (docs/observability.md);
+- ``reset()`` — test isolation across metrics, spans, traces, rings.
 """
 
-from . import metrics
+from . import events, metrics, trace
 from .registry import (
     BYTE_BUCKETS,
     MAX_SERIES_PER_FAMILY,
@@ -36,6 +41,28 @@ from .spans import Span, clear_recent, current_span, recent_spans, span
 
 def render() -> str:
     return REGISTRY.render()
+
+
+def reset() -> None:
+    """Test/bench isolation: zero every metric series AND clear the
+    span ring, the trace ring, and every flight-recorder ring."""
+    REGISTRY.reset()
+    clear_recent()
+    trace.clear()
+    events.clear_all()
+
+
+def trace_export(trace_id=None):
+    """Chrome-trace-event JSON of the completed-span ring (the
+    ``GET /trace`` + ``telemetry.trace_export`` payload)."""
+    return trace.export(trace_id)
+
+
+def debug_bundle(node=None, data_dir=None):
+    """The redacted debug bundle dict (see telemetry.bundle)."""
+    from .bundle import build_bundle
+
+    return build_bundle(node, data_dir)
 
 
 def counter(name: str, help: str = "", labels=()):
@@ -57,4 +84,5 @@ __all__ = [
     "metrics", "span", "Span", "current_span", "recent_spans",
     "clear_recent", "snapshot", "histogram_recent", "gauge_value",
     "counter_value", "render", "counter", "gauge", "histogram",
+    "trace", "events", "reset", "trace_export", "debug_bundle",
 ]

@@ -145,6 +145,26 @@ BENCH_E2E_BATCH_SECONDS = REGISTRY.histogram(
     recent_samples=4096,
 )
 
+# --- pipeline device/host split (identify + thumbnail drivers) --------------
+
+PIPELINE_DEVICE_SECONDS = REGISTRY.histogram(
+    "sd_pipeline_device_seconds",
+    "per-batch device time (hash materialization / device resize)",
+    labels=("pipeline",),  # identify | thumbnail
+)
+PIPELINE_HOST_SECONDS = REGISTRY.histogram(
+    "sd_pipeline_host_seconds",
+    "per-batch host time (window wait + DB linking / image decode)",
+    labels=("pipeline",),  # identify | thumbnail
+)
+
+# --- event loop health (telemetry/events.py LoopLagMonitor) -----------------
+
+EVENT_LOOP_LAG = REGISTRY.gauge(
+    "sd_event_loop_lag_seconds",
+    "latest sampled event-loop scheduling lag",
+)
+
 # --- spans (telemetry/spans.py) ---------------------------------------------
 
 SPAN_SECONDS = REGISTRY.histogram(

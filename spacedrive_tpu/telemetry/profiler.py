@@ -1,0 +1,72 @@
+"""Optional ``jax.profiler`` hooks around the pipeline driver.
+
+When ``SD_JAX_PROFILE=<logdir>`` is set, the identify pipeline wraps
+its run in ``jax.profiler.start_trace``/``stop_trace`` so device-side
+traces (XLA ops, transfers) land next to the host-side Chrome trace
+this subsystem exports. Everything here is no-op-safe: unset env, a
+missing/CPU-only jax, or a profiler that refuses to start all degrade
+to "no profile", never to a failed job. Start/stop is refcounted so
+overlapping drivers (indexer chain + a watcher rescan) share one
+profiler session instead of crashing on double-start.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "SD_JAX_PROFILE"
+
+_lock = threading.Lock()
+_depth = 0
+_active_dir: str | None = None
+
+
+def profile_start(tag: str = "pipeline") -> bool:
+    """Begin (or join) a device profile session. Returns True when a
+    session is active after the call."""
+    global _depth, _active_dir
+    logdir = os.environ.get(ENV_VAR)
+    if not logdir:
+        return False
+    with _lock:
+        if _depth > 0:
+            _depth += 1
+            return True
+        try:
+            import jax
+
+            jax.profiler.start_trace(os.path.join(logdir, tag))
+        except Exception as e:  # noqa: BLE001 - profiling is best-effort
+            logger.debug("jax profiler start failed: %s", e)
+            return False
+        _depth = 1
+        _active_dir = logdir
+        logger.info("jax profiler tracing into %s", logdir)
+        return True
+
+
+def profile_stop() -> None:
+    """Release one hold on the session; the last release stops it."""
+    global _depth, _active_dir
+    with _lock:
+        if _depth == 0:
+            return
+        _depth -= 1
+        if _depth > 0:
+            return
+        _active_dir = None
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 - profiling is best-effort
+            logger.debug("jax profiler stop failed: %s", e)
+
+
+def profiling_active() -> bool:
+    with _lock:
+        return _depth > 0

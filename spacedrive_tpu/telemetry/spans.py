@@ -16,6 +16,14 @@ cross-contaminate parentage) that on exit
 Stages are dotted paths: a span opened inside another records as
 ``parent.child`` (e.g. ``identify.hash``), keeping label cardinality
 proportional to the pipeline's actual shape.
+
+Every span also carries distributed-trace identity (``trace_id``/
+``span_id``/``parent_id``, see ``telemetry.trace``): a nested span
+inherits its parent's trace; a root span adopts the ambient
+``trace.current()`` context installed by a boundary (task dispatch, job
+resume, a P2P header) or mints a fresh trace. Completed spans land in
+the trace ring for Chrome-trace export, and spans slower than
+``events.SLOW_OP_SECONDS`` fire the slow-op watchdog ring.
 """
 
 from __future__ import annotations
@@ -27,7 +35,9 @@ import time
 from collections import deque
 from typing import Any
 
+from . import events as _events
 from . import metrics
+from . import trace as _trace
 
 logger = logging.getLogger(__name__)
 
@@ -49,15 +59,23 @@ class Span:
             ...
     """
 
-    __slots__ = ("stage", "nbytes", "path", "_t0", "_token", "duration")
+    __slots__ = (
+        "stage", "nbytes", "path", "_t0", "_t0_wall", "_token",
+        "_trace_token", "duration", "trace_id", "span_id", "parent_id",
+    )
 
     def __init__(self, stage: str, nbytes: int = 0):
         self.stage = stage
         self.nbytes = int(nbytes)
         self.path = stage  # parent-prefixed on enter
         self._t0 = 0.0
+        self._t0_wall = 0.0
         self._token: contextvars.Token | None = None
+        self._trace_token: contextvars.Token | None = None
         self.duration: float | None = None
+        self.trace_id: str = ""
+        self.span_id: str = ""
+        self.parent_id: str | None = None
 
     def add_bytes(self, n: int) -> None:
         """Attribute more bytes mid-span (e.g. per-file in a loop)."""
@@ -69,7 +87,23 @@ class Span:
         parent = _current.get()
         if parent is not None:
             self.path = f"{parent.path}.{self.stage}"
+            self.trace_id = parent.trace_id
+            self.parent_id = parent.span_id
+        else:
+            # no enclosing span: join the ambient trace context a
+            # boundary installed (dispatch, resume, wire) or start fresh
+            ctx = _trace.current()
+            if ctx is not None:
+                self.trace_id = ctx.trace_id
+                self.parent_id = ctx.span_id
+            else:
+                self.trace_id = _trace.new_trace_id()
+        self.span_id = _trace.new_span_id()
         self._token = _current.set(self)
+        self._trace_token = _trace.set_current(
+            _trace.TraceContext(self.trace_id, self.span_id)
+        )
+        self._t0_wall = time.time()
         self._t0 = time.perf_counter()
         return self
 
@@ -78,6 +112,9 @@ class Span:
         if self._token is not None:
             _current.reset(self._token)
             self._token = None
+        if self._trace_token is not None:
+            _trace.reset_current(self._trace_token)
+            self._trace_token = None
         metrics.SPAN_SECONDS.observe(self.duration, stage=self.path)
         if self.nbytes:
             metrics.SPAN_BYTES.inc(self.nbytes, stage=self.path)
@@ -86,9 +123,15 @@ class Span:
             "seconds": self.duration,
             "bytes": self.nbytes,
             "error": exc_type.__name__ if exc_type is not None else None,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
         }
         with _recent_lock:
             _recent.append(rec)
+        _trace.record_span({**rec, "t0": self._t0_wall})
+        if self.duration >= _events.SLOW_OP_SECONDS:
+            _events.watchdog_slow_op(self.path, self.duration)
         logger.debug("span %s: %.3fms%s", self.path, self.duration * 1e3,
                      f" {self.nbytes}B" if self.nbytes else "")
 

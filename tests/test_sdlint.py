@@ -507,6 +507,39 @@ def test_sd007_silent_on_bounded_labels(tmp_path):
     assert findings == []
 
 
+# --- SD009 event-ring-cardinality -----------------------------------------
+
+
+def test_sd009_flags_dynamic_event_types_and_field_expansion(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def record(kind, fields, P2P_EVENTS, JOB_EVENTS, ring):
+            P2P_EVENTS.emit(f"retx_{kind}")      # runtime-built type
+            P2P_EVENTS.emit(kind)                # variable type
+            JOB_EVENTS.emit("ok", **fields)      # unauditable field names
+            JOB_EVENTS.emit()                    # no type at all
+            ring("custom").emit(kind)            # ring(...) results too
+        """,
+        ["SD009"],
+    )
+    assert len(findings) == 5
+
+
+def test_sd009_silent_on_constant_types_and_literal_fields(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def record(n, err, P2P_EVENTS, bus):
+            P2P_EVENTS.emit("retransmit", remote=str(n), count=n)
+            P2P_EVENTS.emit("stream_failed", error=str(err)[:200])
+            bus.emit(("JobProgress", n))  # the EventBus, not a ring
+        """,
+        ["SD009"],
+    )
+    assert findings == []
+
+
 # --- SD008 unclosed-on-exception ------------------------------------------
 
 

@@ -150,6 +150,67 @@ def test_label_escaping_in_exposition():
     assert 't_esc_total{p="we\\"ird\\\\path\\n"} 1' in r.render()
 
 
+def _parse_prom(text: str) -> dict[str, float]:
+    """{'name{labels}': value} for every sample line in the exposition."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        out[key] = float(val)
+    return out
+
+
+def test_render_histogram_inf_count_sum_consistency():
+    """Prometheus-contract check over the RENDERED text: for every
+    histogram series the +Inf bucket equals _count, buckets are
+    monotonically non-decreasing, and _sum parses back to the observed
+    total — the scrape surface can't drift from the internal state."""
+    r = MetricsRegistry()
+    h = r.histogram("t_c_seconds", "x", labels=("stage",),
+                    buckets=(0.01, 0.1, 1.0))
+    obs = {"a": [0.005, 0.5, 50.0], "b": [0.05]}
+    for stage, vals in obs.items():
+        for v in vals:
+            h.observe(v, stage=stage)
+    samples = _parse_prom(r.render())
+    for stage, vals in obs.items():
+        inf = samples[f't_c_seconds_bucket{{stage="{stage}",le="+Inf"}}']
+        count = samples[f't_c_seconds_count{{stage="{stage}"}}']
+        total = samples[f't_c_seconds_sum{{stage="{stage}"}}']
+        assert inf == count == len(vals)
+        assert total == pytest.approx(sum(vals))
+        cum = [
+            samples[f't_c_seconds_bucket{{stage="{stage}",le="{le}"}}']
+            for le in ("0.01", "0.1", "1", "+Inf")
+        ]
+        assert cum == sorted(cum), f"non-monotonic buckets for {stage}"
+
+
+def test_render_consistency_across_every_registered_family():
+    """The same invariant over the LIVE process registry after real
+    traffic: every histogram family's rendered +Inf == _count."""
+    telemetry.REGISTRY.render()  # must not raise
+    for fam_name, fam in telemetry.REGISTRY._families.items():
+        if fam.kind != "histogram":
+            continue
+        for key, s in fam._series.items():
+            assert sum(s.bucket_counts) == s.count, (fam_name, key)
+
+
+def test_telemetry_reset_clears_spans_trace_and_event_rings():
+    from spacedrive_tpu.telemetry import events, trace
+
+    with telemetry.span("reset_probe"):
+        pass
+    events.ring("reset_probe_ring").emit("tick")
+    assert telemetry.recent_spans() and trace.recent()
+    telemetry.reset()
+    assert telemetry.recent_spans() == []
+    assert trace.recent() == []
+    assert events.ring("reset_probe_ring").snapshot() == []
+
+
 # --- spans ----------------------------------------------------------------
 
 

@@ -1,0 +1,323 @@
+"""Distributed tracing: trace-context propagation across the dispatch
+boundary, the feeder thread, job suspend/resume, chained jobs, and the
+P2P wire — plus the Chrome-trace exporter's contract."""
+
+import asyncio
+import collections
+import json
+import os
+
+import pytest
+
+from spacedrive_tpu import telemetry
+from spacedrive_tpu.telemetry import trace
+
+
+# --- unit: context + span identity ----------------------------------------
+
+
+def test_nested_spans_share_trace_and_parent():
+    telemetry.reset()
+    with telemetry.span("outer") as outer:
+        with telemetry.span("inner") as inner:
+            pass
+    assert outer.trace_id and outer.parent_id is None
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_id == outer.span_id
+
+
+def test_root_span_adopts_ambient_context():
+    ctx = trace.new_context()
+    with trace.use(ctx):
+        with telemetry.span("child") as sp:
+            pass
+    assert sp.trace_id == ctx.trace_id
+    assert sp.parent_id == ctx.span_id
+    # outside the use() block the ambient context is gone
+    assert trace.current() is None
+
+
+def test_trace_context_wire_roundtrip_and_tolerant_decode():
+    ctx = trace.new_context()
+    back = trace.TraceContext.from_wire(ctx.to_wire())
+    assert back.trace_id == ctx.trace_id and back.span_id == ctx.span_id
+    for garbage in (None, {}, [], "x", {"trace_id": 1, "span_id": 2},
+                    {"trace_id": "a"}):
+        assert trace.TraceContext.from_wire(garbage) is None
+
+
+def test_chrome_trace_export_shape():
+    telemetry.reset()
+    with telemetry.span("export_probe", nbytes=42):
+        pass
+    doc = telemetry.trace_export()
+    # valid JSON end to end (what /trace serves)
+    doc = json.loads(json.dumps(doc))
+    events = doc["traceEvents"]
+    probe = [e for e in events if e["name"] == "export_probe"]
+    assert probe, events
+    e = probe[0]
+    assert e["ph"] == "X" and e["dur"] >= 1 and e["ts"] > 0
+    assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    assert e["args"]["trace_id"] and e["args"]["span_id"]
+    assert e["args"]["bytes"] == 42
+    # filtered export only contains that trace
+    only = telemetry.trace_export(e["args"]["trace_id"])["traceEvents"]
+    assert all(
+        ev["args"]["trace_id"] == e["args"]["trace_id"]
+        for ev in only if ev["ph"] == "X"
+    )
+
+
+# --- jax profiler hooks (no-op-safe, refcounted) --------------------------
+
+
+def test_profiler_noop_without_env(monkeypatch):
+    from spacedrive_tpu.telemetry import profiler
+
+    monkeypatch.delenv(profiler.ENV_VAR, raising=False)
+    assert profiler.profile_start("identify") is False
+    assert not profiler.profiling_active()
+    profiler.profile_stop()  # never started: still safe
+
+
+def test_profiler_refcounts_overlapping_drivers(monkeypatch, tmp_path):
+    import sys
+    import types
+
+    from spacedrive_tpu.telemetry import profiler
+
+    calls = []
+    fake_jax = types.SimpleNamespace(
+        profiler=types.SimpleNamespace(
+            start_trace=lambda d: calls.append(("start", d)),
+            stop_trace=lambda: calls.append(("stop", None)),
+        )
+    )
+    monkeypatch.setitem(sys.modules, "jax", fake_jax)
+    monkeypatch.setenv(profiler.ENV_VAR, str(tmp_path))
+    # two overlapping drivers share ONE session
+    assert profiler.profile_start("identify") is True
+    assert profiler.profile_start("identify") is True
+    assert profiler.profiling_active()
+    profiler.profile_stop()
+    assert profiler.profiling_active()  # inner release keeps it alive
+    profiler.profile_stop()
+    assert not profiler.profiling_active()
+    assert [c[0] for c in calls] == ["start", "stop"]
+    assert calls[0][1].startswith(str(tmp_path))
+
+
+# --- e2e: one indexing pass = one trace -----------------------------------
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    from PIL import Image
+
+    d = tmp_path / "corpus"
+    d.mkdir()
+    for i in range(6):
+        (d / f"f{i}.bin").write_bytes(os.urandom(2048))
+    Image.new("RGB", (48, 32), (10, 200, 30)).save(d / "img.png")
+    return str(d)
+
+
+@pytest.mark.asyncio
+async def test_index_pass_yields_single_trace_across_pipeline(tmp_path, corpus):
+    """The acceptance trace: walk → identify (hash+db) → thumbnail all
+    under ONE trace_id, including the task-dispatch boundary and the
+    feeder's producer-thread stages."""
+    from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+    from spacedrive_tpu.node import Node
+
+    telemetry.reset()
+    node = Node(os.path.join(tmp_path, "node"), use_device=False,
+                with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        lib = await node.create_library("trace-lib")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+        await scan_location(lib, loc, node.jobs)
+        await node.jobs.wait_idle()
+        await node.thumbnailer.wait_library_batch(str(lib.id))
+    finally:
+        await node.shutdown()
+
+    stages_by_trace: dict[str, set] = collections.defaultdict(set)
+    for rec in trace.recent():
+        stages_by_trace[rec["trace_id"]].add(rec["stage"])
+    # exactly one trace covers the full pipeline
+    full = [
+        tid for tid, stages in stages_by_trace.items()
+        if {"walk", "identify.hash", "identify.db", "task.dispatch",
+            "feeder.fetch", "thumbnail.decode"} <= stages
+    ]
+    assert len(full) == 1, dict(stages_by_trace)
+
+
+# --- suspend/resume continues the trace -----------------------------------
+
+
+@pytest.mark.asyncio
+async def test_job_pause_serialize_resume_keeps_trace(tmp_path):
+    from spacedrive_tpu.jobs import JobManager
+    from spacedrive_tpu.jobs.job import StatefulJob, StepResult
+    from spacedrive_tpu.jobs.manager import JOB_REGISTRY
+    from spacedrive_tpu.node import Libraries
+    from spacedrive_tpu.tasks import TaskSystem
+
+    span_traces: list[str] = []
+
+    class SlowJob(StatefulJob):
+        NAME = "trace_slow"
+
+        async def init_job(self, ctx):
+            for _ in range(20):
+                self.steps.append({})
+
+        async def execute_step(self, ctx, step, n):
+            with telemetry.span("slowstep") as sp:
+                span_traces.append(sp.trace_id)
+            await asyncio.sleep(0.02)
+            return StepResult()
+
+    JOB_REGISTRY[SlowJob.NAME] = SlowJob
+    try:
+        libs = Libraries(tmp_path)
+        library = libs.create("trace-resume")
+        mgr = JobManager(TaskSystem(2))
+        job = SlowJob()
+        await mgr.ingest(job, library)
+        original = job.trace_ctx
+        assert original is not None
+        await asyncio.sleep(0.05)
+        await mgr.pause(job.id)
+        report = library.db.find_one("job", id=job.id.bytes)
+        assert report is not None and report["data"]
+
+        # the serialized state carries the trace
+        resumed = StatefulJob.deserialize_state(report["data"], JOB_REGISTRY)
+        assert resumed.trace_ctx is not None
+        assert resumed.trace_ctx.trace_id == original.trace_id
+
+        # cold-resume path (fresh manager = process restart): the
+        # re-dispatched job continues its original trace
+        await mgr.system.shutdown()
+        before = len(span_traces)
+        mgr2 = JobManager(TaskSystem(2))
+        n = await mgr2.cold_resume(library)
+        assert n == 1
+        await mgr2.wait(job.id)
+        assert len(span_traces) > before
+        assert set(span_traces) == {original.trace_id}
+        await mgr2.system.shutdown()
+        library.close()
+    finally:
+        JOB_REGISTRY.pop(SlowJob.NAME, None)
+
+
+# --- p2p hop keeps the initiator's trace ----------------------------------
+
+
+class _PipeStream:
+    """Loopback stream: write() appends, read_exact() blocks."""
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._event = asyncio.Event()
+
+    async def write(self, data: bytes) -> None:
+        self._buf += data
+        self._event.set()
+
+    async def read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            self._event.clear()
+            await self._event.wait()
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+@pytest.mark.asyncio
+async def test_sync_header_carries_trace_and_responder_joins_it():
+    """Simulated p2p sync hop: the initiator's SYNC header carries its
+    trace context over the wire; the responder's spans (what
+    p2p/manager.py opens around ingest) record under the SAME
+    trace_id."""
+    import uuid
+
+    from spacedrive_tpu.p2p.protocol import Header, HeaderType
+
+    telemetry.reset()
+    initiator_ctx = trace.new_context()
+    pipe = _PipeStream()
+    with trace.use(initiator_ctx):
+        await Header(
+            HeaderType.SYNC, library_id=uuid.uuid4(),
+            trace=trace.wire_current(),
+        ).write(pipe)
+
+    # --- remote node ---
+    header = await Header.read(pipe)
+    wire_ctx = trace.TraceContext.from_wire(header.trace)
+    assert wire_ctx is not None
+    with trace.use(wire_ctx):
+        with telemetry.span("p2p.sync_notify") as sp:
+            pass
+    assert sp.trace_id == initiator_ctx.trace_id
+    assert sp.parent_id == initiator_ctx.span_id
+
+    # spacedrop headers carry it the same way
+    from spacedrive_tpu.p2p.block import (
+        BlockSize, SpaceblockRequest, SpaceblockRequests,
+    )
+
+    reqs = SpaceblockRequests(
+        id=uuid.uuid4(), block_size=BlockSize.from_file_size(10),
+        requests=[SpaceblockRequest(name="a", size=10)],
+    )
+    pipe2 = _PipeStream()
+    with trace.use(initiator_ctx):
+        await Header(
+            HeaderType.SPACEDROP, spacedrop=reqs,
+            trace=trace.wire_current(),
+        ).write(pipe2)
+    back = await Header.read(pipe2)
+    assert trace.TraceContext.from_wire(back.trace).trace_id \
+        == initiator_ctx.trace_id
+    # and headers without a context stay clean
+    pipe3 = _PipeStream()
+    await Header(HeaderType.SYNC, library_id=uuid.uuid4()).write(pipe3)
+    assert (await Header.read(pipe3)).trace is None
+
+
+@pytest.mark.asyncio
+async def test_ingest_actor_pull_runs_under_notifier_trace():
+    """The responder's ingest actor pull (notify → request_ops → apply)
+    reports into the initiating node's trace."""
+    import uuid
+
+    from spacedrive_tpu.sync.ingest import IngestActor
+    from spacedrive_tpu.sync.manager import SyncManager
+    from spacedrive_tpu.db import LibraryDb
+
+    telemetry.reset()
+    db = LibraryDb(":memory:")
+    sync = SyncManager(db, uuid.uuid4())
+    seen: list[str] = []
+
+    async def request_ops(timestamps, count):
+        ctx = trace.current()
+        seen.append(ctx.trace_id if ctx else None)
+        return [], False
+
+    actor = IngestActor(sync, request_ops, poll_interval=None)
+    initiator = trace.new_context()
+    actor.notify(trace_ctx=initiator)
+    await actor.wait_idle()
+    await actor.stop()
+    db.close()
+    assert seen == [initiator.trace_id]

@@ -1,0 +1,108 @@
+"""Crash-surface hooks (utils.tracing): background-thread and orphaned
+asyncio-task exceptions must reach the logging tree AND the flight
+recorder's error ring, not just stderr."""
+
+import asyncio
+import gc
+import logging
+import sys
+import threading
+
+from spacedrive_tpu.telemetry.events import ERROR_EVENTS
+from spacedrive_tpu.utils.tracing import (
+    install_excepthooks,
+    install_loop_excepthook,
+)
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def _with_panic_capture():
+    cap = _Capture()
+    logging.getLogger("panic").addHandler(cap)
+    return cap
+
+
+def _drop_panic_capture(cap):
+    logging.getLogger("panic").removeHandler(cap)
+
+
+def test_thread_excepthook_reaches_log_and_error_ring():
+    prev_sys, prev_thread = sys.excepthook, threading.excepthook
+    cap = _with_panic_capture()
+    try:
+        install_excepthooks()
+        before = len(ERROR_EVENTS.snapshot())
+
+        def boom():
+            raise RuntimeError("thread-crash-probe")
+
+        t = threading.Thread(target=boom, name="crash-probe")
+        t.start()
+        t.join()
+
+        assert any("crash-probe" in r.getMessage() for r in cap.records)
+        events = ERROR_EVENTS.snapshot()[before:]
+        assert any(
+            e["fields"]["source"] == "thread"
+            and e["fields"]["exc_type"] == "RuntimeError"
+            and "thread-crash-probe" in e["fields"]["message"]
+            and "boom" in e["fields"]["traceback"]
+            for e in events
+        ), events
+    finally:
+        _drop_panic_capture(cap)
+        sys.excepthook, threading.excepthook = prev_sys, prev_thread
+
+
+def test_loop_exception_handler_catches_orphaned_task():
+    cap = _with_panic_capture()
+    try:
+        async def main():
+            install_loop_excepthook(asyncio.get_running_loop())
+            before = len(ERROR_EVENTS.snapshot())
+
+            async def crash():
+                raise ValueError("orphan-task-probe")
+
+            task = asyncio.get_running_loop().create_task(crash())
+            await asyncio.sleep(0.01)
+            assert task.done()
+            # drop the only reference without retrieving the exception —
+            # the "exception was never retrieved" report goes through the
+            # loop handler at GC time
+            del task
+            gc.collect()
+            await asyncio.sleep(0.01)
+            return before
+
+        before = asyncio.run(main())
+        events = ERROR_EVENTS.snapshot()[before:]
+        assert any(
+            e["fields"]["source"] == "loop"
+            and e["fields"]["exc_type"] == "ValueError"
+            and "orphan-task-probe" in e["fields"]["message"]
+            for e in events
+        ), events
+    finally:
+        _drop_panic_capture(cap)
+
+
+def test_loop_handler_still_runs_default_handler(caplog):
+    """The installed handler must CHAIN to asyncio's default handler,
+    not swallow the report."""
+    async def main():
+        loop = asyncio.get_running_loop()
+        install_loop_excepthook(loop)
+        loop.call_exception_handler({"message": "chain-probe"})
+
+    with caplog.at_level(logging.ERROR, logger="asyncio"):
+        asyncio.run(main())
+    assert any("chain-probe" in r.getMessage() for r in caplog.records)

@@ -3,12 +3,22 @@
 SD007  label-cardinality hazards on metric record calls
 SD008  manually-opened resource (lock/span/file) not closed on the
        exception path
+SD009  event-ring emissions with non-constant event types / unauditable
+       field expansion
 
 SD007 keys off this repo's conventions: metric handles are ALL_CAPS
 module attributes (``metrics.SPAN_SECONDS``, ``THUMB_FILES``) and label
 values ride as keyword arguments to ``.inc()/.observe()/.set()``. The
 registry caps series per family as a backstop, but a capped-out family
 silently drops samples — better to catch the f-string at review time.
+
+SD009 extends the same discipline to the flight recorder
+(``telemetry.events``): ring handles are ``*_EVENTS`` constants (or
+``events.ring(...)`` results) and the event ``type`` is the first
+positional argument to ``.emit()``. Field *values* may be dynamic —
+rings are bounded — but a runtime-built ``type`` or a ``**`` field
+expansion makes the event vocabulary unauditable, so the debug bundle's
+consumers could never rely on it.
 """
 
 from __future__ import annotations
@@ -88,6 +98,79 @@ def check_label_cardinality(ctx: FileContext) -> Iterator[Finding]:
                     f"{node.func.attr}` — label domains must be small and "
                     f"fixed (enum-like), or baselined with a bound "
                     f"justification",
+                )
+
+
+# -- SD009 ------------------------------------------------------------------
+
+_EVENT_HANDLE_SUFFIX = "_EVENTS"
+
+
+def _is_event_ring_handle(expr: ast.AST) -> bool:
+    """``*_EVENTS`` ALL_CAPS constants (the events-module idiom), or a
+    direct ``ring("...")`` / ``events.ring("...")`` call result."""
+    name = dotted_name(expr)
+    if name is not None:
+        tail = name.rsplit(".", 1)[-1]
+        return tail.isupper() and tail.endswith(_EVENT_HANDLE_SUFFIX)
+    if isinstance(expr, ast.Call):
+        cname = call_name(expr)
+        return cname is not None and cname.rsplit(".", 1)[-1] == "ring"
+    return False
+
+
+@rule(
+    "SD009",
+    "event-ring-cardinality",
+    "event-ring emissions must use a constant event type and literal "
+    "field names — runtime-built types make the flight recorder's "
+    "vocabulary unauditable",
+)
+def check_event_ring_cardinality(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "emit"
+            and _is_event_ring_handle(node.func.value)
+        ):
+            continue
+        handle = dotted_name(node.func.value) or "ring(...)"
+        if not node.args:
+            yield ctx.finding(
+                "SD009",
+                node,
+                f"`{handle}.emit()` without a positional event type — "
+                f"pass a constant string first",
+            )
+        else:
+            first = node.args[0]
+            if isinstance(first, ast.Starred):
+                yield ctx.finding(
+                    "SD009",
+                    node,
+                    f"`*` argument expansion on `{handle}.emit` — the "
+                    f"event type must be a literal constant",
+                )
+            elif not (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+            ):
+                yield ctx.finding(
+                    "SD009",
+                    node,
+                    f"non-constant event type on `{handle}.emit` — event "
+                    f"vocabularies must be fixed at the call site "
+                    f"(dynamic data belongs in fields, not the type)",
+                )
+        for kw in node.keywords:
+            if kw.arg is None:
+                yield ctx.finding(
+                    "SD009",
+                    node,
+                    f"`**` field expansion on `{handle}.emit` — field "
+                    f"names must be literal keywords so ring consumers "
+                    f"can rely on the schema",
                 )
 
 
