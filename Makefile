@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: lint test tier1 trace-smoke debug-bundle
+.PHONY: lint test tier1 trace-smoke debug-bundle bench-devices
 
 lint:
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
@@ -13,6 +13,17 @@ test: tier1
 tier1:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# multi-device leg: forced-8-device parity smoke (the same test tier-1
+# runs) + the bench device-count sweep on the virtual host mesh. On a
+# real TPU host, drop the XLA_FLAGS/JAX_PLATFORMS overrides to sweep
+# the actual chips (docs/performance.md).
+bench-devices:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sharded_ops.py -q \
+		-p no:cacheprovider
+	env XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		JAX_PLATFORMS=cpu SD_BENCH_SWEEP=1 SD_BENCH_FILES=512 \
+		SD_BENCH_REPEATS=2 $(PY) bench.py
 
 # observability smoke: boot a node, index, assert /metrics + /trace +
 # debug bundle are live and secret-free

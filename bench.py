@@ -54,6 +54,106 @@ def median_spread(samples: list[float]) -> tuple[float, float, float]:
     return med, s[0], s[-1]
 
 
+def sweep_counts(n_devices: int) -> list[int]:
+    """1, 2, 4, … up to (and always including) the full device count."""
+    ks, k = [], 1
+    while k < n_devices:
+        ks.append(k)
+        k *= 2
+    ks.append(n_devices)
+    return ks
+
+
+def device_sweep(arr, lens, repeats: int, chain_k: int) -> list[dict]:
+    """Measure sharded cas_id hashing at 1→N devices (jax.devices()
+    subsets) on the SAME workload as the headline device-compute leg:
+    marginal cost of chained distinct-input dispatches, inputs
+    pre-placed with the dp sharding so the timed window is compute, not
+    transfer. Returns one record per device count for the BENCH JSON's
+    extras, with scaling efficiency relative to the 1-device number —
+    the executed version of the ×N projection the round-3 verdict
+    flagged as unmeasured."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spacedrive_tpu.ops import blake3_jax
+    from spacedrive_tpu.ops.cas import LARGE_CHUNKS
+
+    devs = jax.devices()
+    n = arr.shape[0]
+    records: list[dict] = []
+    base_fps = None
+    words = np.ascontiguousarray(arr).view(np.uint32)
+
+    # a tiny on-device mutation re-freshens every buffer between timed
+    # windows (same trick as the headline leg) so no timed dispatch
+    # ever re-hashes content the stack has seen — without re-paying
+    # the transfer; output sharding follows the input's
+    @jax.jit
+    def freshen(a, tag):
+        return a.at[:, 4].set(tag)
+
+    for k in sweep_counts(len(devs)):
+        if n % k:
+            log(f"sweep: skipping {k} devices ({n} rows do not divide)")
+            continue
+        subset = devs[:k]
+        bufs = []
+        for i in range(chain_k):
+            a = words.copy()
+            a[:, 0] = i + 1  # distinct content per chained dispatch
+            bufs.append(
+                blake3_jax.shard_put(a, subset) if k > 1
+                else jax.device_put(a, subset[0])
+            )
+        jax.block_until_ready(bufs[-1])
+
+        def refresh(rep: int) -> None:
+            for i in range(chain_k):
+                bufs[i] = freshen(
+                    bufs[i], np.uint32((rep * chain_k + i) % 251))
+            jax.block_until_ready(bufs[-1])
+
+        def chain(j: int) -> float:
+            t0 = time.perf_counter()
+            acc = None
+            for b in bufs[:j]:
+                w = blake3_jax.hash_batch(
+                    b, lens, max_chunks=LARGE_CHUNKS,
+                    devices=subset if k > 1 else None,
+                    donate_input=False,  # buffers are reused next repeat
+                )
+                s = jnp.sum(w)
+                acc = s if acc is None else acc + s
+            np.asarray(acc)
+            return time.perf_counter() - t0
+
+        chain(chain_k)  # warm/compile this device count
+        marginals = []
+        for rep in range(repeats):
+            refresh(2 * rep)
+            t1 = chain(1)
+            refresh(2 * rep + 1)
+            tk = chain(chain_k)
+            marginals.append(max(1e-9, (tk - t1) / (chain_k - 1)))
+        med, lo, hi = median_spread(marginals)
+        fps = n / med
+        if base_fps is None:
+            base_fps = fps
+        eff = fps / (base_fps * k)
+        records.append({
+            "devices": k,
+            "files_per_s": round(fps, 1),
+            "ms_per_batch": round(med * 1e3, 2),
+            "spread_ms": [round(lo * 1e3, 2), round(hi * 1e3, 2)],
+            "scaling_efficiency": round(eff, 3),
+        })
+        log(f"sweep {k} device(s): {med*1e3:.1f} ms/batch  "
+            f"{fps:,.0f} files/s  efficiency {eff:.2f}")
+    return records
+
+
 def main() -> None:
     from spacedrive_tpu import native, telemetry
     from spacedrive_tpu.ops import blake3_jax, configure_compilation_cache
@@ -170,6 +270,14 @@ def main() -> None:
     log(f"device compute (marginal, chained): {dev_s*1e3:.1f} ms/batch "
         f"[{dev_lo*1e3:.1f}–{dev_hi*1e3:.1f}]  {dev_fps:,.0f} files/s  {dev_gbps:.1f} GB/s")
 
+    # --- device-count sweep: the ×N leg, executed instead of projected.
+    # Runs whenever >1 device is visible (SD_BENCH_SWEEP=0 skips;
+    # SD_BENCH_SWEEP=1 forces, e.g. on a forced-host-platform CI mesh).
+    sweep_env = os.environ.get("SD_BENCH_SWEEP")
+    sweep_records: list[dict] = []
+    if sweep_env != "0" and (len(jax.devices()) > 1 or sweep_env == "1"):
+        sweep_records = device_sweep(arr, lens, repeats, chain_k)
+
     # --- e2e: host memory → device → digests, pipelined like production
     pipe_depth = 3
     e2e_reps = repeats
@@ -279,6 +387,9 @@ def main() -> None:
             "host_cores": host_cores,
             "roofline_clamped": not roofline_ok,
             "regression_note": regression_note,
+            # per-device-count throughput + scaling efficiency
+            # (device_sweep; [] on single-device rigs)
+            "device_sweep": sweep_records,
         },
     }
     print(json.dumps(out), flush=True)

@@ -37,10 +37,15 @@ from ...telemetry import profiler as _profiler
 logger = logging.getLogger(__name__)
 
 CHUNK_SIZE = 100            # ref:mod.rs:34 (CPU parity constant)
-DEVICE_CHUNK_SIZE = 1024    # device batches amortize dispatch overhead
+DEVICE_CHUNK_SIZE = 1024    # device batches amortize dispatch overhead,
+# PER accelerator: a v5e-8 window is 8192 rows dp-sharded so every chip
+# hashes a warm 1024-row shard from ONE dispatch (parallel/mesh
+# accelerator_count × this constant)
 PIPELINE_DEPTH = 3          # windows in flight: reads AND device
-# transfers for up to 3 windows overlap the current window's hash +
-# DB writes — see execute_step's WindowPipeline
+# transfers for up to PIPELINE_DEPTH windows overlap the current
+# window's hash + DB writes — see execute_step's WindowPipeline; grows
+# with the accelerator count (feeder.pipeline_depth) because sharded
+# windows drain n× faster
 
 
 def orphan_where_clause(sub_path_mat: str | None = None) -> str:
@@ -73,9 +78,13 @@ class FileIdentifierJob(StatefulJob):
             raise JobError(f"location {loc_id} not found")
 
         backend = self.init.get("backend", "auto")
-        chunk = self.init.get("chunk_size") or (
-            DEVICE_CHUNK_SIZE if backend in ("tpu", "device", "auto") else CHUNK_SIZE
-        )
+        if backend in ("tpu", "device", "auto"):
+            from ...parallel.mesh import accelerator_count
+
+            default_chunk = DEVICE_CHUNK_SIZE * accelerator_count()
+        else:
+            default_chunk = CHUNK_SIZE
+        chunk = self.init.get("chunk_size") or default_chunk
 
         params: list[Any] = [loc_id]
         where = orphan_where_clause(self.init.get("sub_path") and self.init["sub_path"])
@@ -168,7 +177,8 @@ class FileIdentifierJob(StatefulJob):
     async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
         import asyncio
 
-        from ...parallel import WindowPipeline
+        from ...parallel import WindowPipeline, pipeline_depth
+        from ...parallel.mesh import accelerator_count
 
         library = ctx.library
         d = self.data
@@ -194,7 +204,8 @@ class FileIdentifierJob(StatefulJob):
                 return rows[-1]["id"], window
 
             self._pipeline = WindowPipeline(
-                fetch, d["cursor"], depth=PIPELINE_DEPTH,
+                fetch, d["cursor"],
+                depth=pipeline_depth(accelerator_count(), base=PIPELINE_DEPTH),
                 # window[2] = the sampled messages riding the H2D link
                 measure=lambda w: sum(len(m) for m in w[2]),
             )

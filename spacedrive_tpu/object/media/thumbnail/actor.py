@@ -44,7 +44,7 @@ from .store import ThumbnailStore, get_shard_hex
 logger = logging.getLogger(__name__)
 
 GENERATION_TIMEOUT_S = 30  # ref:process.rs:172
-DEVICE_BATCH = 32  # images per device dispatch
+DEVICE_BATCH = 32  # images per device dispatch PER accelerator
 
 
 ThumbKey = tuple[str, str, str]  # (namespace, shard, cas_id)
@@ -81,6 +81,7 @@ class Thumbnailer:
         self._pending: collections.Counter[str] = collections.Counter()
         self._cond: asyncio.Condition | None = None
         self._wake: asyncio.Event | None = None
+        self._chunk_rows: int | None = None  # lazily scaled DEVICE_BATCH
         self._worker: asyncio.Task | None = None
         self._stopped = False
         self.generated = 0
@@ -320,11 +321,41 @@ class Thumbnailer:
         with _trace.use(_trace.TraceContext.from_wire(batch.trace)):
             await self._process_batch_traced(batch)
 
+    def _device_chunk(self) -> int:
+        """Images per device dispatch, scaled once per process by the
+        accelerator count: a dp-sharded resize splits the chunk over
+        every chip, so each still sees DEVICE_BATCH rows. CPU-only
+        hosts keep the parity constant (virtual devices share cores —
+        bigger host chunks would only add latency)."""
+        if self._chunk_rows is None:
+            n = 1
+            if self.use_device:
+                try:
+                    from ....parallel.mesh import accelerator_count
+
+                    n = accelerator_count()
+                except Exception:  # noqa: BLE001 - no usable jax
+                    n = 1
+            self._chunk_rows = DEVICE_BATCH * n
+        return self._chunk_rows
+
     async def _process_batch_traced(self, batch: Batch) -> None:
+        """Stage-overlapped chunk loop.
+
+        The per-chunk stages — host decode → device resize → host webp
+        encode + store — are independent across chunks, so they run as
+        a 3-deep software pipeline: while chunk N rides the device,
+        chunk N+1 is decoding on the thread pool and chunk N−1 is
+        encoding/storing. Encode tasks are chained (at most one
+        outstanding, awaited before the next starts), so entries are
+        consumed strictly in order — the persisted resume state only
+        ever drops a prefix whose thumbnails are already on disk.
+        """
         parallelism = (
             self._bg_parallelism if batch.background else self._fg_parallelism
         )
         sem = asyncio.Semaphore(parallelism)
+        chunk_rows = self._device_chunk()
 
         async def _decode(entry: tuple[str, str, str]) -> Decoded | None:
             cas_id, path, ext = entry
@@ -338,61 +369,150 @@ class Thumbnailer:
                     logger.debug("thumb decode failed %s: %s", path, e)
                     return None
 
-        while batch.entries and not self._stopped:
-            chunk = batch.entries[:DEVICE_BATCH]
-            _tm.THUMB_BATCH_FILL.observe(len(chunk) / DEVICE_BATCH)
+        async def _decode_chunk(chunk):
             async with span("thumbnail.decode") as decode_span:
                 decoded = await asyncio.gather(*(_decode(e) for e in chunk))
             _tm.THUMB_STAGE_SECONDS.observe(
                 decode_span.duration, stage="decode")
             _tm.PIPELINE_HOST_SECONDS.observe(
                 decode_span.duration, pipeline="thumbnail")
-            device_idx: list[int] = []
-            for i, d in enumerate(decoded):
+            return decoded
+
+        entries = list(batch.entries)
+        done = 0  # entries fully stored+accounted (a prefix of `entries`)
+
+        async def _encode_chunk(chunk, decoded, device_idx, ds, resized):
+            """Final stage for one chunk: webp-encode device outputs,
+            run host-path stragglers, store, account, and release the
+            chunk from the batch's persisted remainder."""
+            nonlocal done
+            for d in decoded:
                 if d is None:
                     self.errors += 1
                     _tm.THUMB_FILES.inc(result="error")
-                elif not self.use_device or needs_cpu_fallback(d):
-                    # host-path stragglers (extreme aspect / no device)
+            # host-path stragglers (extreme aspect / no device),
+            # concurrent now that they ride their own pipeline stage
+            fallback = [
+                i for i, d in enumerate(decoded)
+                if d is not None and i not in device_idx
+            ]
+
+            async def _one_fallback(i):
+                async with sem:  # same host-thread budget as decode
                     try:
                         webp = await asyncio.wait_for(
-                            asyncio.to_thread(resize_cpu, d),
+                            asyncio.to_thread(resize_cpu, decoded[i]),
                             timeout=GENERATION_TIMEOUT_S,
                         )
                         self._store_one(batch.library_id, chunk[i][0], webp)
                     except Exception:
                         self.errors += 1
                         _tm.THUMB_FILES.inc(result="error")
-                else:
-                    device_idx.append(i)
-            if device_idx:
-                ds = [decoded[i] for i in device_idx]
-                try:
-                    async with span(
-                        "thumbnail.device",
-                        nbytes=sum(d.array.nbytes for d in ds),
-                    ) as device_span:
-                        resized = await asyncio.to_thread(resize_decoded, ds)
-                        webps = await asyncio.gather(
-                            *(
-                                asyncio.to_thread(finish, d, r)
-                                for d, r in zip(ds, resized)
+
+            async def _one_finish(d, r):
+                async with sem:
+                    return await asyncio.to_thread(finish, d, r)
+
+            async with span("thumbnail.encode") as encode_span:
+                await asyncio.gather(*(_one_fallback(i) for i in fallback))
+                if device_idx:
+                    if resized is None:  # the device stage failed wholesale
+                        self.errors += len(device_idx)
+                        _tm.THUMB_FILES.inc(len(device_idx), result="error")
+                    else:
+                        try:
+                            webps = await asyncio.gather(
+                                *(
+                                    _one_finish(d, r)
+                                    for d, r in zip(ds, resized)
+                                )
                             )
-                        )
-                    _tm.THUMB_STAGE_SECONDS.observe(
-                        device_span.duration, stage="device")
-                    _tm.PIPELINE_DEVICE_SECONDS.observe(
-                        device_span.duration, pipeline="thumbnail")
-                    for i, webp in zip(device_idx, webps):
-                        self._store_one(batch.library_id, chunk[i][0], webp)
-                except Exception:
-                    logger.exception("device resize batch failed")
-                    self.errors += len(device_idx)
-                    _tm.THUMB_FILES.inc(len(device_idx), result="error")
-            # consume as we go: the crash/error accounting and the
-            # persisted resume state only ever see the remainder
-            batch.entries = batch.entries[len(chunk):]
+                            for i, webp in zip(device_idx, webps):
+                                self._store_one(
+                                    batch.library_id, chunk[i][0], webp)
+                        except Exception:
+                            logger.exception("thumbnail encode chunk failed")
+                            self.errors += len(device_idx)
+                            _tm.THUMB_FILES.inc(
+                                len(device_idx), result="error")
+            _tm.THUMB_STAGE_SECONDS.observe(
+                encode_span.duration, stage="encode")
+            _tm.PIPELINE_HOST_SECONDS.observe(
+                encode_span.duration, pipeline="thumbnail")
+            done += len(chunk)
+            # only now may the resume state drop this chunk
+            batch.entries = entries[done:]
             await self._account(batch, len(chunk))
+
+        pos = 0  # decode cursor
+        decode_task: asyncio.Task | None = None
+        encode_task: asyncio.Task | None = None
+        try:
+            while pos < len(entries) and not self._stopped:
+                chunk = entries[pos:pos + chunk_rows]
+                if decode_task is None:
+                    decode_task = asyncio.ensure_future(_decode_chunk(chunk))
+                decoded = await decode_task
+                decode_task = None
+                pos += len(chunk)
+                if pos < len(entries) and not self._stopped:
+                    # chunk N+1 decodes while chunk N rides the device
+                    decode_task = asyncio.ensure_future(
+                        _decode_chunk(entries[pos:pos + chunk_rows])
+                    )
+                _tm.THUMB_BATCH_FILL.observe(len(chunk) / chunk_rows)
+                device_idx = [
+                    i for i, d in enumerate(decoded)
+                    if d is not None and self.use_device
+                    and not needs_cpu_fallback(d)
+                ]
+                ds = [decoded[i] for i in device_idx]
+                resized = None
+                if ds:
+                    try:
+                        async with span(
+                            "thumbnail.device",
+                            nbytes=sum(d.array.nbytes for d in ds),
+                        ) as device_span:
+                            resized = await asyncio.to_thread(
+                                resize_decoded, ds)
+                        _tm.THUMB_STAGE_SECONDS.observe(
+                            device_span.duration, stage="device")
+                        _tm.PIPELINE_DEVICE_SECONDS.observe(
+                            device_span.duration, pipeline="thumbnail")
+                    except Exception:
+                        logger.exception("device resize batch failed")
+                        resized = None
+                if encode_task is not None:
+                    await encode_task  # chunk N−1 finishes storing first
+                encode_task = asyncio.ensure_future(
+                    _encode_chunk(
+                        chunk, decoded, device_idx, ds, resized)
+                )
+            if encode_task is not None:
+                await encode_task
+                encode_task = None
+        finally:
+            # cancel the read-ahead and retrieve it so no orphan warns;
+            # the trailing encode (started work) must complete so its
+            # thumbnails are stored before the remainder persists
+            if decode_task is not None:
+                decode_task.cancel()
+                try:
+                    await decode_task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            while encode_task is not None and not encode_task.done():
+                # started encode work MUST finish before the remainder
+                # persists (its chunk's entries are dropped by done+=),
+                # so keep re-awaiting across repeated cancellations —
+                # the shield keeps each cancel from reaching the encode
+                try:
+                    await asyncio.shield(encode_task)
+                except asyncio.CancelledError:
+                    continue
+                except Exception:  # noqa: BLE001 - logged in the task
+                    break
 
     def _store_one(self, library_id: str | None, cas_id: str, webp: bytes) -> None:
         self.store.write(library_id, cas_id, webp)

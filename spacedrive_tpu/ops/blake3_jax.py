@@ -26,6 +26,7 @@ bucket; whole small files (≤100 KiB ⇒ C≤101) and full-file validation
 from __future__ import annotations
 
 import functools
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -246,26 +247,31 @@ def _tree_reduce(cvs: jax.Array, n_chunks: jax.Array) -> jax.Array:
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("max_chunks",))
-def _hash_batch_impl(msgs: jax.Array, lengths: jax.Array, max_chunks: int) -> jax.Array:
-    cvs, n_chunks = _chunk_cvs(_as_words(msgs, max_chunks), lengths, max_chunks)
-    return _tree_reduce(cvs, n_chunks)
-
-
 # `_chunk_cvs` reads the chunk-stage backend from here at TRACE time;
 # one jitted wrapper per mode keeps the jit cache from pinning a failed
 # Pallas program onto the fallback path
 _pallas_mode_static: dict = {"mode": None}
 
 
+def _traced_hash_body(mode: str | None, msgs, lengths, max_chunks: int):
+    """Chunk stage + tree reduce with the pallas-mode switch applied at
+    trace time — the ONE hash body both the single-device and the
+    shard_map per-device programs trace. (A second copy here is how the
+    two paths would silently stop being bit-identical.)"""
+    _pallas_mode_static["mode"] = mode  # runs at trace time
+    try:
+        cvs, n_chunks = _chunk_cvs(
+            _as_words(msgs, max_chunks), lengths, max_chunks
+        )
+        return _tree_reduce(cvs, n_chunks)
+    finally:
+        _pallas_mode_static["mode"] = None
+
+
 def _make_mode_impl(mode: str | None):
     @functools.partial(jax.jit, static_argnames=("max_chunks",))
     def impl(msgs, lengths, max_chunks):
-        _pallas_mode_static["mode"] = mode  # runs at trace time
-        try:
-            return _hash_batch_impl(msgs, lengths, max_chunks)
-        finally:
-            _pallas_mode_static["mode"] = None
+        return _traced_hash_body(mode, msgs, lengths, max_chunks)
 
     return impl
 
@@ -285,7 +291,102 @@ def _resolve_pallas_mode() -> str | None:
     return blake3_pallas.pallas_mode()
 
 
-def hash_batch(msgs, lengths, max_chunks: int | None = None) -> jax.Array:
+# --- multi-device dp dispatch ----------------------------------------------
+#
+# One dispatch feeds every chip: the batch dim is split over a flat
+# `dp` mesh, each device runs the SAME chunk-stage (Pallas on TPU, XLA
+# elsewhere) + tree reduce on its local rows under `shard_map` — the
+# hash of a row never needs another row, so there are no collectives
+# and per-device math is bit-identical to the single-device path.
+# Compiled programs cache per (pallas mode, device set); shapes stay on
+# the per-device warm ladder because cas.pack_canonical_batch pads the
+# global batch to ladder-rung × device-count.
+
+_sharded_impls: dict[tuple, Any] = {}
+
+
+def _dp_mesh(devices):
+    import numpy as np
+
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(list(devices)), ("dp",))
+
+
+def _sharded_impl(mode: str | None, devices, donate_input: bool = True):
+    key = (mode, tuple(d.id for d in devices), donate_input)
+    impl = _sharded_impls.get(key)
+    if impl is None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _dp_mesh(devices)
+        # donation frees the (large) message buffer for reuse the
+        # moment the transfer is consumed; CPU backends don't implement
+        # it and would only warn. Callers that re-hash a placed buffer
+        # (bench's chained sweep) opt out.
+        donate = (
+            (0,) if donate_input and devices[0].platform != "cpu" else ()
+        )
+
+        @functools.partial(
+            jax.jit, static_argnames=("max_chunks",), donate_argnums=donate
+        )
+        def impl(msgs, lengths, max_chunks):
+            def body(m, l):
+                return _traced_hash_body(mode, m, l, max_chunks)
+
+            return shard_map(
+                body, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P("dp")
+            )(msgs, lengths)
+
+        _sharded_impls[key] = impl
+    return impl
+
+
+def shard_put(arr, devices):
+    """Place a batch on the flat `dp` mesh over `devices` (dim 0
+    split, trailing dims replicated). A no-op when the array already
+    has that sharding — bench pre-places its chained inputs through
+    here so timed dispatches measure compute, not transfer."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(arr, NamedSharding(_dp_mesh(devices), P("dp")))
+
+
+def _hash_batch_sharded(
+    msgs, lengths, max_chunks: int, devices, donate_input: bool = True
+) -> jax.Array:
+    from ..telemetry import metrics as _tm
+
+    _tm.SHARD_BATCH_ROWS.observe(msgs.shape[0] // len(devices), op="blake3")
+    placed = shard_put(msgs, devices)
+    placed_lens = shard_put(lengths, devices)
+    mode = _resolve_pallas_mode()
+    if mode is not None:
+        try:
+            return _sharded_impl(mode, devices, donate_input)(
+                placed, placed_lens, max_chunks=max_chunks
+            )
+        except Exception:  # Mosaic/compile/runtime failure → XLA path
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "pallas blake3 failed; falling back to XLA permanently"
+            )
+            _pallas_disabled[0] = True
+            # a runtime failure can land AFTER the placed buffer was
+            # donated (deleted) to the failed program — re-place from
+            # the caller's host array so the XLA retry runs in place
+            placed = shard_put(msgs, devices)
+            placed_lens = shard_put(lengths, devices)
+    return _sharded_impl(None, devices, donate_input)(
+        placed, placed_lens, max_chunks=max_chunks
+    )
+
+
+def hash_batch(msgs, lengths, max_chunks: int | None = None,
+               devices=None, donate_input: bool = True) -> jax.Array:
     """Hash B messages. msgs: uint8[B, C*1024] (zero-padded) or its
     uint32[B, C*256] LE-word view; lengths: int32[B] actual byte
     counts. Returns uint32[B, 8] — the first 32 digest bytes as LE
@@ -295,7 +396,12 @@ def hash_batch(msgs, lengths, max_chunks: int | None = None) -> jax.Array:
     the byte-pack pass entirely; see PROFILE.md). The chunk stage runs
     as a Pallas kernel on real TPUs (ops/blake3_pallas.py), XLA
     otherwise; any Pallas failure permanently falls back to the XLA
-    path."""
+    path.
+
+    `devices`: ≥2 devices shard the batch dim over a flat `dp` mesh
+    (one dispatch feeds every chip; B must divide evenly — callers pad
+    through cas.pack_canonical_batch). None/1 device keeps the classic
+    single-device dispatch byte-for-byte."""
     import numpy as np
 
     if not hasattr(msgs, "dtype"):  # lists / bytes-likes
@@ -308,6 +414,16 @@ def hash_batch(msgs, lengths, max_chunks: int | None = None) -> jax.Array:
         words_per_chunk = 256 if msgs.dtype == jnp.uint32 else CHUNK_LEN
         max_chunks = msgs.shape[1] // words_per_chunk
     lengths = jnp.asarray(lengths, jnp.int32)
+    if devices is not None and len(devices) > 1:
+        devices = list(devices)
+        if msgs.shape[0] % len(devices):
+            raise ValueError(
+                f"batch of {msgs.shape[0]} rows does not divide over "
+                f"{len(devices)} devices — pad through pack_canonical_batch"
+            )
+        return _hash_batch_sharded(
+            msgs, lengths, max_chunks, devices, donate_input
+        )
     mode = _resolve_pallas_mode()
     if mode is not None:
         try:

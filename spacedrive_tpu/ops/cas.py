@@ -86,31 +86,56 @@ def cas_id_from_bytes_cpu(content: bytes) -> str:
     return StreamingBlake3().update(message_from_bytes(content)).hexdigest()[:16]
 
 
-DEVICE_BATCH = 1024  # max rows per dispatch (see cas_ids_begin)
+DEVICE_BATCH = 1024  # max rows per dispatch PER DEVICE (see cas_ids_begin)
 # the tail ladder: at most 3 compiled programs per bucket, and a
 # 5-file tail pads to 32 rows, not 1024
 BATCH_LADDER = (32, 256, DEVICE_BATCH)
 
 
+def batch_ladder(n_devices: int = 1) -> tuple[int, ...]:
+    """Global pad ladder for an n-device dp dispatch: every rung is the
+    per-device warm rung × device count, so each chip always sees one
+    of the SAME three compiled shapes (32/256/1024 rows) regardless of
+    how many chips share the batch — tracing cost stays bounded at 3
+    programs per (bucket, device count)."""
+    n = max(1, n_devices)
+    return BATCH_LADDER if n == 1 else tuple(r * n for r in BATCH_LADDER)
+
+
+def device_batch(n_devices: int = 1) -> int:
+    """Max rows per dispatch: DEVICE_BATCH per participating device."""
+    return DEVICE_BATCH * max(1, n_devices)
+
+
 def pack_canonical_batch(
-    messages: Sequence[bytes], max_chunks: int
+    messages: Sequence[bytes], max_chunks: int, n_devices: int = 1
 ) -> tuple[np.ndarray, np.ndarray]:
-    """The ONE batch-shape policy for device hashing: ≤DEVICE_BATCH
+    """The ONE batch-shape policy for device hashing: ≤device_batch(n)
     messages pack into a `(ladder_size, max_chunks*1024)` uint8 array +
-    int32 lengths. A fresh XLA shape costs seconds of tracing +
-    executable load (worse on a tunneled chip) while a warm shape runs
-    in ~40 ms, so every caller (cas_ids_begin, the validator) MUST pack
-    through here. Pad rows hash 1 junk byte and get sliced off by the
-    caller."""
+    int32 lengths, the ladder scaled by `n_devices` (batch_ladder) so a
+    dp-sharded dispatch divides evenly with warm per-device shapes. A
+    fresh XLA shape costs seconds of tracing + executable load (worse
+    on a tunneled chip) while a warm shape runs in ~40 ms, so every
+    caller (cas_ids_begin, the validator) MUST pack through here. Pad
+    rows hash 1 junk byte and get sliced off by the caller.
+
+    The array starts uninitialized (np.empty) and each row writes its
+    message + explicit zero tail — one pass over the buffer instead of
+    a full zero-fill followed by prefix overwrites (the zero-fill was
+    ~half the pack time at the 57 MB hot-bucket batch size)."""
     n = len(messages)
-    if n > DEVICE_BATCH:
-        raise ValueError(f"pack at most {DEVICE_BATCH} messages, got {n}")
-    n_pad = next(s for s in BATCH_LADDER if s >= n)
-    arr = np.zeros((n_pad, max_chunks * 1024), np.uint8)
+    cap = device_batch(n_devices)
+    if n > cap:
+        raise ValueError(f"pack at most {cap} messages, got {n}")
+    n_pad = next(s for s in batch_ladder(n_devices) if s >= n)
+    arr = np.empty((n_pad, max_chunks * 1024), np.uint8)
     lens = np.ones((n_pad,), np.int32)
     for j, msg in enumerate(messages):
-        arr[j, : len(msg)] = np.frombuffer(msg, np.uint8)
-        lens[j] = len(msg)
+        ln = len(msg)
+        arr[j, :ln] = np.frombuffer(msg, np.uint8)
+        arr[j, ln:] = 0
+        lens[j] = ln
+    arr[n:] = 0  # pad rows (length 1) must hash a zero byte
     return arr, lens
 
 
@@ -129,13 +154,42 @@ class _Bucket:
     messages: list[bytes]
 
 
-def cas_ids_begin(messages: Sequence[bytes]) -> Callable[[], list[str]]:
+def shard_occupancy(n_real: int, n_pad: int, n_dev: int) -> list[float]:
+    """Per-device real-row fraction of one sharded dispatch (device d
+    owns rows [d*r, (d+1)*r) of the contiguously packed batch) — the
+    caller observes these under its own literal `op` label."""
+    r = n_pad // n_dev
+    return [
+        min(max(n_real - d * r, 0), r) / r for d in range(n_dev)
+    ]
+
+
+def cas_ids_begin(
+    messages: Sequence[bytes], devices: Sequence[Any] | None = None
+) -> Callable[[], list[str]]:
     """Dispatch device hashing WITHOUT blocking: batches go to the
     accelerator asynchronously (JAX dispatch) and the returned finisher
     materializes the hex ids. Splitting dispatch from completion lets a
     pipeline queue window N+1's transfer while N is still in flight —
     on a tunneled chip that hides most of the per-call latency
-    (SURVEY §7 hard part #2)."""
+    (SURVEY §7 hard part #2).
+
+    With >1 local device each batch is dp-sharded so ONE dispatch feeds
+    every chip (blake3_jax.hash_batch devices=...). Explicitly passed
+    `devices` always shard; the default policy shards a batch only when
+    it fills at least half of the smallest sharded ladder rung
+    (BATCH_LADDER[0] × n_devices ÷ 2) — tiny tails stay on one device
+    where their warm 32-row shape is cheapest."""
+    if devices is not None:
+        devs = list(devices)
+        explicit = True
+    else:
+        from ..parallel.mesh import dispatch_devices
+
+        devs = dispatch_devices()
+        explicit = False
+    n_dev = len(devs)
+
     buckets: dict[int, _Bucket] = {}
     for i, msg in enumerate(messages):
         c = LARGE_CHUNKS if len(msg) == LARGE_MSG_LEN else _bucket_for(len(msg))
@@ -143,19 +197,38 @@ def cas_ids_begin(messages: Sequence[bytes]) -> Callable[[], list[str]]:
         b.indices.append(i)
         b.messages.append(msg)
 
+    step = device_batch(n_dev)
     in_flight: list[tuple[_Bucket, int, Any]] = []
     for c, bucket in sorted(buckets.items()):
-        for off in range(0, len(bucket.messages), DEVICE_BATCH):
-            part = bucket.messages[off : off + DEVICE_BATCH]
-            arr, lens = pack_canonical_batch(part, c)
+        for off in range(0, len(bucket.messages), step):
+            part = bucket.messages[off : off + step]
+            # shard-declined parts MUST fit the single-device pack cap:
+            # with step = DEVICE_BATCH × n_dev a part can exceed
+            # DEVICE_BATCH, so anything over the cap shards regardless
+            # of the occupancy heuristic (only reachable at >64 devices)
+            shard = n_dev > 1 and (
+                explicit
+                or len(part) * 2 >= n_dev * BATCH_LADDER[0]
+                or len(part) > DEVICE_BATCH
+            )
+            arr, lens = pack_canonical_batch(
+                part, c, n_devices=n_dev if shard else 1
+            )
+            if shard:
+                from ..telemetry import metrics as _tm
+
+                for frac in shard_occupancy(len(part), arr.shape[0], n_dev):
+                    _tm.DEVICE_DISPATCH_OCCUPANCY.observe(frac, op="blake3")
             in_flight.append(
-                (bucket, off, blake3_jax.hash_batch(arr, lens, max_chunks=c))
+                (bucket, off, blake3_jax.hash_batch(
+                    arr, lens, max_chunks=c, devices=devs if shard else None
+                ))
             )
 
     def finish() -> list[str]:
         out: list[str | None] = [None] * len(messages)
         for bucket, off, words in in_flight:
-            part = bucket.indices[off : off + DEVICE_BATCH]
+            part = bucket.indices[off : off + step]
             for j, hx in enumerate(blake3_jax.words_to_hex(words, 16)[: len(part)]):
                 out[part[j]] = hx
         return out  # type: ignore[return-value]
@@ -209,8 +282,15 @@ def cas_ids(messages: Sequence[bytes], backend: str = "auto") -> list[str]:
     if _device_available():
         try:
             return cas_ids_batched(messages)
-        except Exception:  # noqa: BLE001 - fall back to host hashing
-            pass
+        except Exception as exc:  # noqa: BLE001 - fall back to host hashing
+            # the degradation must be observable, not silent: count it
+            # and put the bounded traceback on the flight recorder so a
+            # node quietly hashing on CPU shows up in the debug bundle
+            from ..telemetry import events as _events
+            from ..telemetry import metrics as _tm
+
+            _tm.CAS_BACKEND_FALLBACK.inc()
+            _events.record_error("cas.auto", exc)
     return cas_ids(messages, "cpu")
 
 

@@ -87,11 +87,31 @@ def bucket_for(h: int, w: int) -> tuple[int, int] | None:
     return (b, b)
 
 
+def _one_resize(out_size: int):
+    """Per-image resize body shared by the single-device and sharded
+    bucket programs (identical math ⇒ identical pixels either way)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(img, scale):
+        out = jax.image.scale_and_translate(
+            img.astype(jnp.float32),
+            shape=(out_size, out_size, 4),
+            spatial_dims=(0, 1),
+            scale=scale,
+            translation=jnp.zeros((2,), jnp.float32),
+            method="triangle",
+            antialias=True,
+        )
+        return jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+
+    return one
+
+
 @functools.cache
 def _resize_fn():
     """Lazily built jitted bucket-resize (jax imported on first use)."""
     import jax
-    import jax.numpy as jnp
 
     @functools.partial(jax.jit, static_argnames=("out_size",))
     def resize_bucket(canvases, scales, out_size: int):
@@ -101,27 +121,61 @@ def _resize_fn():
         # corner. One compiled program per (bucket, out) pair; the
         # per-image scale is a traced operand, so every (h, w) in the
         # bucket reuses it.
-        def one(img, scale):
-            out = jax.image.scale_and_translate(
-                img.astype(jnp.float32),
-                shape=(out_size, out_size, 4),
-                spatial_dims=(0, 1),
-                scale=scale,
-                translation=jnp.zeros((2,), jnp.float32),
-                method="triangle",
-                antialias=True,
-            )
-            return jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
-
-        return jax.vmap(one)(canvases, scales)
+        return jax.vmap(_one_resize(out_size))(canvases, scales)
 
     return resize_bucket
+
+
+_sharded_resize_fns: dict[tuple, object] = {}
+
+
+def _resize_fn_sharded(devices):
+    """dp-sharded bucket resize: the batch dim splits over a flat mesh,
+    every device running the same vmapped per-image program on its
+    local rows under shard_map — no collectives, so pixels stay
+    bit-identical to the single-device call. One compiled program per
+    (device set, bucket, out) like the single-device cache."""
+    key = tuple(d.id for d in devices)
+    fn = _sharded_resize_fns.get(key)
+    if fn is None:
+        import jax
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        import numpy as _np
+
+        mesh = Mesh(_np.array(list(devices)), ("dp",))
+
+        @functools.partial(jax.jit, static_argnames=("out_size",))
+        def resize_bucket_sharded(canvases, scales, out_size: int):
+            def body(c, s):
+                return jax.vmap(_one_resize(out_size))(c, s)
+
+            return shard_map(
+                body, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P("dp")
+            )(canvases, scales)
+
+        fn = (mesh, resize_bucket_sharded)
+        _sharded_resize_fns[key] = fn
+    return fn
+
+
+def _auto_devices(n_rows: int):
+    """Default sharding policy: all local devices once every device can
+    hold at least one real image; smaller groups stay single-device
+    (padding whole 4 MB canvases to feed idle chips is a net loss)."""
+    from ..parallel.mesh import dispatch_devices
+
+    devs = dispatch_devices()
+    return devs if len(devs) > 1 and n_rows >= len(devs) else None
 
 
 def resize_batch(
     images: Sequence[np.ndarray],
     targets: Sequence[tuple[int, int]],
     out_size: int = OUT_CANVAS,
+    devices: Sequence | None = None,
 ) -> list[np.ndarray]:
     """Resize a batch of HxWx4 uint8 RGBA images to per-image (th, tw).
 
@@ -129,6 +183,10 @@ def resize_batch(
     call per bucket, crops on host. Returns resized uint8 arrays in
     input order. Images too large for any bucket or with th/tw beyond
     the output canvas must be filtered by the caller beforehand.
+
+    With >1 local device (or an explicit `devices` list) the batch dim
+    of each bucket call dp-shards over the chip mesh — one dispatch,
+    every chip resizing its slice of the canvases.
     """
     results: list[np.ndarray | None] = [None] * len(images)
     by_bucket: dict[tuple[int, int], list[int]] = {}
@@ -144,10 +202,16 @@ def resize_batch(
         by_bucket.setdefault(b, []).append(i)
 
     for (bh, bw), idxs in by_bucket.items():
+        devs = list(devices) if devices is not None else _auto_devices(len(idxs))
+        n_dev = len(devs) if devs else 1
         # Pad the batch dim to the next power of two so compile count is
         # bounded at (buckets × log2 max-batch) programs, not one per
-        # arbitrary group size.
+        # arbitrary group size; a sharded call also rounds up to the
+        # device count so rows divide evenly over the mesh.
         bpad = 1 << max(0, (len(idxs) - 1).bit_length())
+        if n_dev > 1:
+            bpad = max(bpad, n_dev)
+            bpad += (-bpad) % n_dev
         canv = np.zeros((bpad, bh, bw, 4), np.uint8)
         scales = np.ones((bpad, 2), np.float32)
         for j, i in enumerate(idxs):
@@ -165,7 +229,25 @@ def resize_batch(
             canv[j, :h, w:] = img[:, w - 1 : w]
             canv[j, h:, w:] = img[h - 1, w - 1]
             scales[j] = (th / h, tw / w)
-        out = np.asarray(_resize_fn()(canv, scales, out_size=out_size))
+        if n_dev > 1:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..telemetry import metrics as _tm
+            from .cas import shard_occupancy
+
+            mesh, fn = _resize_fn_sharded(devs)
+            _tm.SHARD_BATCH_ROWS.observe(bpad // n_dev, op="thumbnail")
+            for frac in shard_occupancy(len(idxs), bpad, n_dev):
+                _tm.DEVICE_DISPATCH_OCCUPANCY.observe(frac, op="thumbnail")
+            sh = NamedSharding(mesh, P("dp"))
+            out = np.asarray(fn(
+                jax.device_put(canv, sh),
+                jax.device_put(scales, sh),
+                out_size=out_size,
+            ))
+        else:
+            out = np.asarray(_resize_fn()(canv, scales, out_size=out_size))
         for j, i in enumerate(idxs):
             th, tw = targets[i]
             if flip[i]:

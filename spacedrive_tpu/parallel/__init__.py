@@ -5,10 +5,12 @@ maps to batch-parallel device meshes here; §7 hard part #2 — the
 host-side read pipeline that keeps the device fed.
 """
 
-from .feeder import PipelineStats, WindowPipeline
+from .feeder import PipelineStats, WindowPipeline, pipeline_depth
 from .mesh import (
     AXES,
+    accelerator_count,
     batch_sharding,
+    dispatch_devices,
     factor3,
     flat_mesh,
     make_mesh,
@@ -21,11 +23,14 @@ __all__ = [
     "AXES",
     "PipelineStats",
     "WindowPipeline",
+    "accelerator_count",
     "batch_sharding",
+    "dispatch_devices",
     "factor3",
     "flat_mesh",
     "make_mesh",
     "multihost_init",
     "pad_to_multiple",
+    "pipeline_depth",
     "replicated",
 ]

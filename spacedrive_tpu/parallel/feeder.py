@@ -18,7 +18,7 @@ ref:indexer/indexer_job.rs:76-88).
 
 from __future__ import annotations
 
-import queue as _queue
+import collections
 import threading
 import time
 from dataclasses import dataclass, field
@@ -29,6 +29,15 @@ from ..telemetry import span as _span
 from ..telemetry import trace as _trace
 
 T = TypeVar("T")
+
+
+def pipeline_depth(n_devices: int, base: int = 3, cap: int = 8) -> int:
+    """Prefetch depth that keeps an n-device dp dispatch fed: one extra
+    in-flight window per doubling of the chip count (each window drains
+    n× faster, so the producer needs more read-ahead to hide the same
+    disk latency), capped so host memory stays bounded. 1→3, 2→4,
+    4→5, 8→6."""
+    return min(cap, base + max(0, int(n_devices).bit_length() - 1))
 
 
 @dataclass
@@ -64,7 +73,15 @@ class WindowPipeline(Generic[T]):
         # be diagnosed from print lines
         self._measure = measure
         self.stats = PipelineStats()
-        self._queue: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
+        # unbounded deque + condition (NOT a bounded Queue): close()
+        # must wake a blocked consumer IMMEDIATELY. A bounded queue
+        # could be full when close() tried to enqueue its wake-up
+        # sentinel, leaving take() to discover shutdown only via a
+        # 0.1 s poll; here `depth` only throttles the producer, and
+        # close() just flips the flag under the condition and notifies.
+        self._buf: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._depth = max(1, depth)
         self._stop = threading.Event()
         self._done = False
         self._fetch = fetch
@@ -101,20 +118,30 @@ class WindowPipeline(Generic[T]):
                         pass
                 if not self._put(window):
                     return
-                _tm.FEEDER_INFLIGHT.set(self._queue.qsize())
         except BaseException as e:  # surfaced to the consumer on take()
             self._error = e
             self._put(None)
 
     def _put(self, item) -> bool:
-        """Queue.put that aborts promptly when close() is called."""
-        while not self._stop.is_set():
-            try:
-                self._queue.put(item, timeout=0.1)
-                return True
-            except _queue.Full:
-                continue
-        return False
+        """Park one window (or the end-of-stream sentinel) for the
+        consumer; blocks while `depth` windows are already parked and
+        aborts promptly when close() is called. The sentinel never
+        blocks — the deque is unbounded, depth only throttles real
+        windows, so end-of-stream (and a producer error) reaches the
+        consumer even when the buffer is full."""
+        with self._cond:
+            while (
+                item is not None
+                and len(self._buf) >= self._depth
+                and not self._stop.is_set()
+            ):
+                self._cond.wait()
+            if self._stop.is_set():
+                return False
+            self._buf.append(item)
+            _tm.FEEDER_INFLIGHT.set(len(self._buf))
+            self._cond.notify_all()
+            return True
 
     def take(self) -> T | None:
         """Next window in order; None at end of stream (raises if the
@@ -131,17 +158,15 @@ class WindowPipeline(Generic[T]):
             return None
         t0 = time.perf_counter()
         with _span("feeder.wait"):
-            while True:
-                try:
-                    window = self._queue.get(timeout=0.1)
-                    break
-                except _queue.Empty:
-                    # close() may race a full queue (its sentinel is
-                    # dropped on Full); poll the stop flag so a drained
-                    # consumer can't block forever on a dead producer
-                    if self._stop.is_set():
-                        window = None
-                        break
+            with self._cond:
+                while not self._buf and not self._stop.is_set():
+                    self._cond.wait()
+                if self._buf:
+                    window = self._buf.popleft()
+                    self._cond.notify_all()  # free the producer's slot
+                else:  # closed: wake immediately, no sentinel needed
+                    window = None
+                inflight = len(self._buf)
         waited = time.perf_counter() - t0
         hit = waited < 0.002
         with self.stats._lock:
@@ -151,7 +176,7 @@ class WindowPipeline(Generic[T]):
                 self.stats.prefetch_misses += 1
         _tm.FEEDER_WAIT_SECONDS.observe(waited)
         _tm.FEEDER_PREFETCH.inc(result="hit" if hit else "miss")
-        _tm.FEEDER_INFLIGHT.set(self._queue.qsize())
+        _tm.FEEDER_INFLIGHT.set(inflight)
         if window is None:
             self._done = True
             if self._error is not None:
@@ -159,10 +184,9 @@ class WindowPipeline(Generic[T]):
         return window
 
     def close(self) -> None:
-        self._stop.set()
-        # unblock a consumer waiting in take()
-        try:
-            self._queue.put_nowait(None)
-        except _queue.Full:
-            pass
+        with self._cond:
+            self._stop.set()
+            # one notify wakes BOTH sides instantly: a producer blocked
+            # on a full buffer and a consumer blocked on an empty one
+            self._cond.notify_all()
         self._thread.join(timeout=5)

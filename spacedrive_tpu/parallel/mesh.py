@@ -81,6 +81,45 @@ def pad_to_multiple(arr: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
     return arr, pad
 
 
+_ACCEL_COUNT: list[int] | None = None
+
+
+def accelerator_count() -> int:
+    """Local non-CPU device count, 1 when only CPU (or no jax) is live.
+
+    The batch/depth scale factor for dp dispatch: the identifier's
+    chunk size, the thumbnailer's device chunk, and the feeder depth
+    all multiply by this so one host window feeds the whole mesh.
+    Virtual host-platform devices deliberately do NOT count — they
+    share the same cores, so scaling host batches by them only makes
+    batches slower."""
+    global _ACCEL_COUNT
+    if _ACCEL_COUNT is None:
+        try:
+            import jax
+
+            devs = jax.devices()
+            _ACCEL_COUNT = [
+                len(devs) if devs and devs[0].platform != "cpu" else 1
+            ]
+        except Exception:  # noqa: BLE001 - no usable accelerator
+            _ACCEL_COUNT = [1]
+    return _ACCEL_COUNT[0]
+
+
+def dispatch_devices() -> list:
+    """All local JAX devices for dp-sharded dispatch ([] when jax is
+    unusable). Unlike `accelerator_count`, virtual CPU devices DO
+    appear here — sharding is a correctness surface the test suite
+    exercises on the forced host platform."""
+    try:
+        import jax
+
+        return list(jax.devices())
+    except Exception:  # noqa: BLE001
+        return []
+
+
 def multihost_init(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

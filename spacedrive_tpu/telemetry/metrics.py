@@ -89,13 +89,15 @@ THUMB_FILES = REGISTRY.counter(
 )
 THUMB_BATCH_FILL = REGISTRY.histogram(
     "sd_thumbnail_batch_fill_ratio",
-    "images in a device chunk relative to DEVICE_BATCH",
+    "images in a device chunk relative to the device-count-scaled "
+    "chunk size (DEVICE_BATCH × accelerator_count)",
     buckets=RATIO_BUCKETS,
 )
 THUMB_STAGE_SECONDS = REGISTRY.histogram(
     "sd_thumbnail_stage_seconds",
-    "per-chunk time split: host decode vs device resize+encode",
-    labels=("stage",),  # decode | device
+    "per-chunk time split across the pipelined stages: host decode, "
+    "device resize, host webp encode+store",
+    labels=("stage",),  # decode | device | encode
 )
 
 # --- udp stream (p2p/udpstream.py) ------------------------------------------
@@ -143,6 +145,30 @@ BENCH_E2E_BATCH_SECONDS = REGISTRY.histogram(
     "sd_bench_e2e_batch_seconds",
     "end-to-end host→device→digest time per batch (bench.py)",
     recent_samples=4096,
+)
+
+# --- multi-device dp dispatch (ops/blake3_jax.py + ops/thumbnail_jax.py) ----
+
+# rows-per-device of a sharded dispatch: powers of two covering the
+# batch ladder (32..1024 per device) with headroom for bigger rungs
+ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+SHARD_BATCH_ROWS = REGISTRY.histogram(
+    "sd_device_shard_batch_rows",
+    "rows each device receives in a dp-sharded dispatch",
+    labels=("op",),  # blake3 | thumbnail
+    buckets=ROW_BUCKETS,
+)
+DEVICE_DISPATCH_OCCUPANCY = REGISTRY.histogram(
+    "sd_device_dispatch_occupancy",
+    "fraction of a device's shard rows holding real (non-pad) work, "
+    "one observation per device per sharded dispatch",
+    labels=("op",),  # blake3 | thumbnail
+    buckets=RATIO_BUCKETS,
+)
+CAS_BACKEND_FALLBACK = REGISTRY.counter(
+    "sd_cas_backend_fallback_total",
+    "cas_ids('auto') device failures that degraded to the host backend",
 )
 
 # --- pipeline device/host split (identify + thumbnail drivers) --------------

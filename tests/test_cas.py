@@ -68,6 +68,41 @@ def test_batched_device_cas_full_ladder():
     assert got == want
 
 
+def test_auto_backend_fallback_is_counted_and_recorded(monkeypatch):
+    """ISSUE 4 satellite: cas_ids('auto') used to swallow every device
+    exception silently before degrading to CPU. The degradation must
+    bump sd_cas_backend_fallback_total and land the bounded traceback
+    on the flight recorder's error ring."""
+    from spacedrive_tpu import telemetry
+    from spacedrive_tpu.telemetry import events as tev
+
+    monkeypatch.setattr(cas, "_DEVICE_STATE", [True])
+
+    def boom(messages):
+        raise RuntimeError("chip fell over mid-dispatch")
+
+    monkeypatch.setattr(cas, "cas_ids_batched", boom)
+    before = telemetry.counter_value("sd_cas_backend_fallback_total")
+    content = _content(300)
+    got = cas.cas_ids([cas.message_from_bytes(content)], "auto")
+    # degraded result is still correct (host hashing)
+    assert got == [cas.cas_id_from_bytes_cpu(content)]
+    assert telemetry.counter_value("sd_cas_backend_fallback_total") == before + 1
+    errors = tev.ring("errors").snapshot()
+    mine = [
+        e for e in errors
+        if e["type"] == "exception" and e["fields"].get("source") == "cas.auto"
+    ]
+    assert mine, f"no cas.auto event on the error ring: {errors[-3:]}"
+    assert "chip fell over mid-dispatch" in mine[-1]["fields"]["traceback"]
+    assert mine[-1]["fields"]["exc_type"] == "RuntimeError"
+
+    # explicit "tpu" stays strict: no silent degrade, no extra count
+    with pytest.raises(RuntimeError):
+        cas.cas_ids([cas.message_from_bytes(content)], "tpu")
+    assert telemetry.counter_value("sd_cas_backend_fallback_total") == before + 1
+
+
 def test_full_digest_64_hex():
     # Validator-style full digest through the streaming hasher.
     c = _content(3 * 1024 * 1024 + 5)

@@ -163,6 +163,72 @@ def test_window_pipeline_depth_order_and_errors():
     assert not pipe._thread.is_alive()
 
 
+def test_window_pipeline_close_with_full_buffer_wakes_taker_instantly():
+    """Regression (ISSUE 4 satellite): with the old bounded Queue,
+    close() dropped its wake-up sentinel when the queue was Full, so a
+    consumer draining after close discovered shutdown only via a 0.1 s
+    poll. The deque+condition pipeline must hand over buffered windows
+    AND deliver the post-close None with no polling latency."""
+    from spacedrive_tpu.parallel import WindowPipeline
+
+    fetched = threading.Event()
+
+    def fetch(k):
+        fetched.set()
+        return k + 1, k
+
+    pipe = WindowPipeline(fetch, 0, depth=1)
+    fetched.wait(2)
+    # let the producer park its window and block on the full buffer
+    deadline = time.perf_counter() + 2
+    while not pipe._buf and time.perf_counter() < deadline:
+        time.sleep(0.005)
+    assert pipe._buf, "producer never parked a window"
+    pipe.close()
+    # the buffered window still hands over, then None arrives with no
+    # 0.1 s poll — the whole drain fits well inside one old poll tick
+    t0 = time.perf_counter()
+    assert pipe.take() == 0
+    assert pipe.take() is None
+    assert time.perf_counter() - t0 < 0.09
+    assert not pipe._thread.is_alive()
+
+
+def test_window_pipeline_close_wakes_blocked_taker():
+    """close() from another thread must wake a take() that is already
+    blocked on an empty buffer (producer wedged), again with no poll."""
+    from spacedrive_tpu.parallel import WindowPipeline
+
+    wedge = threading.Event()
+
+    def fetch(k):
+        wedge.wait(5)  # producer never delivers
+        return None
+
+    pipe = WindowPipeline(fetch, 0, depth=1)
+    got: list = []
+
+    def consumer():
+        got.append(pipe.take())
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)  # consumer is parked in take()
+    # close() won't return until the wedged producer exits, so run it
+    # aside and measure how fast the CONSUMER wakes (the notify happens
+    # before close joins the producer)
+    closer = threading.Thread(target=pipe.close)
+    t0 = time.perf_counter()
+    closer.start()
+    t.join(timeout=2)
+    assert not t.is_alive()
+    assert time.perf_counter() - t0 < 0.09
+    assert got == [None]
+    wedge.set()
+    closer.join(timeout=2)
+    assert not closer.is_alive()
+
+
 def test_window_pipeline_take_after_exhaustion_returns_none_fast():
     """Advisor r3 (medium): the single end-of-stream sentinel must latch.
 

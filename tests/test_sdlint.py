@@ -429,6 +429,31 @@ def test_sd005_silent_outside_jit_and_on_static_args(tmp_path):
     assert findings == []
 
 
+def test_sd005_flags_host_sync_inside_shard_map_body(tmp_path):
+    # the dp-sharded dispatch path: bodies handed to shard_map trace
+    # per-device exactly like jit bodies
+    findings = run_on(
+        tmp_path,
+        """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(m, l):
+            m.block_until_ready()
+            return m
+
+        def dispatch(mesh, m, l):
+            return shard_map(
+                body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                out_specs=P("dp"),
+            )(m, l)
+        """,
+        ["SD005"],
+    )
+    assert len(findings) == 1
+
+
 # --- SD006 tracer-branch ---------------------------------------------------
 
 
@@ -473,6 +498,27 @@ def test_sd006_silent_on_static_branches(tmp_path):
         ["SD006"],
     )
     assert findings == []
+
+
+def test_sd006_shard_map_body_branches(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            if x.sum() > 0:  # traced per-device shard
+                return x
+            if x.shape[0] > 4:  # static: local shard shape
+                return x
+            return x
+
+        out = shard_map(body, mesh=None, in_specs=None, out_specs=None)
+        """,
+        ["SD006"],
+    )
+    assert len(findings) == 1
 
 
 # --- SD007 metric-label-cardinality ---------------------------------------

@@ -3,11 +3,14 @@
 SD005  host-device sync inside a jitted / pallas function
 SD006  Python control flow branching on a (likely) tracer value
 
-Jit contexts are discovered three ways: ``@jax.jit`` decorators
+Jit contexts are discovered four ways: ``@jax.jit`` decorators
 (including ``functools.partial(jax.jit, ...)``), explicit ``jax.jit(fn)``
-wrapping of a local def, and kernels handed to ``pallas_call``. Nested
-defs inside a jit body are traced too, so these rules walk the full
-subtree (unlike the async rules, which stop at def boundaries).
+wrapping of a local def, kernels handed to ``pallas_call``, and bodies
+handed to ``shard_map`` (the dp-sharded dispatch path — per-device
+bodies trace exactly like jit bodies, so the same sync/branch hazards
+apply). Nested defs inside a jit body are traced too, so these rules
+walk the full subtree (unlike the async rules, which stop at def
+boundaries).
 """
 
 from __future__ import annotations
@@ -20,6 +23,7 @@ from ..core import FileContext, Finding, call_name, dotted_name, rule
 _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
 _PARTIAL_NAMES = {"functools.partial", "partial"}
 _PALLAS_TAILS = {"pallas_call"}
+_SHARD_MAP_TAILS = {"shard_map"}
 
 # attribute access on a tracer that is static at trace time → fine to
 # branch on
@@ -113,6 +117,12 @@ def find_jit_contexts(ctx: FileContext) -> list[JitContext]:
             if node.args and isinstance(node.args[0], ast.Name):
                 if node.args[0].id in by_name:
                     add(by_name[node.args[0].id], set(), "pallas")
+        elif name is not None and name.rsplit(".", 1)[-1] in _SHARD_MAP_TAILS:
+            # shard_map(body, mesh=..., in_specs=..., out_specs=...):
+            # every param of the body is a traced per-device shard
+            if node.args and isinstance(node.args[0], ast.Name):
+                if node.args[0].id in by_name:
+                    add(by_name[node.args[0].id], set(), "shard_map")
     return out
 
 
