@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: lint test tier1 trace-smoke debug-bundle bench-devices
+.PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check
 
 lint:
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
@@ -24,6 +24,12 @@ bench-devices:
 	env XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		JAX_PLATFORMS=cpu SD_BENCH_SWEEP=1 SD_BENCH_FILES=512 \
 		SD_BENCH_REPEATS=2 $(PY) bench.py
+
+# perf trajectory gate: diff the two most recent BENCH_r*.json rounds,
+# fail on a >15% files/s regression in any comparable throughput series
+# (link-bound e2e rates are excused on blocked/congested runs)
+bench-check:
+	$(PY) tools/bench_compare.py --dir .
 
 # observability smoke: boot a node, index, assert /metrics + /trace +
 # debug bundle are live and secret-free

@@ -1626,6 +1626,24 @@ def _telemetry(r: Router) -> None:
         # the redacted support artifact (see telemetry.bundle)
         return telemetry.debug_bundle(node)
 
+    @r.query("telemetry.health")
+    def health(node):
+        # per-subsystem → per-node verdicts (telemetry.health)
+        from ..telemetry import health as _health
+
+        return _health.evaluate(node)
+
+    @r.query("telemetry.mesh")
+    async def mesh(node, arg=None):
+        # mesh-wide view: local snapshot + federated peer snapshots
+        # with staleness marking; arg {refresh?: bool, force?: bool}
+        from ..telemetry.federation import mesh_status
+
+        opts = arg if isinstance(arg, dict) else {}
+        if node.p2p is not None and opts.get("refresh", True):
+            await node.p2p.refresh_federation(force=bool(opts.get("force")))
+        return mesh_status(node)
+
 
 def _invalidation(r: Router) -> None:
     @r.subscription("invalidation.listen")

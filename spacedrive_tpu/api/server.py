@@ -66,6 +66,8 @@ class ApiServer:
                 web.get("/", self._index),
                 web.get("/metrics", self._metrics),
                 web.get("/trace", self._trace),
+                web.get("/health", self._health),
+                web.get("/mesh", self._mesh),
                 web.get("/static/{path:.*}", self._static),
                 web.get("/rspc/client.js", self._client_js),
                 web.get("/rspc/manifest", self._manifest),
@@ -136,6 +138,34 @@ class ApiServer:
             telemetry.trace_export(request.query.get("trace_id") or None),
             headers={"Content-Disposition": "inline; filename=sd-trace.json"},
         )
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        """Per-subsystem → per-node health rollup (telemetry.health).
+        503 when unhealthy so load balancers / probes can act on the
+        status code alone; the JSON body carries the verdicts."""
+        from ..telemetry import health as _health_mod
+
+        verdict = _health_mod.evaluate(self.node)
+        return web.json_response(
+            verdict,
+            status=503 if verdict["status"] == _health_mod.UNHEALTHY else 200,
+            dumps=_dumps,
+        )
+
+    async def _mesh(self, request: web.Request) -> web.Response:
+        """Mesh-wide telemetry: this node's snapshot + the federation
+        cache's per-peer view (freshness-marked). Pull-through — the
+        request refreshes peers whose snapshot aged past the cache's
+        refresh interval; `?refresh=0` reads the cache as-is,
+        `?force=1` re-pulls everyone."""
+        from ..telemetry.federation import mesh_status
+
+        p2p = self.node.p2p
+        if p2p is not None and request.query.get("refresh") != "0":
+            await p2p.refresh_federation(
+                force=request.query.get("force") == "1"
+            )
+        return web.json_response(mesh_status(self.node), dumps=_dumps)
 
     async def _index(self, _request: web.Request) -> web.FileResponse:
         """The explorer web UI (role parity: ref:interface/ + apps/web)."""

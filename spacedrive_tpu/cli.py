@@ -309,6 +309,47 @@ async def cmd_spacedrop(args: argparse.Namespace) -> int:
         return 0
 
 
+def _http_get(url: str, timeout: float = 30.0) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+async def cmd_mesh_status(args: argparse.Namespace) -> int:
+    """Mesh-wide observability: every known peer's latest telemetry
+    snapshot with staleness marking, plus this node's own health.
+    With --url, reads a running node's GET /mesh; otherwise boots an
+    ephemeral mesh node, discovers peers, and pulls directly."""
+    if args.url:
+        import urllib.error
+
+        url = args.url.rstrip("/") + "/mesh"
+        if args.no_refresh:
+            url += "?refresh=0"
+        try:
+            doc = await asyncio.to_thread(_http_get, url)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"mesh-status: cannot reach {url}: {e}", file=sys.stderr)
+            print("is a node running? start one with `sdx serve`",
+                  file=sys.stderr)
+            return 1
+        _write_or_print(json.dumps(json.loads(doc), indent=2), args.out)
+        return 0
+
+    from .telemetry.federation import mesh_status
+
+    async with _mesh_node(args) as node:
+        await node.p2p.refresh_federation(force=True)
+        status = mesh_status(node)
+        _write_or_print(json.dumps(status, indent=2, default=str), args.out)
+        peers = status["mesh"]["peers"]
+        if not peers:
+            print("no peers in the federation cache (none discovered?)",
+                  file=sys.stderr)
+        return 0
+
+
 def cmd_crypto(args: argparse.Namespace) -> int:
     from .crypto import FileHeader, decrypt_file, encrypt_file
 
@@ -506,11 +547,45 @@ def cmd_trace_export(args: argparse.Namespace) -> int:
     return 0
 
 
+async def cmd_debug_bundle_peer(args: argparse.Namespace) -> int:
+    """Pull a REMOTE node's debug bundle across the mesh. The bundle is
+    built — and fully redacted — by the OWNING node before anything
+    touches the wire (telemetry.bundle runs there); this side only
+    receives the already-clean artifact. The peer must have the
+    remoteRspc feature enabled."""
+    from .p2p.identity import RemoteIdentity
+    from .p2p.rspc import RemoteRspcError, remote_exec
+
+    async with _mesh_node(args) as node:
+        try:
+            bundle = await remote_exec(
+                node.p2p.p2p,
+                RemoteIdentity.from_str(args.peer),
+                "telemetry.debug_bundle",
+            )
+        except RemoteRspcError as e:
+            print(f"debug-bundle: peer refused: {e} (code {e.code})",
+                  file=sys.stderr)
+            if e.code == 403:
+                print("the peer must enable the remoteRspc feature "
+                      "(toggleFeature remoteRspc)", file=sys.stderr)
+            return 1
+        except (ValueError, ConnectionError, OSError, EOFError,
+                asyncio.TimeoutError) as e:
+            print(f"debug-bundle: cannot reach peer: {e}", file=sys.stderr)
+            return 1
+        _write_or_print(json.dumps(bundle, indent=2), args.out)
+        return 0
+
+
 def cmd_debug_bundle(args: argparse.Namespace) -> int:
     """The redacted debug bundle: from a running node (--url) with live
-    metrics/rings, or offline straight off the data dir."""
+    metrics/rings, from a mesh peer (--peer, redacted on the owning
+    node), or offline straight off the data dir."""
     from .telemetry.bundle import render_bundle
 
+    if args.peer:
+        return asyncio.run(cmd_debug_bundle_peer(args))
     if args.url:
         import urllib.error
         import urllib.request
@@ -673,7 +748,28 @@ def build_parser() -> argparse.ArgumentParser:
     db.add_argument("--url", default=None,
                     help="pull the bundle from a running node instead of "
                          "building offline from --data-dir")
+    db.add_argument("--peer", default=None, metavar="IDENTITY",
+                    help="pull a MESH PEER's bundle (redacted on the owning "
+                         "node before it rides the wire; the peer must have "
+                         "remoteRspc enabled)")
+    db.add_argument("--wait", type=float, default=3.0,
+                    help="discovery settle time before dialing --peer")
     db.add_argument("--out", help="write JSON here instead of stdout")
+
+    ms = sub.add_parser(
+        "mesh-status",
+        help="mesh-wide observability: every peer's latest telemetry "
+             "snapshot (freshness-marked) + this node's health",
+    )
+    ms.add_argument("--url", default=None,
+                    help="read a running node's GET /mesh instead of booting "
+                         "an ephemeral mesh node")
+    ms.add_argument("--no-refresh", action="store_true",
+                    help="with --url: serve the cached mesh view without "
+                         "re-pulling peers")
+    ms.add_argument("--wait", type=float, default=3.0,
+                    help="discovery settle time (ephemeral-node mode)")
+    ms.add_argument("--out", help="write JSON here instead of stdout")
 
     dk = sub.add_parser(
         "desktop",
@@ -724,6 +820,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_trace_export(args)
     if args.cmd == "debug-bundle":
         return cmd_debug_bundle(args)
+    if args.cmd == "mesh-status":
+        return asyncio.run(cmd_mesh_status(args))
     if args.cmd == "desktop":
         from . import desktop
 

@@ -15,7 +15,7 @@ from typing import Any
 import aiohttp
 
 from ..telemetry import trace as _trace
-from .relay import TRACE_HEADER, b64, unb64
+from .relay import INSTANCE_HEADER, TRACE_HEADER, b64, unb64
 
 
 class CloudApiError(Exception):
@@ -28,14 +28,16 @@ class CloudClient:
         self._session: aiohttp.ClientSession | None = None
 
     async def _request(
-        self, method: str, path: str, json: Any = None
+        self, method: str, path: str, json: Any = None,
+        headers: dict[str, str] | None = None,
     ) -> Any:
         if self._session is None:
             self._session = aiohttp.ClientSession()
         # trace context rides an HTTP header so relay-side spans join
         # the pushing/pulling node's trace
         wire = _trace.wire_current()
-        headers = {TRACE_HEADER: _json.dumps(wire)} if wire else None
+        if wire:
+            headers = {**(headers or {}), TRACE_HEADER: _json.dumps(wire)}
         try:
             async with self._session.request(
                 method, f"{self.origin}{path}", json=json, headers=headers
@@ -107,3 +109,25 @@ class CloudClient:
         for c in out:
             c["contents"] = unb64(c["contents"])
         return out
+
+    # --- telemetry federation fallback (telemetry/federation.py) -------
+
+    async def push_telemetry(
+        self, library_uuid: str, instance_uuid: str, snapshot: dict[str, Any]
+    ) -> Any:
+        return await self._request(
+            "POST",
+            f"/api/libraries/{library_uuid}/telemetry",
+            {"instance_uuid": instance_uuid, "snapshot": snapshot},
+            headers={INSTANCE_HEADER: instance_uuid},
+        )
+
+    async def pull_telemetry(
+        self, library_uuid: str, instance_uuid: str
+    ) -> list[dict[str, Any]]:
+        return await self._request(
+            "POST",
+            f"/api/libraries/{library_uuid}/telemetry/get",
+            {"instance_uuid": instance_uuid},
+            headers={INSTANCE_HEADER: instance_uuid},
+        )

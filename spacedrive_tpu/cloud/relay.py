@@ -25,6 +25,7 @@ from __future__ import annotations
 import base64
 import itertools
 import json as _json
+import time
 from typing import Any
 
 from aiohttp import web
@@ -35,6 +36,9 @@ from ..telemetry import trace as _trace
 # HTTP header carrying the telemetry.trace wire dict (JSON) so relay
 # spans join the calling node's trace
 TRACE_HEADER = "X-SD-Trace"
+# HTTP header naming the pushing instance on telemetry federation
+# calls (body `instance_uuid` is the fallback)
+INSTANCE_HEADER = "X-SD-Instance"
 
 
 def _request_trace(request: web.Request) -> "_trace.TraceContext | None":
@@ -63,6 +67,12 @@ class CloudRelay:
                 ),
                 web.post(
                     "/api/libraries/{lib}/messageCollections/get", self._pull
+                ),
+                web.post(
+                    "/api/libraries/{lib}/telemetry", self._telemetry_push
+                ),
+                web.post(
+                    "/api/libraries/{lib}/telemetry/get", self._telemetry_pull
                 ),
             ]
         )
@@ -159,6 +169,54 @@ class CloudRelay:
                 and c["id"] > cursors.get(c["instance_uuid"], 0)
             ]
             return web.json_response(out[: int(body.get("count", 100))])
+
+
+    # --- telemetry federation fallback (telemetry/federation.py) -------
+    # Nodes without a direct P2P route to a peer exchange compact
+    # snapshots through here: each instance pushes its latest snapshot
+    # (overwrite, not append — only the freshest matters), and pulls
+    # every OTHER instance's copy with its relay-side age, so the
+    # puller's staleness clock keeps running while a snapshot sits here.
+
+    async def _telemetry_push(self, request: web.Request) -> web.Response:
+        lib = self._lib(request)
+        body = await request.json()
+        with _trace.use(_request_trace(request)), _span("relay.telemetry_push"):
+            instance = request.headers.get(INSTANCE_HEADER) \
+                or (body.get("instance_uuid") if isinstance(body, dict)
+                    else None)
+            if instance not in lib["instances"]:
+                raise web.HTTPBadRequest(text="unknown instance")
+            snapshot = body.get("snapshot") if isinstance(body, dict) else None
+            if not isinstance(snapshot, dict):
+                # malformed push is the CLIENT's error — 400, not a 500;
+                # the relay stores any dict shape (it must keep relaying
+                # for peers running a newer snapshot revision — version
+                # checking is the puller's job, snapshot_compatible)
+                raise web.HTTPBadRequest(text="snapshot must be an object")
+            lib.setdefault("telemetry", {})[instance] = {
+                "snapshot": snapshot,
+                "pushed_at": time.time(),
+            }
+            return web.json_response({"ok": True})
+
+    async def _telemetry_pull(self, request: web.Request) -> web.Response:
+        lib = self._lib(request)
+        body = await request.json()
+        with _trace.use(_request_trace(request)), _span("relay.telemetry_pull"):
+            me = request.headers.get(INSTANCE_HEADER) \
+                or body.get("instance_uuid")
+            now = time.time()
+            out = [
+                {
+                    "instance_uuid": inst,
+                    "snapshot": entry["snapshot"],
+                    "age_seconds": round(now - entry["pushed_at"], 3),
+                }
+                for inst, entry in lib.get("telemetry", {}).items()
+                if inst != me
+            ]
+            return web.json_response(out)
 
 
 def b64(data: bytes) -> str:

@@ -21,10 +21,17 @@ from ..sync.ingest import IngestActor
 from ..telemetry import span as _span
 from ..telemetry import trace as _trace
 from ..telemetry.events import P2P_EVENTS
+from ..telemetry.federation import FederationCache, local_snapshot, snapshot_compatible
 from ..utils.tasks import supervise
 from .identity import RemoteIdentity
 from .mdns import MdnsDiscovery
-from .operations import SpacedropManager, respond_file
+from .operations import (
+    SpacedropManager,
+    _wireable_snapshot,
+    request_telemetry,
+    respond_file,
+    respond_telemetry,
+)
 from .p2p import P2P
 from .protocol import Header, HeaderType
 from .sync import alert_new_ops, request_ops_from_peer, respond_sync_request
@@ -44,6 +51,9 @@ class P2PManager:
 
         self.pairing = PairingManager(node, node.event_bus)
         self.ingest_actors: dict[uuid.UUID, IngestActor] = {}
+        # mesh-wide telemetry: freshest snapshot per peer w/ staleness
+        # (telemetry/federation.py; read via GET /mesh, telemetry.mesh)
+        self.federation = FederationCache()
         self._beacon_addrs = beacon_addrs
         self._bind_host = bind_host
         self._unsubs: list[Any] = []
@@ -198,6 +208,104 @@ class P2PManager:
                 return p
         return None
 
+    def _is_library_member(self, remote_identity: Any) -> bool:
+        """True when the identity belongs to an instance of any loaded
+        library — i.e. a peer the pairing flow admitted (instance rows
+        store ``RemoteIdentity.to_bytes()``). The instance table is
+        tiny, so the scan is cheap per request."""
+        if remote_identity is None:
+            return False
+        try:
+            needle = remote_identity.to_bytes()
+        except (AttributeError, ValueError):
+            return False
+        for lib in self.node.libraries.libraries.values():
+            for row in lib.db.query("SELECT identity FROM instance"):
+                if row["identity"] == needle:
+                    return True
+        return False
+
+    # --- telemetry federation (telemetry/federation.py) ----------------
+
+    async def refresh_federation(self, force: bool = False) -> dict:
+        """Pull fresh snapshots from every discovered peer — direct P2P
+        first, the cloud relay as fallback for peers we can't reach —
+        and return the refreshed mesh view. Pull-through: a peer whose
+        cached snapshot is younger than the cache's refresh interval is
+        skipped unless ``force``, so a burst of /mesh hits doesn't
+        stampede the mesh."""
+        due = [
+            peer for peer in self.p2p.discovered_peers()
+            if force or self.federation.needs_refresh(str(peer.identity))
+        ]
+
+        # pulls are independent — run them concurrently so a mesh with
+        # several unreachable peers costs ONE telemetry timeout, not N
+        # (EOFError covers IncompleteReadError: a peer closing the
+        # stream mid-response is a failed pull, not a /mesh 500)
+        async def pull(peer: Any) -> tuple[Any, str] | None:
+            try:
+                snap = await request_telemetry(self.p2p, peer.identity)
+                self.federation.store(str(peer.identity), snap,
+                                      transport="p2p")
+                return None
+            except (ConnectionError, OSError, EOFError,
+                    asyncio.TimeoutError, ValueError) as e:
+                return (peer, str(e))
+
+        results = await asyncio.gather(*(pull(p) for p in due))
+        failed = [r for r in results if r is not None]
+        # the relay leg costs real HTTP round-trips per cloud-enabled
+        # library — run it only when something needs it (unreached
+        # peers, relay-tracked peers due a refresh, or an explicit
+        # force), not on every dashboard poll
+        if failed or force or self.federation.due_relay_peers():
+            await self._relay_federation(failed)
+        return self.federation.mesh()
+
+    async def _relay_federation(self, failed: list[tuple[Any, str]]) -> None:
+        """Cloud-relay fallback: push our own snapshot and pull every
+        other instance's through each cloud-enabled library, then mark
+        peers that neither route reached as failed."""
+        from ..cloud.api import CloudApiError
+
+        clients = {
+            lib.id: (lib.cloud_sync.client, lib.sync.instance)
+            for lib in self.node.libraries.libraries.values()
+            if getattr(lib, "cloud_sync", None) is not None
+        }
+        recovered: set[str] = set()
+        if clients:
+            snap = _wireable_snapshot(local_snapshot(self.node))
+            for lib_id, (client, inst) in clients.items():
+                try:
+                    await client.push_telemetry(str(lib_id), str(inst), snap)
+                    rows = await client.pull_telemetry(str(lib_id), str(inst))
+                except (CloudApiError, OSError, asyncio.TimeoutError) as e:
+                    logger.debug("relay federation via %s failed: %s",
+                                 lib_id, e)
+                    continue
+                for row in rows:
+                    remote = row.get("snapshot")
+                    if not snapshot_compatible(remote):
+                        continue
+                    try:
+                        inst_uuid = uuid.UUID(row["instance_uuid"])
+                    except (KeyError, ValueError):
+                        continue
+                    peer = self.peer_for_instance(inst_uuid)
+                    pid = (str(peer.identity) if peer is not None
+                           else f"instance:{inst_uuid}")
+                    self.federation.store(
+                        pid, remote, transport="relay",
+                        age_seconds=float(row.get("age_seconds", 0.0)),
+                    )
+                    recovered.add(pid)
+        for peer, err in failed:
+            pid = str(peer.identity)
+            if pid not in recovered:
+                self.federation.record_failure(pid, err)
+
     # --- inbound dispatch (ref:manager.rs stream handler) --------------
 
     async def _handle_stream(self, stream: Any) -> None:
@@ -244,6 +352,23 @@ class P2PManager:
             else:
                 w = Writer(stream)
                 w.u8(0).string("filesOverP2P disabled")
+                await w.flush()
+        elif header.type == HeaderType.TELEMETRY:
+            # served to LIBRARY MEMBERS only: any LAN node can complete
+            # a handshake, but the snapshot names libraries, watermarks,
+            # and node metadata — the same trust bar the pairing flow
+            # sets (FILE and RSPC gate behind features for the same
+            # reason; membership is the natural gate for mesh health)
+            if self._is_library_member(
+                getattr(stream, "remote_identity", None)
+            ):
+                with _span("p2p.telemetry_serve"):
+                    await respond_telemetry(stream, self.node)
+            else:
+                w = Writer(stream)
+                w.msgpack(
+                    {"error": "telemetry is served to library members only"}
+                )
                 await w.flush()
         elif header.type == HeaderType.RSPC:
             from .rspc import respond_rspc

@@ -187,6 +187,60 @@ class SpacedropManager:
         return True
 
 
+TELEMETRY_TIMEOUT = 10.0
+
+
+async def request_telemetry(p2p: Any, identity: RemoteIdentity) -> dict:
+    """Pull a peer's compact telemetry snapshot (the federation wire
+    request; see telemetry/federation.py). The responder builds the
+    snapshot on its side — nothing secret rides it — and this side
+    validates the version before trusting the shape."""
+    from ..telemetry.federation import snapshot_compatible
+    from ..utils.compat import timeout
+
+    stream = await p2p.new_stream(identity)
+    try:
+        async with timeout(TELEMETRY_TIMEOUT):
+            await Header(
+                HeaderType.TELEMETRY, trace=_trace.wire_current()
+            ).write(stream)
+            snap = await Reader(stream).msgpack()
+    finally:
+        await stream.close()
+    if isinstance(snap, dict) and "v" not in snap and snap.get("error"):
+        # the responder refused (e.g. we are not a library member there)
+        raise PermissionError(str(snap["error"]))
+    if not snapshot_compatible(snap):
+        raise ValueError(
+            f"peer served an incompatible telemetry snapshot "
+            f"(v={snap.get('v') if isinstance(snap, dict) else '?'})"
+        )
+    return snap
+
+
+async def respond_telemetry(stream: Any, node: Any) -> None:
+    """Server half: serve this node's snapshot. The snapshot is built
+    by the owning node (metrics values, health verdicts, ring digests
+    — no ring payloads), so nothing needing redaction crosses here."""
+    from ..telemetry.federation import local_snapshot
+
+    w = Writer(stream)
+    w.msgpack(_wireable_snapshot(local_snapshot(node)))
+    await w.flush()
+
+
+def _wireable_snapshot(obj: Any) -> Any:
+    """msgpack-encodable projection (floats/str/ints pass, odd leaves
+    stringify) — snapshots must never fail to serialize."""
+    if isinstance(obj, dict):
+        return {str(k): _wireable_snapshot(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_wireable_snapshot(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return str(obj)
+
+
 async def request_file(
     p2p: Any,
     identity: RemoteIdentity,

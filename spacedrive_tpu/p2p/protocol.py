@@ -28,6 +28,7 @@ class HeaderType(enum.IntEnum):
     HTTP = 5
     RSPC = 6
     PAIRING = 7  # library join request (ref: the reference's pairing flow)
+    TELEMETRY = 8  # pull the peer's compact telemetry snapshot (federation)
 
 
 @dataclass
@@ -70,6 +71,8 @@ class Header:
             w.uuid(self.file.library_id)
             w.uuid(self.file.file_path_pub_id)
             w.msgpack(self.file.range.to_wire())
+        elif self.type == HeaderType.TELEMETRY:
+            w.msgpack(self.trace or {})
         await w.flush()
 
     @classmethod
@@ -91,4 +94,6 @@ class Header:
                     range=Range.from_wire(await r.msgpack()),
                 ),
             )
+        if t == HeaderType.TELEMETRY:
+            return cls(t, trace=(await r.msgpack()) or None)
         return cls(t)

@@ -19,14 +19,18 @@ from __future__ import annotations
 import asyncio
 import enum
 import logging
+import time
 import uuid
 from typing import Any, Awaitable, Callable, Iterable
 
+from ..telemetry import metrics as _tm
 from ..telemetry import span as _span
 from ..telemetry import trace as _trace
+from ..telemetry.events import SYNC_EVENTS
+from ..telemetry.peers import peer_label
 from .apply import apply_op
 from .crdt import CRDTOperation, DELETE
-from .hlc import NTP64
+from .hlc import ClockDriftError, NTP64
 from .manager import SyncManager, _record_id_blob
 
 logger = logging.getLogger(__name__)
@@ -78,8 +82,31 @@ def is_operation_old(sync: SyncManager, op: CRDTOperation) -> bool:
 
 def receive_crdt_operation(sync: SyncManager, op: CRDTOperation) -> bool:
     """Merge clock, LWW-check, apply + store atomically; returns True if
-    the op was applied (ref:ingest.rs:120-166)."""
-    sync.clock.update(op.timestamp)
+    the op was applied (ref:ingest.rs:120-166).
+
+    A delta-guard trip (remote HLC unacceptably far in the future) now
+    rejects *that op* — counted on ``sd_hlc_delta_guard_total`` and
+    recorded on the sync flight ring — instead of poisoning the whole
+    batch: one peer with a broken clock must not stall replication from
+    everyone else. The watermark deliberately does NOT advance past a
+    guarded op (advancing to a far-future timestamp would skip that
+    peer's legitimate later ops)."""
+    peer = peer_label(op.instance)
+    # observed skew: remote op's HLC time vs our wall clock (positive =
+    # remote ahead); sampled per op, cheap (one gauge set)
+    skew = op.timestamp.as_unix() - time.time()
+    _tm.HLC_CLOCK_SKEW.set(skew, peer=peer)
+    try:
+        sync.clock.update(op.timestamp)
+    except ClockDriftError as e:
+        _tm.HLC_DELTA_GUARD.inc()
+        SYNC_EVENTS.emit(
+            "delta_guard",
+            peer=peer,
+            skew_seconds=round(skew, 3),
+            error=str(e)[:200],
+        )
+        return False
 
     applied = False
     if not is_operation_old(sync, op):
@@ -126,11 +153,18 @@ def receive_crdt_operation(sync: SyncManager, op: CRDTOperation) -> bool:
                 ),
             )
         applied = True
+        _tm.SYNC_OPS.inc(
+            result="tombstone" if op.data.kind == DELETE else "applied"
+        )
+    else:
+        _tm.SYNC_OPS.inc(result="stale")
 
     # watermark advances even for rejected-old ops: they're *seen*
     current = sync.timestamps.get(op.instance, NTP64(0))
     if op.timestamp > current:
         sync.timestamps[op.instance] = op.timestamp
+        if op.instance != sync.instance:
+            _tm.SYNC_WATERMARK.set(op.timestamp.as_unix(), peer=peer)
     return applied
 
 
@@ -169,6 +203,9 @@ class IngestActor:
         self.state = State.WAITING_FOR_NOTIFICATION
         self.applied = 0
         self.rejected = 0
+        # last op outcome, for accept/reject transition events (True so
+        # a batch that opens with a reject records the transition)
+        self._last_op_accepted = True
         self._notify = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._stopped = False
@@ -241,17 +278,48 @@ class IngestActor:
         while not self._stopped:
             self.state = State.RETRIEVING_MESSAGES
             timestamps = list(self.sync.timestamps.items())
-            ops, has_more = await self.request_ops(
-                timestamps, self.ops_per_request
-            )
+            with _span("sync.request"):
+                ops, has_more = await self.request_ops(
+                    timestamps, self.ops_per_request
+                )
             self.state = State.INGESTING
             if ops:
+                _tm.SYNC_INGEST_BACKLOG.set(len(ops))
+                batch_applied = batch_rejected = 0
                 with _span("sync.ingest"):
-                    for op in ops:
-                        if receive_crdt_operation(self.sync, op):
+                    for i, op in enumerate(ops):
+                        ok = receive_crdt_operation(self.sync, op)
+                        if ok:
                             self.applied += 1
+                            batch_applied += 1
                         else:
                             self.rejected += 1
+                            batch_rejected += 1
+                        # flight-record accept↔reject TRANSITIONS (not
+                        # per-op emits): the ring captures when a stream
+                        # of applies turns into rejects and vice versa
+                        if ok != self._last_op_accepted:
+                            self._last_op_accepted = ok
+                            if ok:
+                                SYNC_EVENTS.emit(
+                                    "accept_resume",
+                                    peer=peer_label(op.instance),
+                                    batch_index=i,
+                                )
+                            else:
+                                SYNC_EVENTS.emit(
+                                    "reject_start",
+                                    peer=peer_label(op.instance),
+                                    batch_index=i,
+                                )
+                _tm.SYNC_INGEST_BACKLOG.set(0)
+                SYNC_EVENTS.emit(
+                    "ingest_batch",
+                    applied=batch_applied,
+                    rejected=batch_rejected,
+                    has_more=bool(has_more),
+                )
+                self.sync.observe_replication_lag()
             if ops and self.sync.event_bus is not None:
                 self.sync.event_bus.emit(("SyncMessage", "Ingested"))
             if not has_more:

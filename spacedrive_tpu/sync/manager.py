@@ -10,10 +10,13 @@ SyncMessage events for the P2P layer.
 from __future__ import annotations
 
 import logging
+import time
 import uuid
 from typing import Any, Callable, Iterable
 
 from ..db.database import LibraryDb
+from ..telemetry import metrics as _tm
+from ..telemetry.peers import peer_label
 from ..utils.events import EventBus
 from .crdt import CRDTOperation
 from .factory import OperationFactory
@@ -49,6 +52,40 @@ class SyncManager(OperationFactory):
         )
         for row in rows:
             self.timestamps[uuid.UUID(bytes=row["pub_id"])] = NTP64(row["ts"])
+
+    # --- replication observability ---
+
+    def replication_watermarks(self) -> dict[str, float]:
+        """Per-remote-instance latest applied HLC timestamp (unix
+        seconds), keyed by the capped ``peer_label`` short-hash — the
+        raw pub_id never reaches a metric label or a wire snapshot."""
+        return {
+            peer_label(inst): ts.as_unix()
+            for inst, ts in self.timestamps.items()
+            if inst != self.instance
+        }
+
+    def observe_replication_lag(self) -> dict[str, float]:
+        """Refresh ``sd_sync_lag_seconds{peer}`` /
+        ``sd_sync_watermark_seconds{peer}`` from the in-memory
+        watermarks and return the lag map. Lag is wall-clock now minus
+        the latest *applied* HLC timestamp from that peer: ~0 right
+        after a converged sync round, growing while this replica falls
+        (or the peer goes) behind. Called after ingest batches and by
+        the health/federation read paths so the gauges stay honest even
+        when no ops are flowing."""
+        now = time.time()
+        lags: dict[str, float] = {}
+        for inst, ts in self.timestamps.items():
+            if inst == self.instance:
+                continue
+            label = peer_label(inst)
+            watermark = ts.as_unix()
+            lag = max(0.0, now - watermark)
+            lags[label] = lag
+            _tm.SYNC_LAG.set(lag, peer=label)
+            _tm.SYNC_WATERMARK.set(watermark, peer=label)
+        return lags
 
     def _instance_db_id(self, instance: uuid.UUID) -> int:
         row = self.db.find_one("instance", pub_id=instance.bytes)

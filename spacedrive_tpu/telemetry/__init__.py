@@ -22,7 +22,7 @@ Surface:
 - ``reset()`` — test isolation across metrics, spans, traces, rings.
 """
 
-from . import events, metrics, trace
+from . import events, federation, health, metrics, trace
 from .registry import (
     BYTE_BUCKETS,
     MAX_SERIES_PER_FAMILY,
@@ -85,4 +85,5 @@ __all__ = [
     "clear_recent", "snapshot", "histogram_recent", "gauge_value",
     "counter_value", "render", "counter", "gauge", "histogram",
     "trace", "events", "reset", "trace_export", "debug_bundle",
+    "health", "federation",
 ]

@@ -98,6 +98,7 @@ def clear_all() -> None:
 
 JOB_EVENTS = ring("jobs")          # job state transitions
 P2P_EVENTS = ring("p2p")           # connects, stream opens, retransmits
+SYNC_EVENTS = ring("sync")         # ingest accept/reject transitions, delta-guard trips
 WATCHER_EVENTS = ring("watcher")   # debounced burst flushes
 ERROR_EVENTS = ring("errors")      # uncaught exceptions w/ tracebacks
 WATCHDOG_EVENTS = ring("watchdog")  # slow-op firings

@@ -1,0 +1,235 @@
+"""Health model — rolls raw telemetry signals into verdicts.
+
+Metrics answer "how much", the health model answers the on-call
+question: *is this node OK, and if not, which subsystem?* Each
+subsystem's verdict derives from signals the registry / flight
+recorder already collects — no new probes, no background task; the
+evaluation is a pure read over existing state, cheap enough to run on
+every ``GET /health`` hit and inside every federation snapshot.
+
+Verdict vocabulary (ordered): ``healthy`` < ``degraded`` <
+``unhealthy``; ``unknown`` means "no signal yet" and never worsens the
+rollup (a node that has not dispatched a batch is idle, not sick).
+
+Subsystems and their signals:
+
+- ``event_loop`` — the loop-lag sampler's gauge (a starved loop stalls
+  every actor at once);
+- ``feeder`` — recent consumer-side wait times (a stalled H2D feeder
+  starves the device);
+- ``device`` — recent dispatch occupancy (chips mostly hauling pad
+  rows means the batch ladder is misconfigured);
+- ``p2p`` — retransmit / zero-window / failure *episode* rate off the
+  p2p flight ring;
+- ``sync`` — the federation-corroborated replication head gap (how far
+  a fresh peer snapshot's library head is ahead of ours) plus
+  delta-guard trips; raw wall-clock lag rides along as a signal but
+  never drives the verdict — it grows on a healthy idle mesh.
+
+Thresholds are module constants, deliberately lenient: a health
+verdict that cries wolf gets ignored.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from .registry import REGISTRY
+from .snapshot import counter_value, gauge_value, histogram_recent
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+UNHEALTHY = "unhealthy"
+UNKNOWN = "unknown"
+
+_RANK = {HEALTHY: 0, UNKNOWN: 0, DEGRADED: 1, UNHEALTHY: 2}
+
+# event-loop scheduling lag (seconds)
+LOOP_LAG_DEGRADED = 0.2
+LOOP_LAG_UNHEALTHY = 1.0
+# feeder consumer wait (seconds, worst recent sample)
+FEEDER_WAIT_DEGRADED = 1.0
+FEEDER_WAIT_UNHEALTHY = 5.0
+# device dispatch occupancy (mean of recent observations)
+OCCUPANCY_DEGRADED = 0.25
+# p2p failure episodes per minute over the ring window
+P2P_EPISODES_DEGRADED = 30.0
+P2P_EPISODES_UNHEALTHY = 120.0
+P2P_EPISODE_TYPES = ("rto_timeout", "rwnd_stall", "bad_ack", "stream_failed")
+P2P_WINDOW_SECONDS = 60.0
+# replication head gap (seconds a peer's library head is ahead of ours,
+# corroborated by a FRESH federation snapshot — see _sync below)
+SYNC_GAP_DEGRADED = 60.0
+SYNC_GAP_UNHEALTHY = 600.0
+
+
+def _verdict(status: str, reason: str | None = None,
+             **signals: Any) -> dict[str, Any]:
+    out: dict[str, Any] = {"status": status}
+    if reason:
+        out["reason"] = reason
+    if signals:
+        out["signals"] = signals
+    return out
+
+
+def _event_loop() -> dict[str, Any]:
+    lag = gauge_value("sd_event_loop_lag_seconds")
+    if lag >= LOOP_LAG_UNHEALTHY:
+        return _verdict(UNHEALTHY, f"event-loop lag {lag:.2f}s", lag_seconds=lag)
+    if lag >= LOOP_LAG_DEGRADED:
+        return _verdict(DEGRADED, f"event-loop lag {lag:.2f}s", lag_seconds=lag)
+    return _verdict(HEALTHY, lag_seconds=lag)
+
+
+def _feeder() -> dict[str, Any]:
+    waits = histogram_recent("sd_feeder_wait_seconds")
+    if not waits:
+        return _verdict(UNKNOWN, "no feeder activity")
+    worst = max(waits)
+    if worst >= FEEDER_WAIT_UNHEALTHY:
+        return _verdict(UNHEALTHY, f"feeder stall {worst:.2f}s",
+                        worst_wait_seconds=worst)
+    if worst >= FEEDER_WAIT_DEGRADED:
+        return _verdict(DEGRADED, f"feeder wait {worst:.2f}s",
+                        worst_wait_seconds=worst)
+    return _verdict(HEALTHY, worst_wait_seconds=worst)
+
+
+def _device() -> dict[str, Any]:
+    samples: list[float] = []
+    for op in ("blake3", "thumbnail"):
+        samples.extend(histogram_recent("sd_device_dispatch_occupancy", op=op))
+    if not samples:
+        return _verdict(UNKNOWN, "no sharded dispatches")
+    mean = sum(samples) / len(samples)
+    if mean < OCCUPANCY_DEGRADED:
+        return _verdict(
+            DEGRADED,
+            f"mean dispatch occupancy {mean:.2f} — chips mostly hauling pad rows",
+            mean_occupancy=mean,
+        )
+    return _verdict(HEALTHY, mean_occupancy=mean)
+
+
+def _p2p() -> dict[str, Any]:
+    from .events import P2P_EVENTS
+
+    now = time.time()
+    episodes = [
+        e for e in P2P_EVENTS.snapshot()
+        if e.get("type") in P2P_EPISODE_TYPES
+        and now - e.get("ts", 0.0) <= P2P_WINDOW_SECONDS
+    ]
+    rate = len(episodes) * 60.0 / P2P_WINDOW_SECONDS
+    if rate >= P2P_EPISODES_UNHEALTHY:
+        return _verdict(UNHEALTHY, f"{rate:.0f} failure episodes/min",
+                        episodes_per_min=rate)
+    if rate >= P2P_EPISODES_DEGRADED:
+        return _verdict(DEGRADED, f"{rate:.0f} failure episodes/min",
+                        episodes_per_min=rate)
+    return _verdict(HEALTHY, episodes_per_min=rate)
+
+
+def _replication_gaps(node: Any) -> dict[str, float]:
+    """Per-peer head gap, CORROBORATED: how far each fresh federation
+    snapshot's library head (latest HLC that peer has seen) is ahead of
+    ours. ~0 on a converged mesh — idle or busy — and positive only
+    when a peer demonstrably holds ops we have not applied. This is the
+    signal verdicts act on; raw wall-clock lag cannot distinguish
+    'replica behind' from 'nothing to replicate'."""
+    cache = getattr(getattr(node, "p2p", None), "federation", None)
+    if cache is None:
+        return {}
+    our_heads: dict[str, float] = {}
+    for lib in getattr(getattr(node, "libraries", None), "libraries",
+                       {}).values():
+        try:
+            our_heads[str(lib.id)] = lib.sync.clock.peek_last().as_unix()
+        except Exception:  # noqa: BLE001 - health reads never fail
+            continue
+    gaps: dict[str, float] = {}
+    for pid, snap in cache.fresh_snapshots().items():
+        libs = (snap.get("node") or {}).get("libraries") or {}
+        worst = 0.0
+        seen = False
+        for lib_id, entry in libs.items():
+            head = entry.get("head_seconds") if isinstance(entry, dict) else None
+            ours = our_heads.get(str(lib_id))
+            if head is None or ours is None:
+                continue
+            seen = True
+            worst = max(worst, float(head) - ours)
+        if seen:
+            from .peers import peer_label
+
+            gaps[peer_label(pid)] = worst
+    return gaps
+
+
+def _sync(node: Any = None) -> dict[str, Any]:
+    lags: dict[str, float] = {}
+    if node is not None:
+        # refresh the gauges from live watermarks so dashboards see
+        # honest time-since-last-applied-op even while idle (the gauge
+        # would otherwise freeze at the last ingest)
+        for lib in getattr(getattr(node, "libraries", None), "libraries",
+                           {}).values():
+            try:
+                lags.update(lib.sync.observe_replication_lag())
+            except Exception:  # noqa: BLE001 - health reads never fail
+                continue
+    else:
+        fam = REGISTRY.get("sd_sync_lag_seconds")
+        if fam is not None:
+            with fam._lock:
+                lags = {k[0]: s.value for k, s in fam._series.items() if k}
+    guard_trips = counter_value("sd_hlc_delta_guard_total")
+    gaps = _replication_gaps(node)
+    signals = {"lag_seconds": lags, "delta_guard_trips": guard_trips,
+               "head_gap_seconds": gaps}
+    if not lags and not gaps:
+        v = _verdict(UNKNOWN, "no replication peers")
+        if guard_trips:
+            v = _verdict(DEGRADED, f"{int(guard_trips)} delta-guard trips",
+                         delta_guard_trips=guard_trips)
+        return v
+    # verdicts key off the corroborated head gap ONLY. Raw wall-lag
+    # (now − last applied op) grows on a perfectly healthy idle mesh,
+    # so it must never flip a node unhealthy — a probe acting on
+    # GET /health's 503 would drain idle-but-fine nodes. (The /mesh
+    # staleness rule separately covers 'peer gone silent'.)
+    if gaps:
+        worst_peer, worst = max(gaps.items(), key=lambda kv: kv[1])
+        if worst >= SYNC_GAP_UNHEALTHY:
+            return _verdict(
+                UNHEALTHY,
+                f"{worst:.0f}s of peer {worst_peer}'s ops not yet applied",
+                **signals)
+        if worst >= SYNC_GAP_DEGRADED:
+            return _verdict(
+                DEGRADED,
+                f"{worst:.0f}s of peer {worst_peer}'s ops not yet applied",
+                **signals)
+    if guard_trips:
+        return _verdict(DEGRADED, f"{int(guard_trips)} delta-guard trips",
+                        **signals)
+    return _verdict(HEALTHY, **signals)
+
+
+def evaluate(node: Any = None) -> dict[str, Any]:
+    """The full health rollup: per-subsystem verdicts plus the overall
+    status (worst subsystem; ``unknown`` counts as healthy)."""
+    subsystems = {
+        "event_loop": _event_loop(),
+        "feeder": _feeder(),
+        "device": _device(),
+        "p2p": _p2p(),
+        "sync": _sync(node),
+    }
+    overall = HEALTHY
+    for v in subsystems.values():
+        if _RANK[v["status"]] > _RANK[overall]:
+            overall = v["status"]
+    return {"status": overall, "subsystems": subsystems}

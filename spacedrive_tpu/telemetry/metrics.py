@@ -184,6 +184,61 @@ PIPELINE_HOST_SECONDS = REGISTRY.histogram(
     labels=("pipeline",),  # identify | thumbnail
 )
 
+# --- sync / replication (sync/ingest.py + sync/manager.py) ------------------
+# Per-peer series label by telemetry.peers.peer_label (capped stable
+# short-hash of the instance pub_id) — NEVER the raw identifier
+# (sdlint SD010).
+
+SYNC_OPS = REGISTRY.counter(
+    "sd_sync_ops_total",
+    "CRDT ops ingested from remote instances, by outcome",
+    labels=("result",),  # applied | stale | tombstone
+)
+SYNC_LAG = REGISTRY.gauge(
+    "sd_sync_lag_seconds",
+    "replication lag per remote instance: wall-clock now minus the "
+    "latest applied HLC timestamp from that peer",
+    labels=("peer",),
+)
+SYNC_WATERMARK = REGISTRY.gauge(
+    "sd_sync_watermark_seconds",
+    "latest applied HLC timestamp per remote instance (unix seconds)",
+    labels=("peer",),
+)
+HLC_DELTA_GUARD = REGISTRY.counter(
+    "sd_hlc_delta_guard_total",
+    "remote ops rejected because their HLC timestamp exceeded the "
+    "delta guard (clock too far in the future)",
+)
+HLC_CLOCK_SKEW = REGISTRY.gauge(
+    "sd_hlc_clock_skew_seconds",
+    "last observed remote-op HLC timestamp minus local wall clock, "
+    "per remote instance (positive = remote clock ahead)",
+    labels=("peer",),
+)
+SYNC_INGEST_BACKLOG = REGISTRY.gauge(
+    "sd_sync_ingest_backlog",
+    "ops fetched by the ingest actor and not yet applied (current batch)",
+)
+
+# --- telemetry federation (telemetry/federation.py + p2p) -------------------
+
+FED_PULLS = REGISTRY.counter(
+    "sd_federation_pulls_total",
+    "peer telemetry-snapshot pulls by outcome and transport",
+    labels=("result",),  # p2p | relay | error
+)
+FED_SNAPSHOT_AGE = REGISTRY.gauge(
+    "sd_federation_snapshot_age_seconds",
+    "age of the freshest cached snapshot per peer",
+    labels=("peer",),
+)
+FED_PEERS = REGISTRY.gauge(
+    "sd_federation_peers",
+    "peers currently tracked by the federation cache, by freshness",
+    labels=("state",),  # fresh | stale
+)
+
 # --- event loop health (telemetry/events.py LoopLagMonitor) -----------------
 
 EVENT_LOOP_LAG = REGISTRY.gauge(

@@ -553,6 +553,79 @@ def test_sd007_silent_on_bounded_labels(tmp_path):
     assert findings == []
 
 
+def test_sd007_sanctions_peer_label_scheme(tmp_path):
+    """peer_label(...) — direct or through a same-function local — is
+    the approved per-peer label shape and must not trip SD007."""
+    findings = run_on(
+        tmp_path,
+        """
+        from spacedrive_tpu.telemetry.peers import peer_label
+
+        def record(op, lag, SYNC_LAG, SKEW):
+            SYNC_LAG.set(lag, peer=peer_label(op.instance))
+            label = peer_label(op.instance)
+            SKEW.set(0.5, peer=label)
+        """,
+        ["SD007"],
+    )
+    assert findings == []
+
+
+def test_sd007_peer_label_dataflow_is_same_function_only(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        from spacedrive_tpu.telemetry.peers import peer_label
+
+        def mk(op):
+            return peer_label(op.instance)
+
+        def record(op, SYNC_LAG):
+            label = mk(op)  # not a visible peer_label assignment
+            SYNC_LAG.set(1.0, peer=label)
+        """,
+        ["SD007"],
+    )
+    assert len(findings) == 1
+
+
+# --- SD010 peer-identifier-metric-label ------------------------------------
+
+
+def test_sd010_flags_raw_peer_identifier_labels(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def record(op, peer, identity, SYNC_LAG, FED_AGE, PULLS):
+            SYNC_LAG.set(1.0, peer=str(op.instance))
+            FED_AGE.set(2.0, peer=peer)
+            PULLS.inc(result=str(identity))
+        """,
+        ["SD010"],
+    )
+    assert len(findings) == 3
+    assert rules_of(findings) == ["SD010"]
+    assert "peer_label" in findings[0].message
+
+
+def test_sd010_silent_on_peer_label_and_non_peer_values(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        from spacedrive_tpu.telemetry.peers import peer_label
+
+        def record(op, stage, OPS, SYNC_LAG, SKEW):
+            OPS.inc(result="applied")          # constant — no peer shape
+            OPS.observe(0.1, stage=stage)      # dynamic but not peer-ish
+            SYNC_LAG.set(1.0, peer=peer_label(op.instance))
+            label = peer_label(op.instance)
+            SKEW.set(0.5, peer=label)
+        """,
+        ["SD010"],
+    )
+    assert findings == []
+
+
 # --- SD009 event-ring-cardinality -----------------------------------------
 
 

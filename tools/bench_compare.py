@@ -1,0 +1,159 @@
+"""bench_compare — turn the BENCH_r*.json pile into a gated signal.
+
+Each bench round drops a ``BENCH_r<NN>.json`` at the repo root; until
+now the perf trajectory lived in the reviewer's memory. This tool
+diffs the two most recent rounds and exits nonzero when a headline
+files/s throughput regressed by more than the threshold (default 15%),
+so ``make bench-check`` (and CI) observes the trajectory instead of
+trusting it.
+
+Comparison rules:
+
+- only *same-named* metrics compare — when the headline metric was
+  renamed between rounds (e.g. ``cas_id_blake3_throughput`` →
+  ``cas_id_e2e_throughput`` at the PR 3 rig change), the pair is
+  reported as incomparable, not as a 98% regression;
+- every throughput-shaped series is gated: the headline ``parsed
+  .value`` plus any numeric ``extras`` entry whose name marks a rate
+  (``*_files_per_s``, ``*_thumbs_per_s``, ``*_per_s``, ``*throughput*``,
+  ``*_gbps``) — cas_id and thumbnail rates ride the same rule;
+- runs flagged ``blocked`` (congested host→device link) gate only
+  device-side rates: e2e numbers under a congested link measure the
+  container's network weather, not the code.
+
+Usage:
+    python tools/bench_compare.py [--dir .] [--threshold 0.15] [old new]
+Exit codes: 0 ok / nothing to compare, 1 regression, 2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any
+
+DEFAULT_THRESHOLD = 0.15
+
+# extras whose name marks a higher-is-better rate
+_RATE_NAME = re.compile(
+    r"(_files_per_s|_thumbs_per_s|_clips_per_s|_per_s|throughput|_gbps)$"
+)
+# e2e rates that depend on the host→device link, skipped when either
+# run was marked blocked (link congestion is weather, not code)
+_LINK_BOUND = re.compile(r"(e2e|link_probe)")
+
+
+def _series(doc: dict[str, Any]) -> dict[str, float]:
+    """Comparable {name: value} rates from one BENCH_r JSON."""
+    parsed = doc.get("parsed") or {}
+    out: dict[str, float] = {}
+    metric, value = parsed.get("metric"), parsed.get("value")
+    if isinstance(metric, str) and isinstance(value, (int, float)):
+        out[metric] = float(value)
+    for k, v in (parsed.get("extras") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and _RATE_NAME.search(k):
+            out[f"extras.{k}"] = float(v)
+    return out
+
+
+def _blocked(doc: dict[str, Any]) -> bool:
+    return bool((doc.get("parsed") or {}).get("blocked"))
+
+
+def compare(old: dict[str, Any], new: dict[str, Any],
+            threshold: float = DEFAULT_THRESHOLD) -> dict[str, Any]:
+    """Diff two bench documents. Returns {checked, regressions,
+    skipped} where regressions is a list of {name, old, new, delta}."""
+    old_s, new_s = _series(old), _series(new)
+    link_excused = _blocked(old) or _blocked(new)
+    checked: list[dict[str, Any]] = []
+    regressions: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    for name in sorted(old_s):
+        if name not in new_s:
+            skipped.append(f"{name}: absent in newer run")
+            continue
+        if link_excused and _LINK_BOUND.search(name):
+            skipped.append(f"{name}: link-bound rate on a blocked run")
+            continue
+        ov, nv = old_s[name], new_s[name]
+        if ov <= 0:
+            skipped.append(f"{name}: non-positive baseline {ov}")
+            continue
+        delta = (nv - ov) / ov
+        rec = {"name": name, "old": ov, "new": nv,
+               "delta_pct": round(delta * 100, 2)}
+        checked.append(rec)
+        if delta < -threshold:
+            regressions.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "skipped": skipped}
+
+
+def latest_pair(bench_dir: str) -> tuple[str, str] | None:
+    files = sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json")))
+    if len(files) < 2:
+        return None
+    return files[-2], files[-1]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="explicit OLD NEW pair (default: two most recent "
+                         "BENCH_r*.json in --dir)")
+    ap.add_argument("--dir", default=".",
+                    help="where BENCH_r*.json live (default: cwd)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="fractional regression that fails the gate "
+                         "(default 0.15 = 15%%)")
+    args = ap.parse_args(argv)
+
+    if args.files and len(args.files) != 2:
+        print("bench-compare: pass exactly two files (old new), or none",
+              file=sys.stderr)
+        return 2
+    if args.files:
+        old_path, new_path = args.files
+    else:
+        pair = latest_pair(args.dir)
+        if pair is None:
+            print("bench-compare: fewer than two BENCH_r*.json rounds — "
+                  "nothing to gate")
+            return 0
+        old_path, new_path = pair
+
+    try:
+        with open(old_path) as f:
+            old = json.load(f)
+        with open(new_path) as f:
+            new = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench-compare: cannot read bench JSON: {e}", file=sys.stderr)
+        return 2
+
+    result = compare(old, new, args.threshold)
+    print(f"bench-compare: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)}  (gate: -{args.threshold:.0%})")
+    for rec in result["checked"]:
+        mark = "REGRESSION" if rec in result["regressions"] else "ok"
+        print(f"  {mark:>10}  {rec['name']}: {rec['old']:g} -> "
+              f"{rec['new']:g}  ({rec['delta_pct']:+.1f}%)")
+    for note in result["skipped"]:
+        print(f"     skipped  {note}")
+    if not result["checked"]:
+        print("  no comparable series (metric renamed between rounds?)")
+    if result["regressions"]:
+        print(f"bench-compare: {len(result['regressions'])} series regressed "
+              f"past the {args.threshold:.0%} gate", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,12 +5,24 @@ SD008  manually-opened resource (lock/span/file) not closed on the
        exception path
 SD009  event-ring emissions with non-constant event types / unauditable
        field expansion
+SD010  peer/instance identifiers fed into metric labels without the
+       ``peer_label`` short-hash
 
 SD007 keys off this repo's conventions: metric handles are ALL_CAPS
 module attributes (``metrics.SPAN_SECONDS``, ``THUMB_FILES``) and label
 values ride as keyword arguments to ``.inc()/.observe()/.set()``. The
 registry caps series per family as a backstop, but a capped-out family
 silently drops samples — better to catch the f-string at review time.
+One dynamic shape is sanctioned: ``telemetry.peers.peer_label(...)`` —
+the capped stable short-hash for per-peer series — either called
+directly in the keyword or assigned to a local first (``label =
+peer_label(x)``; same-function dataflow only).
+
+SD010 is the flip side: a label value whose expression touches a
+peer/instance-shaped identifier (``peer``, ``instance``, ``identity``,
+``pub_id``, ``node_id``, ``remote``) and is NOT routed through
+``peer_label`` leaks an unbounded long-lived identifier into the
+series space.
 
 SD009 extends the same discipline to the flight recorder
 (``telemetry.events``): ring handles are ``*_EVENTS`` constants (or
@@ -30,6 +42,9 @@ from ..core import FileContext, Finding, call_name, dotted_name, rule, walk_shal
 
 _RECORD_METHODS = {"inc", "observe", "set", "labels", "dec"}
 
+# the sanctioned per-peer label mapping (telemetry/peers.py)
+_PEER_LABEL_FUNC = "peer_label"
+
 
 def _is_metric_handle(expr: ast.AST) -> bool:
     """ALL_CAPS last path segment — the repo's metric-handle idiom."""
@@ -38,6 +53,48 @@ def _is_metric_handle(expr: ast.AST) -> bool:
         return False
     tail = name.rsplit(".", 1)[-1]
     return tail.isupper() and len(tail) > 1
+
+
+def _is_peer_label_call(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Call)
+        and (call_name(expr) or "").rsplit(".", 1)[-1] == _PEER_LABEL_FUNC
+    )
+
+
+def _peer_label_names(ctx: FileContext, scope: ast.AST | None) -> set[str]:
+    """Local names assigned from ``peer_label(...)`` in this scope —
+    the same-function dataflow that makes ``label = peer_label(x);
+    METRIC.set(v, peer=label)`` lint-clean."""
+    names: set[str] = set()
+    for node in walk_shallow(scope if scope is not None else ctx.tree):
+        if isinstance(node, ast.Assign) and _is_peer_label_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+class _ScopeSafeNames:
+    """Per-record-call lookup of peer_label-bound names, memoized by
+    enclosing scope so one file scan stays O(functions)."""
+
+    def __init__(self, ctx: FileContext):
+        self._ctx = ctx
+        self._cache: dict[int, set[str]] = {}
+
+    def for_call(self, node: ast.AST) -> set[str]:
+        scope = self._ctx.enclosing_function(node)
+        key = id(scope)
+        if key not in self._cache:
+            self._cache[key] = _peer_label_names(self._ctx, scope)
+        return self._cache[key]
+
+
+def _is_sanctioned_peer_value(value: ast.AST, safe_names: set[str]) -> bool:
+    return _is_peer_label_call(value) or (
+        isinstance(value, ast.Name) and value.id in safe_names
+    )
 
 
 def _label_hazard(value: ast.AST) -> str | None:
@@ -71,6 +128,7 @@ def _label_hazard(value: ast.AST) -> str | None:
     "cardinality until the registry cap silently drops samples",
 )
 def check_label_cardinality(ctx: FileContext) -> Iterator[Finding]:
+    safe = _ScopeSafeNames(ctx)
     for node in ast.walk(ctx.tree):
         if not (
             isinstance(node, ast.Call)
@@ -89,6 +147,11 @@ def check_label_cardinality(ctx: FileContext) -> Iterator[Finding]:
                     f"cardinality unauditable; pass explicit labels",
                 )
                 continue
+            if _is_sanctioned_peer_value(kw.value, safe.for_call(node)):
+                # peer_label(...) is the bounded per-peer scheme: 8-hex
+                # short-hash + the registry series cap (SD010 enforces
+                # the inverse — raw peer ids must not bypass it)
+                continue
             hazard = _label_hazard(kw.value)
             if hazard is not None:
                 yield ctx.finding(
@@ -98,6 +161,73 @@ def check_label_cardinality(ctx: FileContext) -> Iterator[Finding]:
                     f"{node.func.attr}` — label domains must be small and "
                     f"fixed (enum-like), or baselined with a bound "
                     f"justification",
+                )
+
+
+# -- SD010 ------------------------------------------------------------------
+
+# identifier fragments that mark a value as peer/instance-shaped
+_PEER_ID_TOKENS = ("peer", "instance", "identity", "pub_id", "node_id",
+                   "remote")
+
+
+def _peer_identifier_mention(expr: ast.AST,
+                             safe_names: set[str]) -> str | None:
+    """The first peer-shaped identifier referenced by ``expr`` outside
+    a ``peer_label(...)`` wrapping, or None. Subtrees under a
+    peer_label call are already hashed and don't count."""
+    stack = [expr]
+    while stack:
+        cur = stack.pop()
+        if _is_peer_label_call(cur):
+            continue  # hashed — don't descend
+        if isinstance(cur, ast.Name) and cur.id in safe_names:
+            continue
+        ident = None
+        if isinstance(cur, ast.Name):
+            ident = cur.id
+        elif isinstance(cur, ast.Attribute):
+            ident = cur.attr
+        if ident is not None and any(
+            tok in ident.lower() for tok in _PEER_ID_TOKENS
+        ):
+            return ident
+        stack.extend(ast.iter_child_nodes(cur))
+    return None
+
+
+@rule(
+    "SD010",
+    "peer-identifier-metric-label",
+    "metric labels fed from peer/instance identifiers must go through "
+    "telemetry.peers.peer_label — raw pub_ids/identities are unbounded "
+    "series AND leak long-lived identifiers into every scrape",
+)
+def check_peer_identifier_labels(ctx: FileContext) -> Iterator[Finding]:
+    safe = _ScopeSafeNames(ctx)
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _RECORD_METHODS
+            and _is_metric_handle(node.func.value)
+        ):
+            continue
+        handle = dotted_name(node.func.value)
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue  # SD007 already rejects ** expansion
+            if _is_sanctioned_peer_value(kw.value, safe.for_call(node)):
+                continue
+            mention = _peer_identifier_mention(kw.value, safe.for_call(node))
+            if mention is not None:
+                yield ctx.finding(
+                    "SD010",
+                    node,
+                    f"label `{kw.arg}=...` on `{handle}.{node.func.attr}` "
+                    f"is fed from peer identifier `{mention}` — wrap it in "
+                    f"telemetry.peers.peer_label(...) (capped stable "
+                    f"short-hash), never the raw id",
                 )
 
 
