@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check
+.PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check chaos
 
 lint:
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
@@ -24,6 +24,14 @@ bench-devices:
 	env XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		JAX_PLATFORMS=cpu SD_BENCH_SWEEP=1 SD_BENCH_FILES=512 \
 		SD_BENCH_REPEATS=2 $(PY) bench.py
+
+# chaos soak: the full fault-injection matrix — the fast deterministic
+# subset (also in tier-1) plus the multi-seed slow soak
+# (docs/robustness.md). Deterministic per seed; `-m ''` lifts the
+# default "not slow" filter so the matrix runs too.
+chaos:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py \
+		tests/test_resilience.py -q -m '' -p no:cacheprovider
 
 # perf trajectory gate: diff the two most recent BENCH_r*.json rounds,
 # fail on a >15% files/s regression in any comparable throughput series

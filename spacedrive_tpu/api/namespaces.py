@@ -820,10 +820,12 @@ def _cloud(r: Router) -> None:
         origin = node.config.config.preferences.get("cloud_api_origin")
         if not origin:
             return None
+        from ..utils.resilience import BreakerOpen
+
         client = CloudClient(origin)
         try:
             return await client.get_library(str(library.id))
-        except CloudApiError:
+        except (CloudApiError, BreakerOpen):
             return None
         finally:
             await client.close()
@@ -832,11 +834,13 @@ def _cloud(r: Router) -> None:
     async def enable(node, library):
         from ..cloud.api import CloudApiError
 
+        from ..utils.resilience import BreakerOpen
+
         try:
             cloud = await node.enable_cloud_sync(library)
         except ValueError as e:
             raise RspcError.bad_request(str(e))
-        except CloudApiError as e:
+        except (CloudApiError, BreakerOpen) as e:
             raise RspcError(502, f"cloud relay unreachable: {e}")
         return {"instance": str(library.sync.instance), "enabled": cloud is not None}
 

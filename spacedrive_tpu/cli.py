@@ -614,6 +614,15 @@ def cmd_debug_bundle(args: argparse.Namespace) -> int:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="sdx", description=__doc__)
     p.add_argument("--data-dir", default=DEFAULT_DATA_DIR)
+    p.add_argument(
+        "--faults", metavar="PLAN", default=None,
+        help="arm the fault-injection plane for this invocation "
+             "(chaos testing): \"point:mode[:k=v,...][;...]\" — see "
+             "docs/robustness.md; SD_FAULTS/SD_FAULT_SEED are the env "
+             "equivalents",
+    )
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="deterministic seed for --faults probabilities")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     ix = sub.add_parser("index", help="index a directory into a library")
@@ -792,6 +801,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    from .utils import faults as _faults
+
+    if getattr(args, "faults", None):
+        _faults.install(
+            _faults.FaultPlan.parse(args.faults, seed=args.fault_seed)
+        )
+    else:
+        _faults.install_from_env()
     if args.cmd == "index":
         return asyncio.run(cmd_index(args))
     if args.cmd == "serve":

@@ -5,6 +5,15 @@ Parity: ref:crates/cloud-api/src/lib.rs — `library::{create,get}`
 {request_add(push), get}` (:448,485) against the relay's REST surface.
 One aiohttp session per client; all methods raise `CloudApiError` on
 non-2xx like the reference's `Result<_, rspc::Error>` surface.
+
+Every request rides the shared relay resilience policy: bounded
+decorrelated-jitter retries on network failures and 5xx, a per-origin
+circuit breaker (a dead relay costs one fast ``BreakerOpen`` per
+cycle, not a timeout ladder), and ambient-deadline clipping. A 4xx is
+the CLIENT's error — it neither retries nor feeds the breaker. A
+mid-body EOF (``aiohttp`` payload error while reading the response)
+trips the breaker like any transport failure: a relay that truncates
+bodies is as dead as one that refuses connections.
 """
 
 from __future__ import annotations
@@ -15,11 +24,36 @@ from typing import Any
 import aiohttp
 
 from ..telemetry import trace as _trace
+from ..utils.resilience import (
+    PASS,
+    RETRY,
+    ResiliencePolicy,
+    RetryPolicy,
+)
 from .relay import INSTANCE_HEADER, TRACE_HEADER, b64, unb64
 
 
 class CloudApiError(Exception):
-    pass
+    def __init__(self, message: str, status: int | None = None):
+        super().__init__(message)
+        self.status = status  # None = transport-level failure
+
+
+def _relay_classify(exc: BaseException) -> str:
+    if isinstance(exc, CloudApiError) and exc.status is not None \
+            and exc.status < 500:
+        return PASS  # the relay answered; the request was bad — ours
+    return RETRY
+
+
+RELAY_POLICY = ResiliencePolicy(
+    "relay",
+    RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=2.0,
+                attempt_timeout=30.0),
+    failure_threshold=5,
+    reset_timeout=30.0,
+    classify=_relay_classify,
+)
 
 
 class CloudClient:
@@ -28,6 +62,15 @@ class CloudClient:
         self._session: aiohttp.ClientSession | None = None
 
     async def _request(
+        self, method: str, path: str, json: Any = None,
+        headers: dict[str, str] | None = None,
+    ) -> Any:
+        return await RELAY_POLICY.call(
+            self.origin,
+            lambda: self._request_once(method, path, json, headers),
+        )
+
+    async def _request_once(
         self, method: str, path: str, json: Any = None,
         headers: dict[str, str] | None = None,
     ) -> Any:
@@ -44,8 +87,12 @@ class CloudClient:
             ) as resp:
                 if resp.status >= 400:
                     raise CloudApiError(
-                        f"{method} {path} -> {resp.status}: {await resp.text()}"
+                        f"{method} {path} -> {resp.status}: {await resp.text()}",
+                        status=resp.status,
                     )
+                # reading the body can hit a mid-stream EOF — that is a
+                # transport failure (status=None), so it retries AND
+                # feeds the per-origin breaker
                 return await resp.json()
         except aiohttp.ClientError as e:
             raise CloudApiError(f"{method} {path} failed: {e}") from e

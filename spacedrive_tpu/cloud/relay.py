@@ -22,6 +22,7 @@ Endpoints (JSON bodies; op payloads base64):
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import itertools
 import json as _json
@@ -32,6 +33,34 @@ from aiohttp import web
 
 from ..telemetry import span as _span
 from ..telemetry import trace as _trace
+from ..utils import faults as _faults
+
+
+@web.middleware
+async def _fault_middleware(request: web.Request, handler):
+    """The ``relay.http`` injection point: 500s, slow responses, and
+    truncated bodies, exercised against the CLIENT's retry/breaker
+    policy (a production relay never ships with a plan installed)."""
+    spec = _faults.hit("relay.http")
+    if spec is None:
+        return await handler(request)
+    if spec.mode == "500":
+        return web.Response(status=500, text="injected relay failure")
+    if spec.mode == "timeout":
+        await asyncio.sleep(spec.delay_s)
+        return await handler(request)
+    # "truncate": advertise the full body, send half, then drop the
+    # connection — the client sees a mid-body EOF
+    resp = await handler(request)
+    body = resp.body if isinstance(resp.body, (bytes, bytearray)) else b""
+    out = web.StreamResponse(status=resp.status)
+    out.content_length = max(len(body), 2)
+    await out.prepare(request)
+    await out.write(bytes(body[: len(body) // 2]))
+    transport = request.transport
+    if transport is not None:
+        transport.close()
+    return out
 
 # HTTP header carrying the telemetry.trace wire dict (JSON) so relay
 # spans join the calling node's trace
@@ -55,7 +84,7 @@ class CloudRelay:
     def __init__(self, p2p_limits=None) -> None:
         self.libraries: dict[str, dict[str, Any]] = {}
         self._collection_ids = itertools.count(1)
-        self.app = web.Application()
+        self.app = web.Application(middlewares=[_fault_middleware])
         self.app.add_routes(
             [
                 web.post("/api/libraries", self._create_library),

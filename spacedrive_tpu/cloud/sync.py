@@ -24,6 +24,7 @@ from ..sync.hlc import NTP64
 from ..sync.ingest import receive_crdt_operation
 from ..sync.manager import SyncManager, _record_id_blob
 from ..telemetry import span as _span
+from ..utils.resilience import BreakerOpen
 from .api import CloudApiError, CloudClient
 
 logger = logging.getLogger(__name__)
@@ -98,7 +99,9 @@ class CloudSync:
         while not self._stopped:
             try:
                 await self._send_tick()
-            except CloudApiError as e:
+            except (CloudApiError, BreakerOpen, asyncio.TimeoutError) as e:
+                # expected while the relay is down / breaker-gated: the
+                # next tick (or the breaker's half-open probe) retries
                 logger.debug("cloud send failed: %s", e)
             except Exception:
                 logger.exception("cloud sender crashed; continuing")
@@ -145,7 +148,7 @@ class CloudSync:
         while not self._stopped:
             try:
                 await self._receive_tick()
-            except CloudApiError as e:
+            except (CloudApiError, BreakerOpen, asyncio.TimeoutError) as e:
                 logger.debug("cloud receive failed: %s", e)
             except Exception:
                 logger.exception("cloud receiver crashed; continuing")

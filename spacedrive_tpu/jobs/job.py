@@ -255,7 +255,12 @@ def status_for_result(status: "Any", had_errors: bool) -> JobStatus:
 
     if status == TaskStatus.DONE:
         return JobStatus.COMPLETED_WITH_ERRORS if had_errors else JobStatus.COMPLETED
-    if status == TaskStatus.CANCELED:
+    # FORCED_ABORTION is the task coroutine being cancelled out from
+    # under the job — loop teardown at node shutdown, or an explicit
+    # force-abort. Either way nothing *failed*: recording it as FAILED
+    # put a spurious `job.failed`-shaped settled event on the flight
+    # ring (and an error toast) every time a node shut down mid-job.
+    if status in (TaskStatus.CANCELED, TaskStatus.FORCED_ABORTION):
         return JobStatus.CANCELED
     if status in (TaskStatus.PAUSED, TaskStatus.SHUTDOWN):
         return JobStatus.PAUSED

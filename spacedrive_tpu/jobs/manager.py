@@ -108,7 +108,13 @@ class JobManager:
         report = ctx.report
         report.status = status_for_result(result.status, bool(job.errors))
         if result.status == TaskStatus.ERROR:
-            report.errors_text.append(str(result.error))
+            if isinstance(result.error, asyncio.CancelledError):
+                # a cancellation surfacing as ERROR (e.g. re-raised from
+                # inside the job body during node shutdown) is not a
+                # crash — no spurious failed transition, no error toast
+                report.status = JobStatus.CANCELED
+            else:
+                report.errors_text.append(str(result.error))
         if report.status == JobStatus.PAUSED:
             report.data = job.serialize_state()  # resume state
         else:

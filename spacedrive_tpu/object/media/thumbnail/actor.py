@@ -28,6 +28,7 @@ from typing import Any, Sequence
 from ....telemetry import metrics as _tm
 from ....telemetry import span
 from ....telemetry import trace as _trace
+from ....utils import faults as _faults
 from .process import (
     Decoded,
     ThumbError,
@@ -90,7 +91,22 @@ class Thumbnailer:
         # Crash recovery: previously queued batches resume as background,
         # and are re-persisted at once so a second crash before the first
         # batch completes still loses nothing (the load deleted the file).
+        # Entries whose thumbnail already landed in the store are dropped
+        # here: a crash between chunk store and journal write leaves the
+        # stored prefix inside the persisted batch, and re-decoding /
+        # re-resizing it would redo device work the store already holds.
         for b in load_state(self.data_dir):
+            kept = [
+                e for e in b.entries
+                if not self.store.exists(b.library_id, e[0])
+            ]
+            already = len(b.entries) - len(kept)
+            if already:
+                self.skipped += already
+                _tm.THUMB_FILES.inc(already, result="skipped")
+            if not kept:
+                continue
+            b.entries = kept
             b.background = True
             b.id = next(self._batch_ids)
             self._bg.append(b)
@@ -396,6 +412,18 @@ class Thumbnailer:
                 i for i, d in enumerate(decoded)
                 if d is not None and i not in device_idx
             ]
+            if device_idx and resized is None:
+                # the device stage failed past the degradation ladder:
+                # degrade the chunk to the CPU reference resize instead
+                # of erroring it — slower pixels beat missing thumbnails
+                from ....telemetry.events import RESILIENCE_EVENTS
+
+                RESILIENCE_EVENTS.emit(
+                    "thumbnail_cpu_fallback", entries=len(device_idx),
+                )
+                fallback = fallback + list(device_idx)
+                device_idx = []
+                ds = []
 
             async def _one_fallback(i):
                 async with sem:  # same host-thread budget as decode
@@ -415,30 +443,38 @@ class Thumbnailer:
 
             async with span("thumbnail.encode") as encode_span:
                 await asyncio.gather(*(_one_fallback(i) for i in fallback))
+                # device_idx is non-empty only when the device stage
+                # produced output — a wholesale failure was rerouted to
+                # the CPU fallback above
                 if device_idx:
-                    if resized is None:  # the device stage failed wholesale
-                        self.errors += len(device_idx)
-                        _tm.THUMB_FILES.inc(len(device_idx), result="error")
-                    else:
-                        try:
-                            webps = await asyncio.gather(
-                                *(
-                                    _one_finish(d, r)
-                                    for d, r in zip(ds, resized)
-                                )
+                    try:
+                        webps = await asyncio.gather(
+                            *(
+                                _one_finish(d, r)
+                                for d, r in zip(ds, resized)
                             )
-                            for i, webp in zip(device_idx, webps):
-                                self._store_one(
-                                    batch.library_id, chunk[i][0], webp)
-                        except Exception:
-                            logger.exception("thumbnail encode chunk failed")
-                            self.errors += len(device_idx)
-                            _tm.THUMB_FILES.inc(
-                                len(device_idx), result="error")
+                        )
+                        for i, webp in zip(device_idx, webps):
+                            self._store_one(
+                                batch.library_id, chunk[i][0], webp)
+                    except Exception:
+                        logger.exception("thumbnail encode chunk failed")
+                        self.errors += len(device_idx)
+                        _tm.THUMB_FILES.inc(
+                            len(device_idx), result="error")
             _tm.THUMB_STAGE_SECONDS.observe(
                 encode_span.duration, stage="encode")
             _tm.PIPELINE_HOST_SECONDS.observe(
                 encode_span.duration, pipeline="thumbnail")
+            if _faults.hit("thumbnail.persist") is not None:
+                # simulated process death in the window between "chunk
+                # stored" and "journal dropped it": InjectedCrash is a
+                # BaseException, so no recovery path below can absorb it
+                # — only a fresh actor (standing in for a fresh process)
+                # resumes, and the resume filter must skip this chunk
+                raise _faults.InjectedCrash(
+                    "injected crash between chunk store and journal write"
+                )
             done += len(chunk)
             # only now may the resume state drop this chunk
             batch.entries = entries[done:]

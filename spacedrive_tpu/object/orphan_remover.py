@@ -47,13 +47,19 @@ class OrphanRemoverActor:
         self.debounce = debounce
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
+        self._stopped = False
         self._last_checked = 0.0
 
     def start(self) -> None:
         if self._task is None:
+            self._stopped = False
             self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def stop(self) -> None:
+        # cooperative flag first (sdlint SD011: the tick loop must have
+        # a stop condition of its own), cancel as the fast path
+        self._stopped = True
+        self._wake.set()
         if self._task is not None:
             self._task.cancel()
             try:
@@ -66,11 +72,13 @@ class OrphanRemoverActor:
         self._wake.set()
 
     async def _run(self) -> None:
-        while True:
+        while not self._stopped:
             try:
                 await asyncio.wait_for(self._wake.wait(), timeout=self.tick_interval)
             except asyncio.TimeoutError:
                 pass
+            if self._stopped:
+                return
             self._wake.clear()
             if time.monotonic() - self._last_checked > self.debounce:
                 try:

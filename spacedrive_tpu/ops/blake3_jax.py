@@ -404,6 +404,16 @@ def hash_batch(msgs, lengths, max_chunks: int | None = None,
     single-device dispatch byte-for-byte."""
     import numpy as np
 
+    from ..utils import faults as _faults
+
+    spec = _faults.hit("device.blake3")
+    if spec is not None:
+        if spec.mode == "raise":
+            raise _faults.InjectedFault("injected device failure (blake3)")
+        if spec.mode == "xla":
+            raise _faults.device_error("device.blake3")
+        # "wrong_shape" falls through and truncates the result below —
+        # exercising the caller-side digest-shape validation (cas)
     if not hasattr(msgs, "dtype"):  # lists / bytes-likes
         msgs = np.asarray(msgs, np.uint8)
     if isinstance(msgs, np.ndarray) and msgs.dtype == np.uint8:
@@ -414,6 +424,7 @@ def hash_batch(msgs, lengths, max_chunks: int | None = None,
         words_per_chunk = 256 if msgs.dtype == jnp.uint32 else CHUNK_LEN
         max_chunks = msgs.shape[1] // words_per_chunk
     lengths = jnp.asarray(lengths, jnp.int32)
+    out = None
     if devices is not None and len(devices) > 1:
         devices = list(devices)
         if msgs.shape[0] % len(devices):
@@ -421,21 +432,34 @@ def hash_batch(msgs, lengths, max_chunks: int | None = None,
                 f"batch of {msgs.shape[0]} rows does not divide over "
                 f"{len(devices)} devices — pad through pack_canonical_batch"
             )
-        return _hash_batch_sharded(
+        out = _hash_batch_sharded(
             msgs, lengths, max_chunks, devices, donate_input
         )
-    mode = _resolve_pallas_mode()
-    if mode is not None:
-        try:
-            return _hash_batch_impl_modes[mode](msgs, lengths, max_chunks=max_chunks)
-        except Exception:  # Mosaic/compile/runtime failure → XLA path
-            import logging
+    elif devices is not None and len(devices) == 1:
+        # pin the single-device dispatch to THIS device (the ladder's
+        # surviving chip) — committed inputs make jit execute there,
+        # instead of on a default device that may be the dead one
+        msgs = jax.device_put(msgs, devices[0])
+        lengths = jax.device_put(lengths, devices[0])
+    if out is None:
+        mode = _resolve_pallas_mode()
+        if mode is not None:
+            try:
+                out = _hash_batch_impl_modes[mode](
+                    msgs, lengths, max_chunks=max_chunks
+                )
+            except Exception:  # Mosaic/compile/runtime failure → XLA path
+                import logging
 
-            logging.getLogger(__name__).exception(
-                "pallas blake3 failed; falling back to XLA permanently"
-            )
-            _pallas_disabled[0] = True
-    return _hash_batch_impl_modes[None](msgs, lengths, max_chunks=max_chunks)
+                logging.getLogger(__name__).exception(
+                    "pallas blake3 failed; falling back to XLA permanently"
+                )
+                _pallas_disabled[0] = True
+    if out is None:
+        out = _hash_batch_impl_modes[None](msgs, lengths, max_chunks=max_chunks)
+    if spec is not None and spec.mode == "wrong_shape":
+        out = out[:, :4]
+    return out
 
 
 def words_to_digests(words, out_len: int = 32) -> list[bytes]:

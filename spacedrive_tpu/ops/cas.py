@@ -165,7 +165,8 @@ def shard_occupancy(n_real: int, n_pad: int, n_dev: int) -> list[float]:
 
 
 def cas_ids_begin(
-    messages: Sequence[bytes], devices: Sequence[Any] | None = None
+    messages: Sequence[bytes], devices: Sequence[Any] | None = None,
+    _depth: int = 0,
 ) -> Callable[[], list[str]]:
     """Dispatch device hashing WITHOUT blocking: batches go to the
     accelerator asynchronously (JAX dispatch) and the returned finisher
@@ -179,16 +180,50 @@ def cas_ids_begin(
     `devices` always shard; the default policy shards a batch only when
     it fills at least half of the smallest sharded ladder rung
     (BATCH_LADDER[0] × n_devices ÷ 2) — tiny tails stay on one device
-    where their warm 32-row shape is cheapest."""
+    where their warm 32-row shape is cheapest.
+
+    Auto dispatches ride the degradation ladder (parallel.mesh.LADDER):
+    a device failure demotes the NEXT attempt — full mesh → surviving
+    chip subset → host reference path — and the failed batch is re-run
+    at the demoted rung inside the same `finish()` call instead of
+    failing the window (the host path is bit-identical, golden-tested).
+    Explicit `devices` stay strict and re-raise."""
+    from ..parallel import mesh as _mesh
+
     if devices is not None:
         devs = list(devices)
         explicit = True
+        level: int | None = None
     else:
-        from ..parallel.mesh import dispatch_devices
-
-        devs = dispatch_devices()
         explicit = False
+        if _depth >= 3:
+            # recursion cap: go straight to the host path WITHOUT
+            # consulting the ladder — ladder_devices() could hand this
+            # doomed call the half-open probe and strand it
+            from ..telemetry import metrics as _tm
+
+            _tm.CAS_BACKEND_FALLBACK.inc()
+            return lambda: cas_ids(messages, "cpu")
+        devs, level = _mesh.ladder_devices()
+        if level == _mesh.LEVEL_HOST:
+            # demoted to (or stuck on) the host reference path — count
+            # the degradation so a node quietly hashing on CPU shows up
+            from ..telemetry import metrics as _tm
+
+            _tm.CAS_BACKEND_FALLBACK.inc()
+            return lambda: cas_ids(messages, "cpu")
     n_dev = len(devs)
+
+    def _retry_demoted(exc: Exception) -> Callable[[], list[str]]:
+        from ..telemetry import events as _events
+        from ..telemetry import metrics as _tm
+
+        _mesh.LADDER.record_failure(level, devs)
+        _tm.CAS_BACKEND_FALLBACK.inc()
+        _events.record_error("cas.ladder", exc)
+        # bounded re-dispatch at the demoted rung (depth caps probe
+        # oscillation when a test-sized reset_timeout is in effect)
+        return cas_ids_begin(messages, _depth=_depth + 1)
 
     buckets: dict[int, _Bucket] = {}
     for i, msg in enumerate(messages):
@@ -199,38 +234,76 @@ def cas_ids_begin(
 
     step = device_batch(n_dev)
     in_flight: list[tuple[_Bucket, int, Any]] = []
-    for c, bucket in sorted(buckets.items()):
-        for off in range(0, len(bucket.messages), step):
-            part = bucket.messages[off : off + step]
-            # shard-declined parts MUST fit the single-device pack cap:
-            # with step = DEVICE_BATCH × n_dev a part can exceed
-            # DEVICE_BATCH, so anything over the cap shards regardless
-            # of the occupancy heuristic (only reachable at >64 devices)
-            shard = n_dev > 1 and (
-                explicit
-                or len(part) * 2 >= n_dev * BATCH_LADDER[0]
-                or len(part) > DEVICE_BATCH
-            )
-            arr, lens = pack_canonical_batch(
-                part, c, n_devices=n_dev if shard else 1
-            )
-            if shard:
-                from ..telemetry import metrics as _tm
+    used_devices = False  # did any part actually shard over `devs`?
+    try:
+        for c, bucket in sorted(buckets.items()):
+            for off in range(0, len(bucket.messages), step):
+                part = bucket.messages[off : off + step]
+                # shard-declined parts MUST fit the single-device pack cap:
+                # with step = DEVICE_BATCH × n_dev a part can exceed
+                # DEVICE_BATCH, so anything over the cap shards regardless
+                # of the occupancy heuristic (only reachable at >64 devices)
+                shard = n_dev > 1 and (
+                    explicit
+                    or len(part) * 2 >= n_dev * BATCH_LADDER[0]
+                    or len(part) > DEVICE_BATCH
+                )
+                # at the SUBSET rung an unsharded tail must still land
+                # on a SURVIVING chip, not the (possibly dead) default
+                # device — pin it to the subset's first device
+                single = (
+                    devs[:1]
+                    if not shard and not explicit
+                    and level == _mesh.LEVEL_SUBSET and devs
+                    else None
+                )
+                used_devices = used_devices or shard or single is not None
+                arr, lens = pack_canonical_batch(
+                    part, c, n_devices=n_dev if shard else 1
+                )
+                if shard:
+                    from ..telemetry import metrics as _tm
 
-                for frac in shard_occupancy(len(part), arr.shape[0], n_dev):
-                    _tm.DEVICE_DISPATCH_OCCUPANCY.observe(frac, op="blake3")
-            in_flight.append(
-                (bucket, off, blake3_jax.hash_batch(
-                    arr, lens, max_chunks=c, devices=devs if shard else None
-                ))
-            )
+                    for frac in shard_occupancy(len(part), arr.shape[0], n_dev):
+                        _tm.DEVICE_DISPATCH_OCCUPANCY.observe(frac, op="blake3")
+                in_flight.append(
+                    (bucket, off, blake3_jax.hash_batch(
+                        arr, lens, max_chunks=c,
+                        devices=devs if shard else single,
+                    ))
+                )
+    except Exception as exc:  # noqa: BLE001 - dispatch failure → demote
+        if explicit:
+            raise
+        return _retry_demoted(exc)
 
     def finish() -> list[str]:
         out: list[str | None] = [None] * len(messages)
-        for bucket, off, words in in_flight:
-            part = bucket.indices[off : off + step]
-            for j, hx in enumerate(blake3_jax.words_to_hex(words, 16)[: len(part)]):
-                out[part[j]] = hx
+        try:
+            for bucket, off, words in in_flight:
+                part = bucket.indices[off : off + step]
+                if getattr(words, "ndim", 2) != 2 or words.shape[1] != 8 \
+                        or words.shape[0] < len(part):
+                    raise ValueError(
+                        f"device returned wrong-shaped digest batch "
+                        f"{getattr(words, 'shape', '?')} for {len(part)} rows"
+                    )
+                for j, hx in enumerate(
+                    blake3_jax.words_to_hex(words, 16)[: len(part)]
+                ):
+                    out[part[j]] = hx
+        except Exception as exc:  # noqa: BLE001 - materialization → demote
+            if explicit:
+                raise
+            return _retry_demoted(exc)()
+        if not explicit:
+            if used_devices:
+                _mesh.LADDER.record_success(level)
+            else:
+                # the whole call ran unsharded on the default device —
+                # it proved nothing about the rung's chips, so a held
+                # half-open probe is released, never promoted
+                _mesh.LADDER.probe_inconclusive(level)
         return out  # type: ignore[return-value]
 
     return finish

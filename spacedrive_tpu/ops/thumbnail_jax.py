@@ -171,6 +171,85 @@ def _auto_devices(n_rows: int):
     return devs if len(devs) > 1 and n_rows >= len(devs) else None
 
 
+def _resize_bucket(
+    images, targets, flip, idxs, bh: int, bw: int, out_size: int, devs
+) -> np.ndarray:
+    """Pack one bucket's canvases and run its device call; returns the
+    [bpad, out, out, 4] uint8 result (validated — a device returning
+    the wrong shape is an error the caller can demote on, never a
+    silent corruption)."""
+    from ..utils import faults as _faults
+
+    n_dev = len(devs) if devs else 1
+    # Pad the batch dim to the next power of two so compile count is
+    # bounded at (buckets × log2 max-batch) programs, not one per
+    # arbitrary group size; a sharded call also rounds up to the
+    # device count so rows divide evenly over the mesh.
+    bpad = 1 << max(0, (len(idxs) - 1).bit_length())
+    if n_dev > 1:
+        bpad = max(bpad, n_dev)
+        bpad += (-bpad) % n_dev
+    canv = np.zeros((bpad, bh, bw, 4), np.uint8)
+    scales = np.ones((bpad, 2), np.float32)
+    for j, i in enumerate(idxs):
+        img = images[i]
+        th, tw = targets[i]
+        if flip[i]:
+            img = np.transpose(img, (1, 0, 2))
+            th, tw = tw, th
+        h, w = img.shape[:2]
+        # Edge-replicate into the padding so the antialias window
+        # clamps at the image boundary instead of pulling in zeros
+        # (the reference resampler clamps at edges too).
+        canv[j, :h, :w] = img
+        canv[j, h:, :w] = img[h - 1 : h, :]
+        canv[j, :h, w:] = img[:, w - 1 : w]
+        canv[j, h:, w:] = img[h - 1, w - 1]
+        scales[j] = (th / h, tw / w)
+    spec = _faults.hit("device.thumbnail")
+    if spec is not None:
+        if spec.mode == "raise":
+            raise _faults.InjectedFault("injected device failure (thumbnail)")
+        if spec.mode == "xla":
+            raise _faults.device_error("device.thumbnail")
+    if n_dev > 1:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..telemetry import metrics as _tm
+        from .cas import shard_occupancy
+
+        mesh, fn = _resize_fn_sharded(devs)
+        _tm.SHARD_BATCH_ROWS.observe(bpad // n_dev, op="thumbnail")
+        for frac in shard_occupancy(len(idxs), bpad, n_dev):
+            _tm.DEVICE_DISPATCH_OCCUPANCY.observe(frac, op="thumbnail")
+        sh = NamedSharding(mesh, P("dp"))
+        out = np.asarray(fn(
+            jax.device_put(canv, sh),
+            jax.device_put(scales, sh),
+            out_size=out_size,
+        ))
+    elif devs:
+        # single surviving device: committed inputs pin the jit there,
+        # not on a default device that may be the dead one
+        import jax
+
+        out = np.asarray(_resize_fn()(
+            jax.device_put(canv, devs[0]), jax.device_put(scales, devs[0]),
+            out_size=out_size,
+        ))
+    else:
+        out = np.asarray(_resize_fn()(canv, scales, out_size=out_size))
+    if spec is not None and spec.mode == "wrong_shape":
+        out = out[:, : out_size // 2]
+    if out.shape != (bpad, out_size, out_size, 4):
+        raise ValueError(
+            f"device resize returned shape {out.shape}, "
+            f"expected {(bpad, out_size, out_size, 4)}"
+        )
+    return out
+
+
 def resize_batch(
     images: Sequence[np.ndarray],
     targets: Sequence[tuple[int, int]],
@@ -187,7 +266,13 @@ def resize_batch(
     With >1 local device (or an explicit `devices` list) the batch dim
     of each bucket call dp-shards over the chip mesh — one dispatch,
     every chip resizing its slice of the canvases.
-    """
+
+    Auto dispatches ride the degradation ladder (parallel.mesh.LADDER):
+    a failed bucket call demotes — full mesh → surviving subset →
+    single default device (the per-image math is identical at every
+    rung, so pixels never change) — and the bucket re-runs at the
+    demoted rung instead of failing the chunk. Explicit `devices` stay
+    strict and re-raise."""
     results: list[np.ndarray | None] = [None] * len(images)
     by_bucket: dict[tuple[int, int], list[int]] = {}
     flip: list[bool] = [False] * len(images)
@@ -202,52 +287,50 @@ def resize_batch(
         by_bucket.setdefault(b, []).append(i)
 
     for (bh, bw), idxs in by_bucket.items():
-        devs = list(devices) if devices is not None else _auto_devices(len(idxs))
-        n_dev = len(devs) if devs else 1
-        # Pad the batch dim to the next power of two so compile count is
-        # bounded at (buckets × log2 max-batch) programs, not one per
-        # arbitrary group size; a sharded call also rounds up to the
-        # device count so rows divide evenly over the mesh.
-        bpad = 1 << max(0, (len(idxs) - 1).bit_length())
-        if n_dev > 1:
-            bpad = max(bpad, n_dev)
-            bpad += (-bpad) % n_dev
-        canv = np.zeros((bpad, bh, bw, 4), np.uint8)
-        scales = np.ones((bpad, 2), np.float32)
-        for j, i in enumerate(idxs):
-            img = images[i]
-            th, tw = targets[i]
-            if flip[i]:
-                img = np.transpose(img, (1, 0, 2))
-                th, tw = tw, th
-            h, w = img.shape[:2]
-            # Edge-replicate into the padding so the antialias window
-            # clamps at the image boundary instead of pulling in zeros
-            # (the reference resampler clamps at edges too).
-            canv[j, :h, :w] = img
-            canv[j, h:, :w] = img[h - 1 : h, :]
-            canv[j, :h, w:] = img[:, w - 1 : w]
-            canv[j, h:, w:] = img[h - 1, w - 1]
-            scales[j] = (th / h, tw / w)
-        if n_dev > 1:
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from ..telemetry import metrics as _tm
-            from .cas import shard_occupancy
-
-            mesh, fn = _resize_fn_sharded(devs)
-            _tm.SHARD_BATCH_ROWS.observe(bpad // n_dev, op="thumbnail")
-            for frac in shard_occupancy(len(idxs), bpad, n_dev):
-                _tm.DEVICE_DISPATCH_OCCUPANCY.observe(frac, op="thumbnail")
-            sh = NamedSharding(mesh, P("dp"))
-            out = np.asarray(fn(
-                jax.device_put(canv, sh),
-                jax.device_put(scales, sh),
-                out_size=out_size,
-            ))
+        if devices is not None:
+            out = _resize_bucket(
+                images, targets, flip, idxs, bh, bw, out_size, list(devices)
+            )
         else:
-            out = np.asarray(_resize_fn()(canv, scales, out_size=out_size))
+            from ..parallel import mesh as _mesh
+
+            # bounded: one attempt per rung plus one half-open probe —
+            # a tiny reset_timeout must not oscillate probe/demote forever
+            for attempt in range(4):
+                devs, level = _mesh.ladder_devices()
+                if (
+                    level < _mesh.LEVEL_HOST
+                    and len(devs) > 1 and len(idxs) >= len(devs)
+                ):
+                    use = devs
+                elif level == _mesh.LEVEL_SUBSET and devs:
+                    # unsharded at the subset rung: still pin to a
+                    # surviving chip, never the (possibly dead) default
+                    use = devs[:1]
+                else:
+                    use = None
+                try:
+                    out = _resize_bucket(
+                        images, targets, flip, idxs, bh, bw, out_size, use
+                    )
+                except Exception as exc:  # noqa: BLE001 - demote & retry
+                    # always settle the ladder bookkeeping (a probe left
+                    # unreported would block re-arming), THEN decide
+                    # whether anything is left to demote to
+                    _mesh.LADDER.record_failure(level, devs)
+                    if level >= _mesh.LEVEL_HOST or attempt == 3:
+                        raise
+                    from ..telemetry import events as _events
+
+                    _events.record_error("thumbnail.ladder", exc)
+                    continue
+                if use is not None:
+                    _mesh.LADDER.record_success(level)
+                else:
+                    # ran on the single default device — says nothing
+                    # about the rung's chips; release a held probe
+                    _mesh.LADDER.probe_inconclusive(level)
+                break
         for j, i in enumerate(idxs):
             th, tw = targets[i]
             if flip[i]:

@@ -22,6 +22,14 @@ from ..telemetry import span as _span
 from ..telemetry import trace as _trace
 from ..telemetry.events import P2P_EVENTS
 from ..telemetry.federation import FederationCache, local_snapshot, snapshot_compatible
+from ..utils import faults as _faults
+from ..utils.resilience import (
+    PASS,
+    RETRY,
+    BreakerOpen,
+    ResiliencePolicy,
+    RetryPolicy,
+)
 from ..utils.tasks import supervise
 from .identity import RemoteIdentity
 from .mdns import MdnsDiscovery
@@ -38,6 +46,30 @@ from .sync import alert_new_ops, request_ops_from_peer, respond_sync_request
 from .wire import Writer
 
 logger = logging.getLogger(__name__)
+
+
+def _peer_classify(exc: BaseException) -> str:
+    """Retry/breaker classification for peer-facing calls: transport
+    failures retry and count; an ANSWER we dislike (refusal, version
+    mismatch) passes through untouched — a peer that responds is not a
+    peer whose breaker should open."""
+    if isinstance(exc, (PermissionError, ValueError)):
+        return PASS
+    return RETRY
+
+
+# One bounded, jittered retry ladder + per-peer breaker for every
+# sync-plane exchange (alerts, op pulls, telemetry pulls): a flapping
+# peer costs one fast BreakerOpen per write instead of a fresh dial +
+# timeout, and re-arms itself through the breaker's half-open probe.
+SYNC_POLICY = ResiliencePolicy(
+    "p2p_sync",
+    RetryPolicy(max_attempts=2, base_delay=0.05, max_delay=0.5,
+                attempt_timeout=30.0),
+    failure_threshold=3,
+    reset_timeout=15.0,
+    classify=_peer_classify,
+)
 
 
 class P2PManager:
@@ -141,10 +173,20 @@ class P2PManager:
         async def request_ops(timestamps, count, lib_id=lib.id):
             for peer in self.peers_for_library(lib_id):
                 try:
-                    return await request_ops_from_peer(
-                        self.p2p, peer.identity, lib_id, timestamps, count
+                    # EOFError covers IncompleteReadError: a peer
+                    # vanishing mid-SYNC is a failed (retryable) pull,
+                    # not an unhandled ingest-tick crash
+                    return await SYNC_POLICY.call(
+                        str(peer.identity),
+                        lambda peer=peer: request_ops_from_peer(
+                            self.p2p, peer.identity, lib_id, timestamps,
+                            count,
+                        ),
                     )
-                except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                except BreakerOpen:
+                    continue  # fast-failed: try the next peer
+                except (ConnectionError, OSError, EOFError,
+                        asyncio.TimeoutError) as e:
                     logger.debug("sync pull from %s failed: %s", peer.identity, e)
             return [], False
 
@@ -187,8 +229,16 @@ class P2PManager:
     async def _alert_peers(self, library_id: uuid.UUID) -> None:
         for peer in self.peers_for_library(library_id):
             try:
-                await alert_new_ops(self.p2p, peer.identity, library_id)
-            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                await SYNC_POLICY.call(
+                    str(peer.identity),
+                    lambda peer=peer: alert_new_ops(
+                        self.p2p, peer.identity, library_id
+                    ),
+                )
+            except BreakerOpen:
+                continue  # alerts are idempotent nudges; skip fast
+            except (ConnectionError, OSError, EOFError,
+                    asyncio.TimeoutError) as e:
                 logger.debug("sync alert to %s failed: %s", peer.identity, e)
 
     def peers_for_library(self, library_id: uuid.UUID) -> list[Any]:
@@ -245,12 +295,19 @@ class P2PManager:
         # stream mid-response is a failed pull, not a /mesh 500)
         async def pull(peer: Any) -> tuple[Any, str] | None:
             try:
-                snap = await request_telemetry(self.p2p, peer.identity)
+                snap = await SYNC_POLICY.call(
+                    str(peer.identity),
+                    lambda peer=peer: request_telemetry(
+                        self.p2p, peer.identity
+                    ),
+                )
                 self.federation.store(str(peer.identity), snap,
                                       transport="p2p")
                 return None
             except (ConnectionError, OSError, EOFError,
                     asyncio.TimeoutError, ValueError) as e:
+                # BreakerOpen is a ConnectionError: a breaker-gated peer
+                # still falls through to the relay leg below
                 return (peer, str(e))
 
         results = await asyncio.gather(*(pull(p) for p in due))
@@ -334,6 +391,9 @@ class P2PManager:
             with _span("p2p.spacedrop_receive"):
                 await self.spacedrop.handle_inbound(stream, header.spacedrop)
         elif header.type == HeaderType.SYNC:
+            if _faults.hit("p2p.sync_serve") is not None:
+                await stream.close()  # peer "vanishes" before the ack
+                return
             with _span("p2p.sync_notify"):
                 w = Writer(stream)
                 w.u8(0x01)
@@ -342,6 +402,9 @@ class P2PManager:
                 if actor is not None:
                     actor.notify(trace_ctx=wire_ctx)
         elif header.type == HeaderType.SYNC_REQUEST:
+            if _faults.hit("p2p.sync_serve") is not None:
+                await stream.close()  # peer "vanishes" mid-exchange
+                return
             lib = self.node.libraries.get(header.library_id)
             if lib is not None:
                 with _span("p2p.sync_serve"):

@@ -20,12 +20,24 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..telemetry import trace as _trace
+from ..utils.resilience import ResiliencePolicy, RetryPolicy
 from .block import BlockSize, Range, SpaceblockRequest, SpaceblockRequests, Transfer
 from .identity import RemoteIdentity
 from .protocol import FileRequest, Header, HeaderType
 from .wire import Reader, Writer
 
 SPACEDROP_TIMEOUT = 60.0  # ref:spacedrop.rs user-decision timeout
+
+# Connection-establishment leg only: once the remote user's dialog is
+# in play, retrying would re-prompt them — the transfer itself stays
+# single-shot. The breaker keeps repeated sends to a gone peer cheap.
+SPACEDROP_POLICY = ResiliencePolicy(
+    "spacedrop",
+    RetryPolicy(max_attempts=3, base_delay=0.1, max_delay=1.0,
+                attempt_timeout=15.0),
+    failure_threshold=3,
+    reset_timeout=15.0,
+)
 
 
 async def ping(p2p: Any, identity: RemoteIdentity) -> float:
@@ -79,7 +91,9 @@ class SpacedropManager:
                 for p, s in zip(paths, sizes)
             ],
         )
-        stream = await self.p2p.new_stream(identity)
+        stream = await SPACEDROP_POLICY.call(
+            str(identity), lambda: self.p2p.new_stream(identity)
+        )
         cancel = asyncio.Event()
         self._cancel[requests.id] = cancel
         try:

@@ -140,6 +140,12 @@ class P2P:
         peer: direct LAN addresses first, then the WAN relay fallback
         (ref:p2p2 `Peer::new_stream`; relayed parity with
         quic/transport.rs:212,344)."""
+        from ..utils import faults as _faults
+
+        if _faults.hit("p2p.connect") is not None:
+            raise ConnectionResetError(
+                f"injected connection reset dialing {identity}"
+            )
         peer = self.peers.get(identity)
         if peer is None or not peer.is_discovered:
             raise ConnectionError(f"peer {identity} not discovered")

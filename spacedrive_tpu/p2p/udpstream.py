@@ -700,6 +700,19 @@ class UdpStream:
     def write(self, data: bytes) -> None:
         if self._closed or self._fin_sent:
             raise UdpStreamError("udp stream closed")
+        from ..utils import faults as _faults
+
+        spec = _faults.hit("p2p.write")
+        if spec is not None:
+            if spec.mode == "partial":
+                # first segment goes out ON THE WIRE, then the
+                # "connection" dies — the peer sees a truncated message,
+                # this side an error. Transmitted synchronously: a
+                # queued write would be discarded by _fail() below
+                # before the sender task ever ran.
+                self._transmit(DATA, bytes(memoryview(bytes(data))[:MSS]))
+            self._fail(UdpStreamError("injected connection reset"))
+            raise UdpStreamError("injected connection reset")
         view = memoryview(bytes(data))
         for off in range(0, max(len(view), 1), MSS):
             self._pending_writes.append(bytes(view[off:off + MSS]))

@@ -86,6 +86,10 @@ class WindowPipeline(Generic[T]):
         self._done = False
         self._fetch = fetch
         self._error: BaseException | None = None
+        # one restart is cheap insurance against a transient producer
+        # crash (fetches are side-effect-free, so re-reading the failed
+        # window is safe); a second crash surfaces to the consumer
+        self._restarts_left = 1
         # the producer thread starts with empty contextvars — carry the
         # constructing task's trace across so feeder.fetch spans join it
         self._trace_ctx = _trace.current()
@@ -96,10 +100,20 @@ class WindowPipeline(Generic[T]):
         self._thread.start()
 
     def _run(self, key: Any) -> None:
+        from ..utils import faults as _faults
+
         if self._trace_ctx is not None:
             _trace.set_current(self._trace_ctx)
         try:
             while not self._stop.is_set():
+                spec = _faults.hit("feeder.fetch")
+                if spec is not None:
+                    if spec.mode == "stall":
+                        time.sleep(spec.delay_s)
+                    elif spec.mode == "crash":
+                        raise _faults.InjectedFault(
+                            "injected feeder producer crash"
+                        )
                 t0 = time.perf_counter()
                 with _span("feeder.fetch"):
                     item = self._fetch(key)
@@ -118,9 +132,33 @@ class WindowPipeline(Generic[T]):
                         pass
                 if not self._put(window):
                     return
-        except BaseException as e:  # surfaced to the consumer on take()
+        except BaseException as e:
+            if self._restart(key, e):
+                return
+            # restart budget spent: surfaced to the consumer on take()
             self._error = e
             self._put(None)
+
+    def _restart(self, key: Any, exc: BaseException) -> bool:
+        """Re-spawn the producer once after a crash, resuming at the
+        window whose fetch failed (fetches are side-effect-free per the
+        class contract). Returns False when the budget is spent — the
+        caller then surfaces the error."""
+        from ..telemetry.events import RESILIENCE_EVENTS
+
+        if self._stop.is_set() or self._restarts_left <= 0:
+            return False
+        self._restarts_left -= 1
+        _tm.FEEDER_RESTARTS.inc()
+        RESILIENCE_EVENTS.emit(
+            "feeder_restart", error=str(exc)[:200],
+        )
+        self._thread = threading.Thread(
+            target=self._run, args=(key,), name="sd-window-pipeline",
+            daemon=True,
+        )
+        self._thread.start()
+        return True
 
     def _put(self, item) -> bool:
         """Park one window (or the end-of-stream sentinel) for the

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
+import time
 from typing import Any, Sequence
 
 import numpy as np
@@ -118,6 +120,195 @@ def dispatch_devices() -> list:
         return list(jax.devices())
     except Exception:  # noqa: BLE001
         return []
+
+
+# --- graceful degradation ladder (utils/resilience + utils/faults) ---------
+
+LEVEL_MESH = 0      # full dp mesh — every local device
+LEVEL_SUBSET = 1    # surviving chip subset (per-device probe survivors)
+LEVEL_HOST = 2      # host reference path — no device dispatch at all
+
+
+class DeviceLadder:
+    """Demotion ladder for device dispatch: all chips → surviving chip
+    subset → host reference path — a failed batch degrades instead of
+    failing the job.
+
+    Callers take ``(devices, level)`` from :meth:`filter` and report
+    the dispatch outcome back via :meth:`record_success` /
+    :meth:`record_failure`. Demotion probes each device individually
+    (one tiny transfer+readback, routed through the ``device.probe``
+    fault point so chaos tests pick which chips "die") and keeps the
+    survivors. After ``reset_timeout`` the ladder hands out ONE
+    half-open probe dispatch at the next level up; its success re-arms
+    (promotes), its failure restarts the clock — the same breaker
+    discipline as ``utils.resilience.CircuitBreaker``, but over ladder
+    rungs instead of a binary gate.
+
+    Every transition updates ``sd_device_demotion_level`` and lands on
+    the ``resilience`` flight ring, so a node quietly hashing on one
+    chip (or on the CPU) is visible from /metrics, /health, and /mesh.
+    """
+
+    def __init__(self, reset_timeout: float = 30.0):
+        self.reset_timeout = reset_timeout
+        self._level = LEVEL_MESH
+        self._subset_ids: frozenset | None = None
+        self._demoted_at = 0.0
+        self._probe_inflight = False
+        self._probe_started = 0.0
+        self._lock = threading.Lock()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._level = LEVEL_MESH
+            self._subset_ids = None
+            self._probe_inflight = False
+        self._set_gauge(LEVEL_MESH)
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    @staticmethod
+    def _set_gauge(level: int) -> None:
+        from ..telemetry import metrics as _tm
+
+        _tm.DEVICE_DEMOTION.set(float(level))
+
+    def _probe_device(self, index: int, dev: Any) -> bool:
+        from ..utils import faults as _faults
+
+        if _faults.hit("device.probe", arg=str(index)) is not None:
+            return False
+        try:
+            import jax
+
+            back = np.asarray(jax.device_put(np.arange(4, dtype=np.int32), dev))
+            return bool((back == np.arange(4)).all())
+        except Exception:  # noqa: BLE001 - a dead chip raises anything
+            return False
+
+    def _survivors(self, devices: Sequence[Any]) -> list[Any]:
+        return [
+            d for i, d in enumerate(devices) if self._probe_device(i, d)
+        ]
+
+    def filter(self, devices: Sequence[Any]) -> tuple[list[Any], int]:
+        """The device set + ladder level for the next dispatch. An
+        empty list means the host path. When a demoted ladder's reset
+        timeout has elapsed, ONE caller gets the promoted level as a
+        half-open probe (it must report the outcome)."""
+        devices = list(devices)
+        now = time.monotonic()
+        with self._lock:
+            level = self._level
+            if (
+                level > LEVEL_MESH
+                # an in-flight probe older than the reset window was
+                # abandoned (its dispatch died without reporting) —
+                # don't let it wedge re-arming forever
+                and (not self._probe_inflight
+                     or now - self._probe_started >= self.reset_timeout)
+                and now - self._demoted_at >= self.reset_timeout
+            ):
+                level -= 1
+                self._probe_inflight = True
+                self._probe_started = now
+            subset_ids = self._subset_ids
+        if level == LEVEL_MESH:
+            return devices, level
+        if level == LEVEL_HOST:
+            return [], level
+        if subset_ids:
+            subset = [d for d in devices if d.id in subset_ids]
+        else:
+            subset = self._survivors(devices)
+            if subset:
+                # cache the sweep (e.g. after a HOST→SUBSET re-arm left
+                # no subset) — probing every device is a blocking
+                # round-trip per chip and must not run per dispatch
+                with self._lock:
+                    if self._subset_ids is None:
+                        self._subset_ids = frozenset(d.id for d in subset)
+        return (subset or devices[:1]), level
+
+    def record_success(self, level: int) -> None:
+        """A dispatch at ``level`` completed — a half-open probe's
+        success promotes (re-arms) the ladder to that level. Only the
+        probe holder (level below current) touches probe bookkeeping:
+        a concurrent same-level dispatch reporting in must not clear an
+        in-flight probe it does not own."""
+        from ..telemetry.events import RESILIENCE_EVENTS
+
+        with self._lock:
+            if level >= self._level:
+                return
+            self._probe_inflight = False
+            self._level = level
+            if level == LEVEL_MESH:
+                self._subset_ids = None
+        self._set_gauge(level)
+        RESILIENCE_EVENTS.emit("device_promote", level=level)
+
+    def probe_inconclusive(self, level: int) -> None:
+        """A dispatch holding the half-open probe finished WITHOUT
+        actually exercising the rung's devices (e.g. a tail batch too
+        small to shard ran on the single default device) — release the
+        probe slot without promoting, so the next real dispatch gets
+        the probe instead of a false re-arm."""
+        with self._lock:
+            if level < self._level:
+                self._probe_inflight = False
+
+    def record_failure(self, level: int, devices: Sequence[Any]) -> int:
+        """A dispatch at ``level`` failed — demote one rung (probing
+        for survivors when leaving the full mesh) and return the new
+        level."""
+        from ..telemetry.events import RESILIENCE_EVENTS
+
+        devices = list(devices)
+        if level == LEVEL_MESH and len(devices) > 1:
+            survivors = self._survivors(devices)
+            next_level = LEVEL_SUBSET if survivors else LEVEL_HOST
+            subset = frozenset(d.id for d in survivors)
+        else:
+            next_level = LEVEL_HOST
+            subset = None
+        with self._lock:
+            if level < self._level:
+                self._probe_inflight = False  # the probe itself failed
+            if next_level <= self._level:
+                # another dispatch already demoted at least this far;
+                # just restart the re-arm clock
+                self._demoted_at = time.monotonic()
+                return self._level
+            self._level = next_level
+            self._subset_ids = subset
+            self._demoted_at = time.monotonic()
+        self._set_gauge(next_level)
+        RESILIENCE_EVENTS.emit(
+            "device_demote",
+            level=next_level,
+            survivors=len(subset) if subset is not None else 0,
+            failed_level=level,
+        )
+        return next_level
+
+
+#: the process-wide ladder every auto-policy dispatch consults
+LADDER = DeviceLadder()
+
+
+def ladder_devices() -> tuple[list[Any], int]:
+    """``dispatch_devices()`` filtered through the degradation ladder:
+    (devices, level) — an empty list means use the host reference
+    path. Callers MUST report the dispatch outcome back to ``LADDER``
+    so demotion/re-arm bookkeeping stays truthful."""
+    devs = dispatch_devices()
+    if not devs:
+        return [], LEVEL_HOST
+    return LADDER.filter(devs)
 
 
 def multihost_init(

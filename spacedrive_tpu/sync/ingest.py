@@ -91,11 +91,26 @@ def receive_crdt_operation(sync: SyncManager, op: CRDTOperation) -> bool:
     everyone else. The watermark deliberately does NOT advance past a
     guarded op (advancing to a far-future timestamp would skip that
     peer's legitimate later ops)."""
+    from ..utils import faults as _faults
+
     peer = peer_label(op.instance)
     # observed skew: remote op's HLC time vs our wall clock (positive =
     # remote ahead); sampled per op, cheap (one gauge set)
     skew = op.timestamp.as_unix() - time.time()
     _tm.HLC_CLOCK_SKEW.set(skew, peer=peer)
+    if _faults.hit("sync.ingest") is not None:
+        # "poison": this op reads as a clock-skew-burst casualty — it is
+        # rejected exactly like a real delta-guard trip (counted, on the
+        # ring, watermark NOT advanced) so the peer's later legitimate
+        # ops are re-pulled and convergence survives the injection
+        _tm.HLC_DELTA_GUARD.inc()
+        SYNC_EVENTS.emit(
+            "delta_guard",
+            peer=peer,
+            skew_seconds=round(skew, 3),
+            error="injected poisoned op",
+        )
+        return False
     try:
         sync.clock.update(op.timestamp)
     except ClockDriftError as e:

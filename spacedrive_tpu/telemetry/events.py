@@ -103,6 +103,8 @@ WATCHER_EVENTS = ring("watcher")   # debounced burst flushes
 ERROR_EVENTS = ring("errors")      # uncaught exceptions w/ tracebacks
 WATCHDOG_EVENTS = ring("watchdog")  # slow-op firings
 LOOP_EVENTS = ring("loop")         # event-loop-lag samples over threshold
+FAULT_EVENTS = ring("faults")      # injected-fault activations (utils/faults)
+RESILIENCE_EVENTS = ring("resilience")  # retries, breaker transitions, demotions
 
 
 def record_error(source: str, exc: BaseException | None,

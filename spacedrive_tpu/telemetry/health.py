@@ -24,7 +24,11 @@ Subsystems and their signals:
 - ``sync`` — the federation-corroborated replication head gap (how far
   a fresh peer snapshot's library head is ahead of ours) plus
   delta-guard trips; raw wall-clock lag rides along as a signal but
-  never drives the verdict — it grows on a healthy idle mesh.
+  never drives the verdict — it grows on a healthy idle mesh;
+- ``resilience`` — open circuit breakers (utils/resilience) and the
+  device degradation-ladder level: a node fast-failing a dead relay or
+  hashing on a chip subset still works, but reads degraded until the
+  half-open probe / ladder re-arm succeeds.
 
 Thresholds are module constants, deliberately lenient: a health
 verdict that cries wolf gets ignored.
@@ -98,6 +102,23 @@ def _feeder() -> dict[str, Any]:
 
 
 def _device() -> dict[str, Any]:
+    # the degradation ladder outranks occupancy: a node that demoted to
+    # a chip subset (or all the way to the host reference path) is still
+    # CORRECT, but an operator must see it — it is running at a fraction
+    # of its provisioned throughput until the re-arm probe succeeds
+    demotion = gauge_value("sd_device_demotion_level")
+    if demotion >= 2:
+        return _verdict(
+            DEGRADED,
+            "device dispatch demoted to the host reference path",
+            demotion_level=demotion,
+        )
+    if demotion >= 1:
+        return _verdict(
+            DEGRADED,
+            "device dispatch demoted to a surviving chip subset",
+            demotion_level=demotion,
+        )
     samples: list[float] = []
     for op in ("blake3", "thumbnail"):
         samples.extend(histogram_recent("sd_device_dispatch_occupancy", op=op))
@@ -110,7 +131,24 @@ def _device() -> dict[str, Any]:
             f"mean dispatch occupancy {mean:.2f} — chips mostly hauling pad rows",
             mean_occupancy=mean,
         )
-    return _verdict(HEALTHY, mean_occupancy=mean)
+    return _verdict(HEALTHY, mean_occupancy=mean, demotion_level=demotion)
+
+
+def _resilience() -> dict[str, Any]:
+    """Breaker plane: open circuits mean some target (relay, peer) is
+    being fast-failed right now. Degraded — the node itself still
+    works, but a dependency is being routed around."""
+    from ..utils.resilience import breaker_snapshot
+
+    open_n = gauge_value("sd_breaker_open")
+    retries = counter_value("sd_resilience_retries_total")
+    signals = {"open_breakers": open_n, "retries_total": retries,
+               "breakers": breaker_snapshot()}
+    if open_n > 0:
+        return _verdict(
+            DEGRADED, f"{int(open_n)} circuit breaker(s) open", **signals
+        )
+    return _verdict(HEALTHY, **signals)
 
 
 def _p2p() -> dict[str, Any]:
@@ -227,6 +265,7 @@ def evaluate(node: Any = None) -> dict[str, Any]:
         "device": _device(),
         "p2p": _p2p(),
         "sync": _sync(node),
+        "resilience": _resilience(),
     }
     overall = HEALTHY
     for v in subsystems.values():

@@ -239,6 +239,39 @@ FED_PEERS = REGISTRY.gauge(
     labels=("state",),  # fresh | stale
 )
 
+# --- resilience + fault plane (utils/resilience.py + utils/faults.py) -------
+# Per-target breaker detail stays on the `resilience` flight ring
+# (bounded; values may carry peer_label short-hashes) — the metric
+# families here are deliberately label-free so the series space stays
+# O(1) no matter how many peers/relays a node talks to.
+
+FAULTS_INJECTED = REGISTRY.counter(
+    "sd_faults_injected_total",
+    "fault-plane activations (chaos testing only; 0 in production)",
+)
+RESILIENCE_RETRIES = REGISTRY.counter(
+    "sd_resilience_retries_total",
+    "backoff sleeps taken by resilience-policy retry ladders",
+)
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "sd_breaker_transitions_total",
+    "circuit-breaker state transitions, by state entered",
+    labels=("state",),  # open | half_open | closed
+)
+BREAKER_OPEN = REGISTRY.gauge(
+    "sd_breaker_open",
+    "circuit breakers currently open across all policies/targets",
+)
+DEVICE_DEMOTION = REGISTRY.gauge(
+    "sd_device_demotion_level",
+    "device dispatch degradation rung: 0 = full mesh, 1 = surviving "
+    "chip subset, 2 = host reference path",
+)
+FEEDER_RESTARTS = REGISTRY.counter(
+    "sd_feeder_restarts_total",
+    "window-pipeline producer threads restarted after a crash",
+)
+
 # --- event loop health (telemetry/events.py LoopLagMonitor) -----------------
 
 EVENT_LOOP_LAG = REGISTRY.gauge(

@@ -1,0 +1,557 @@
+"""Chaos soak — the fault plane driven through the real seams.
+
+The contract under test (ISSUE 6 acceptance): with faults injected at
+every registered point, a full walk → identify → thumbnail pass over a
+small corpus COMPLETES, with cas_ids and thumbnail bytes bit-identical
+to the fault-free run; device dispatch demonstrably demotes
+(chips → subset → host) and re-arms after recovery; and every injection
+is visible on the ``faults`` flight ring.
+
+Deterministic: fault plans are seed-controlled (``FaultPlan(seed=...)``)
+and the corpus is generated from fixed RNG seeds. The fast tests here
+are tier-1; the multi-seed soak matrix is ``-m slow`` and runs under
+``make chaos``.
+"""
+
+import asyncio
+import os
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.parallel import mesh
+from spacedrive_tpu.telemetry import counter_value, gauge_value
+from spacedrive_tpu.telemetry.events import ring
+from spacedrive_tpu.utils import faults, resilience
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_state():
+    faults.clear()
+    resilience.reset_breakers()
+    mesh.LADDER.reset()
+    mesh.LADDER.reset_timeout = 30.0
+    yield
+    faults.clear()
+    resilience.reset_breakers()
+    mesh.LADDER.reset()
+    mesh.LADDER.reset_timeout = 30.0
+
+
+# --- corpus + one full pass ------------------------------------------------
+
+
+def _build_corpus(root, seed: int = 7) -> None:
+    """Small mixed corpus: text dupes, a >100 KiB sampled-read file,
+    an empty file, and images for the thumbnailer."""
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    (root / "docs").mkdir(parents=True)
+    (root / "docs" / "a.txt").write_bytes(b"hello chaos")
+    (root / "docs" / "b.txt").write_bytes(b"hello chaos")  # dup content
+    (root / "big.bin").write_bytes(
+        rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    )
+    (root / "mid.bin").write_bytes(
+        rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    )
+    (root / "empty.txt").write_bytes(b"")
+    for i in range(4):
+        Image.fromarray(
+            rng.integers(0, 255, (48 + 8 * i, 64, 3), dtype=np.uint8), "RGB"
+        ).save(root / f"img{i}.png")
+
+
+async def _index_pass(data_dir, loc_path, backend: str = "device"):
+    """One full walk → identify → thumbnail chain; returns
+    ({relpath: cas_id}, {cas_id: webp_bytes})."""
+    from spacedrive_tpu.jobs import JobManager, JobStatus
+    from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+    from spacedrive_tpu.node import Libraries
+    from spacedrive_tpu.object.media.thumbnail import Thumbnailer
+    from spacedrive_tpu.tasks import TaskSystem
+
+    class _Node:
+        pass
+
+    node = _Node()
+    node.thumbnailer = Thumbnailer(data_dir)
+    node.image_labeler = None
+    libs = Libraries(data_dir, node=node)
+    library = libs.create("chaos-lib")
+    mgr = JobManager(TaskSystem(2))
+    location = LocationCreateArgs(path=str(loc_path)).create(library)
+    assert location is not None
+    job_id = await scan_location(library, location, mgr, backend=backend)
+    await mgr.wait(job_id)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        await mgr.wait_idle()
+        rows = library.db.query("SELECT status FROM job")
+        if len(rows) == 3 and all(
+            r["status"] in (int(JobStatus.COMPLETED),
+                            int(JobStatus.COMPLETED_WITH_ERRORS))
+            for r in rows
+        ):
+            break
+    rows = library.db.query("SELECT name, status FROM job")
+    assert len(rows) == 3, rows
+    assert all(
+        r["status"] in (int(JobStatus.COMPLETED),
+                        int(JobStatus.COMPLETED_WITH_ERRORS))
+        for r in rows
+    ), [(r["name"], r["status"]) for r in rows]
+    await node.thumbnailer.wait_library_batch(library.id)
+    cas_by_path = {
+        f"{r['materialized_path']}{r['name']}.{r['extension']}": r["cas_id"]
+        for r in library.db.query(
+            "SELECT materialized_path, name, extension, cas_id "
+            "FROM file_path WHERE is_dir = 0"
+        )
+    }
+    thumbs = {}
+    for cas_id in cas_by_path.values():
+        if cas_id and node.thumbnailer.store.exists(library.id, cas_id):
+            with open(
+                node.thumbnailer.store.path_for(library.id, cas_id), "rb"
+            ) as f:
+                thumbs[cas_id] = f.read()
+    await node.thumbnailer.shutdown()
+    await mgr.system.shutdown()
+    library.close()
+    return cas_by_path, thumbs
+
+
+FAULT_FAMILIES = (
+    "device.blake3:raise:times=1;"
+    "device.blake3:wrong_shape:times=1,after=2;"
+    "device.thumbnail:raise:times=1;"
+    "feeder.fetch:crash:times=1;"
+    "feeder.fetch:stall:times=1,delay_s=0.05"
+)
+
+
+@pytest.mark.asyncio
+async def test_index_pass_bit_identical_under_faults(tmp_path):
+    """The acceptance walk: every pipeline fault family injected, pass
+    completes, results bit-identical, injections on the ring, dispatch
+    demotes and re-arms."""
+    loc = tmp_path / "corpus"
+    loc.mkdir()
+    _build_corpus(loc)
+
+    clean_cas, clean_thumbs = await _index_pass(tmp_path / "clean", loc)
+    assert len([c for c in clean_cas.values() if c]) >= 7
+    assert len(clean_thumbs) == 4  # the four pngs
+
+    mesh.LADDER.reset()
+    ring("faults").clear()
+    plan = faults.FaultPlan.parse(FAULT_FAMILIES, seed=1)
+    with faults.active(plan):
+        chaos_cas, chaos_thumbs = await _index_pass(tmp_path / "chaos", loc)
+
+    # bit-identical results despite every injected fault
+    assert chaos_cas == clean_cas
+    assert chaos_thumbs == clean_thumbs
+
+    # every fault family actually fired and is visible on the ring
+    fired = plan.activations()
+    assert fired.get("device.blake3", 0) >= 2
+    assert fired.get("device.thumbnail", 0) >= 1
+    assert fired.get("feeder.fetch", 0) >= 2
+    ring_points = {
+        e["fields"]["point"] for e in ring("faults").snapshot()
+        if e["type"] == "injected"
+    }
+    assert {"device.blake3", "device.thumbnail", "feeder.fetch"} <= ring_points
+
+    # dispatch demonstrably demoted (metric + ring) ...
+    assert gauge_value("sd_device_demotion_level") >= 1.0
+    demotes = [
+        e for e in ring("resilience").snapshot()
+        if e["type"] == "device_demote"
+    ]
+    assert demotes
+    # ... and re-arms once the breaker-reset probe succeeds (one probe
+    # dispatch per rung climbs host → subset → mesh). The probe batch
+    # must be big enough to SHARD — an unsharded tail dispatch proves
+    # nothing about the chips and is deliberately inconclusive.
+    mesh.LADDER.reset_timeout = 0.05
+    from spacedrive_tpu.ops import cas as cas_mod
+
+    probe_batch = [b"rearm-probe-%03d" % i for i in range(128)]
+    for _ in range(3):
+        time.sleep(0.1)
+        cas_mod.cas_ids_batched(probe_batch)
+        if mesh.LADDER.level == mesh.LEVEL_MESH:
+            break
+    assert mesh.LADDER.level == mesh.LEVEL_MESH
+    assert gauge_value("sd_device_demotion_level") == 0.0
+    assert any(
+        e["type"] == "device_promote" for e in ring("resilience").snapshot()
+    )
+
+
+@pytest.mark.asyncio
+async def test_thumbnail_persist_crash_cold_resume(tmp_path):
+    """A crash injected between chunk store and journal write: the next
+    actor (a fresh process) resumes WITHOUT re-doing the stored prefix
+    and finishes the batch."""
+    from PIL import Image
+
+    from spacedrive_tpu.object.media.thumbnail import Thumbnailer
+
+    rng = np.random.default_rng(3)
+    imgs = []
+    for i in range(10):
+        p = tmp_path / f"p{i}.png"
+        Image.fromarray(
+            rng.integers(0, 255, (40, 52, 3), dtype=np.uint8), "RGB"
+        ).save(p)
+        imgs.append((f"cas{i:04d}", str(p), "png"))
+
+    data_dir = tmp_path / "data"
+    t1 = Thumbnailer(data_dir, use_device=False)
+    t1._chunk_rows = 4  # 3 chunks: crash fires after the first stores
+    with faults.active(
+        faults.FaultPlan.parse("thumbnail.persist:crash:times=1")
+    ):
+        t1.new_indexed_thumbnails_batch("lib1", imgs)
+        with pytest.raises(faults.InjectedCrash):
+            await t1._worker  # the "process" dies mid-batch
+    stored_after_crash = [c for c, _, _ in imgs if t1.store.exists("lib1", c)]
+    assert len(stored_after_crash) == 4  # exactly the stored chunk
+
+    # fresh actor = fresh process: resumes the journal, skips the prefix
+    t2 = Thumbnailer(data_dir, use_device=False)
+    resumed = sum(len(b.entries) for b in t2._bg)
+    assert resumed == len(imgs) - len(stored_after_crash)
+    t2._chunk_rows = 4
+    await t2.wait_library_batch("lib1")  # _ensure_started drives the queue
+    assert all(t2.store.exists("lib1", c) for c, _, _ in imgs)
+    await t2.shutdown()
+
+
+# --- relay: retries, breaker, mid-body EOF ---------------------------------
+
+
+async def _relay_client(tmp_path=None):
+    from spacedrive_tpu.cloud.api import CloudClient
+    from spacedrive_tpu.cloud.relay import CloudRelay
+
+    relay = CloudRelay()
+    port = await relay.start()
+    client = CloudClient(f"http://127.0.0.1:{port}")
+    lib = str(uuid.uuid4())
+    inst = str(uuid.uuid4())
+    await client.create_library(lib, "chaos")
+    await client.add_instance(lib, inst)
+    return relay, client, lib, inst
+
+
+@pytest.mark.asyncio
+async def test_relay_500s_absorbed_by_retries():
+    relay, client, lib, inst = await _relay_client()
+    try:
+        before = counter_value("sd_resilience_retries_total")
+        with faults.active(faults.FaultPlan.parse("relay.http:500:times=2")):
+            out = await client.pull_ops(lib, inst, {})
+        assert out == []  # succeeded despite two injected 500s
+        assert counter_value("sd_resilience_retries_total") >= before + 2
+    finally:
+        await client.close()
+        await relay.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_relay_timeout_fault_bounded_by_deadline():
+    from spacedrive_tpu.utils.resilience import deadline_scope
+
+    relay, client, lib, inst = await _relay_client()
+    try:
+        t0 = time.monotonic()
+        with faults.active(
+            faults.FaultPlan.parse("relay.http:timeout:delay_s=30,times=1")
+        ):
+            with deadline_scope(0.3):
+                with pytest.raises(Exception):
+                    await client.pull_ops(lib, inst, {})
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        await client.close()
+        await relay.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_relay_midbody_eof_trips_breaker_then_rearms():
+    """Satellite: a truncated body is a breaker failure, not just a
+    logged pull error — enough of them fast-fail the relay leg, and the
+    half-open probe re-arms it once bodies flow again."""
+    from spacedrive_tpu.cloud.api import RELAY_POLICY
+    from spacedrive_tpu.utils.resilience import BreakerOpen
+
+    relay, client, lib, inst = await _relay_client()
+    try:
+        breaker = RELAY_POLICY.breaker(client.origin)
+        with faults.active(
+            faults.FaultPlan.parse("relay.http:truncate:times=20")
+        ):
+            with pytest.raises(Exception):
+                await client.pull_ops(lib, inst, {})
+            assert breaker.failures >= 3  # every EOF counted
+            while breaker.state != resilience.OPEN:
+                with pytest.raises(Exception):
+                    await client.pull_ops(lib, inst, {})
+            with pytest.raises(BreakerOpen):
+                await client.pull_ops(lib, inst, {})
+        # recovery: half-open probe after the reset window
+        breaker.reset_timeout = 0.05
+        await asyncio.sleep(0.1)
+        assert await client.pull_ops(lib, inst, {}) == []
+        assert breaker.state == resilience.CLOSED
+    finally:
+        await client.close()
+        await relay.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_relay_4xx_neither_retries_nor_feeds_breaker():
+    from spacedrive_tpu.cloud.api import CloudApiError, RELAY_POLICY
+
+    relay, client, lib, inst = await _relay_client()
+    try:
+        before = counter_value("sd_resilience_retries_total")
+        with pytest.raises(CloudApiError) as exc:
+            await client.push_telemetry(lib, "not-an-instance", {"v": 1})
+        assert exc.value.status == 400
+        assert counter_value("sd_resilience_retries_total") == before
+        assert RELAY_POLICY.breaker(client.origin).failures == 0
+    finally:
+        await client.close()
+        await relay.shutdown()
+
+
+# --- sync: poisoned op rejected, convergence survives ----------------------
+
+
+class _SyncInstance:
+    """Minimal loopback sync instance (the sync suite's harness)."""
+
+    def __init__(self, name: str):
+        from spacedrive_tpu.db import LibraryDb
+        from spacedrive_tpu.db.database import now_iso
+        from spacedrive_tpu.sync.ingest import IngestActor
+        from spacedrive_tpu.sync.manager import SyncManager
+        from spacedrive_tpu.utils.events import EventBus
+
+        self.id = uuid.uuid4()
+        self.db = LibraryDb(None, memory=True)
+        now = now_iso()
+        self.db.insert(
+            "instance", pub_id=self.id.bytes, identity=b"", node_id=b"",
+            node_name=name, node_platform=0, last_seen=now, date_created=now,
+        )
+        self.bus = EventBus()
+        self.sync = SyncManager(self.db, self.id, event_bus=self.bus)
+        self.peers: list["_SyncInstance"] = []
+
+        async def request_ops(timestamps, count):
+            ops, has_more = [], False
+            for peer in self.peers:
+                got = peer.sync.get_ops(count=count, clocks=timestamps)
+                ops.extend(got)
+                has_more = has_more or len(got) == count
+            return ops, has_more
+
+        self.actor = IngestActor(self.sync, request_ops)
+
+
+@pytest.mark.asyncio
+async def test_sync_poisoned_op_rejected_then_converges():
+    a, b = _SyncInstance("a"), _SyncInstance("b")
+    for x, y in ((a, b), (b, a)):
+        from spacedrive_tpu.db.database import now_iso
+
+        now = now_iso()
+        x.db.insert(
+            "instance", pub_id=y.id.bytes, identity=b"", node_id=b"",
+            node_name="", node_platform=0, last_seen=now, date_created=now,
+        )
+    a.peers.append(b)
+
+    tag_id = uuid.uuid4().hex
+    b.sync.write_ops(
+        b.sync.shared_create("tag", tag_id, [("name", "chaos"),
+                                             ("color", "#f00")])
+    )
+    guard_before = counter_value("sd_hlc_delta_guard_total")
+    with faults.active(faults.FaultPlan.parse("sync.ingest:poison:times=1")):
+        a.actor.notify()
+        await a.actor.wait_idle()
+        # the poisoned op was rejected; the watermark did NOT advance
+        assert counter_value("sd_hlc_delta_guard_total") == guard_before + 1
+        # a later notify re-pulls and applies the same op cleanly
+        a.actor.notify()
+        await a.actor.wait_idle()
+    row = a.db.find_one("tag", pub_id=bytes.fromhex(tag_id))
+    assert row is not None and row["name"] == "chaos"
+    trips = [
+        e for e in ring("sync").snapshot()
+        if e["type"] == "delta_guard"
+        and e["fields"].get("error") == "injected poisoned op"
+    ]
+    assert trips
+    await a.actor.stop()
+    await b.actor.stop()
+
+
+# --- p2p: conn reset, partial write, peer vanish ---------------------------
+
+
+@pytest.mark.asyncio
+async def test_p2p_connect_reset_fault():
+    from spacedrive_tpu.p2p.p2p import P2P
+
+    p = P2P("chaos-test")
+    with faults.active(faults.FaultPlan.parse("p2p.connect:reset:times=1")):
+        with pytest.raises(ConnectionResetError):
+            await p.new_stream(p.remote_identity)
+
+
+@pytest.mark.asyncio
+async def test_udpstream_write_faults():
+    from spacedrive_tpu.p2p.udpstream import UdpStream, UdpStreamError
+
+    class _FakeEndpoint:
+        local_addr = ("127.0.0.1", 0)
+
+        def __init__(self):
+            self.sent = []
+
+        def set_receiver(self, cb):
+            self.cb = cb
+
+        def sendto(self, data, addr):
+            self.sent.append(data)
+
+        def close(self):
+            pass
+
+    # reset: write raises, stream fails, reader poisoned
+    ep = _FakeEndpoint()
+    s = UdpStream(ep, ("127.0.0.1", 9))
+    with faults.active(faults.FaultPlan.parse("p2p.write:reset:times=1")):
+        with pytest.raises(UdpStreamError):
+            s.write(b"hello" * 1000)
+    with pytest.raises(UdpStreamError):
+        await s.reader.read(1)
+    failed = [
+        e for e in ring("p2p").snapshot() if e["type"] == "stream_failed"
+    ]
+    assert failed
+
+    # partial: exactly one MSS-sized segment hits the wire, then the
+    # stream dies — the peer really does observe a truncated message
+    ep2 = _FakeEndpoint()
+    s2 = UdpStream(ep2, ("127.0.0.1", 9))
+    with faults.active(faults.FaultPlan.parse("p2p.write:partial:times=1")):
+        with pytest.raises(UdpStreamError):
+            s2.write(b"x" * 100_000)
+    await asyncio.sleep(0.01)
+    from spacedrive_tpu.p2p.udpstream import DATA, MSS, _HDR
+
+    data_grams = [d for d in ep2.sent if _HDR.unpack_from(d)[0] == DATA]
+    assert len(data_grams) == 1
+    assert len(data_grams[0]) == _HDR.size + MSS
+    assert not s2._pending_writes  # nothing left queued behind the fail
+
+
+@pytest.mark.asyncio
+async def test_peer_vanish_mid_sync_is_a_retryable_pull_failure():
+    """The requester half: an IncompleteReadError mid-exchange retries
+    under the sync policy and lands as a failed pull, not a crash."""
+    from spacedrive_tpu.p2p.manager import SYNC_POLICY
+
+    calls = []
+
+    async def flaky_exchange():
+        calls.append(1)
+        if len(calls) == 1:
+            raise asyncio.IncompleteReadError(b"", 4)
+        return (["op"], False)
+
+    ops, has_more = await SYNC_POLICY.call("vanishing-peer", flaky_exchange)
+    assert ops == ["op"] and len(calls) == 2
+
+
+@pytest.mark.asyncio
+async def test_sync_serve_vanish_closes_stream_before_response(tmp_path):
+    """The responder half: the ``p2p.sync_serve`` fault makes the peer
+    vanish mid-SYNC — stream closed, nothing written, injection on the
+    ring."""
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.p2p.manager import P2PManager
+    from spacedrive_tpu.p2p.protocol import Header, HeaderType
+
+    node = Node(os.path.join(tmp_path, "n"), use_device=False,
+                with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        mgr = P2PManager(node)
+
+        class _Stream:
+            remote_identity = "test-peer"
+            closed = False
+            wrote = b""
+
+            async def write(self, data):
+                self.wrote += data
+
+            async def close(self):
+                self.closed = True
+
+        stream = _Stream()
+        header = Header(HeaderType.SYNC_REQUEST, library_id=uuid.uuid4())
+        with faults.active(
+            faults.FaultPlan.parse("p2p.sync_serve:vanish:times=1")
+        ):
+            await mgr._handle_stream_traced(stream, header, None)
+        assert stream.closed and stream.wrote == b""
+        assert any(
+            e["fields"]["point"] == "p2p.sync_serve"
+            for e in ring("faults").snapshot() if e["type"] == "injected"
+        )
+    finally:
+        await node.shutdown()
+
+
+# --- the soak matrix (make chaos) ------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.asyncio
+async def test_chaos_soak_matrix(tmp_path, seed):
+    """Every fault family, multiple deterministic seeds, full pass each
+    — completion + bit-identity + ring visibility, repeatedly."""
+    loc = tmp_path / "corpus"
+    loc.mkdir()
+    _build_corpus(loc, seed=seed)
+    clean_cas, clean_thumbs = await _index_pass(tmp_path / "clean", loc)
+    plan = faults.FaultPlan.parse(
+        FAULT_FAMILIES + ";sync.ingest:poison:times=1", seed=seed
+    )
+    mesh.LADDER.reset()
+    with faults.active(plan):
+        chaos_cas, chaos_thumbs = await _index_pass(
+            tmp_path / f"chaos{seed}", loc
+        )
+    assert chaos_cas == clean_cas
+    assert chaos_thumbs == clean_thumbs
+    fired = plan.activations()
+    assert fired.get("device.blake3", 0) >= 1
+    assert fired.get("feeder.fetch", 0) >= 1

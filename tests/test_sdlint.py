@@ -810,6 +810,107 @@ def test_baseline_keys_survive_line_moves(tmp_path):
     assert before[0].key == after[0].key
 
 
+# --- SD011 unbounded-retry -------------------------------------------------
+
+
+def test_sd011_flags_sleep_free_retry(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        async def hammer(client):
+            while True:
+                try:
+                    return await client.fetch()
+                except Exception:
+                    continue
+        """,
+        ["SD011"],
+    )
+    assert len(findings) == 1
+    assert "sleep-free" in findings[0].message
+
+
+def test_sd011_flags_flag_gated_sleep_free_retry(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        async def pump(self):
+            while not self._stopped:
+                try:
+                    self.push()
+                except OSError:
+                    pass
+        """,
+        ["SD011"],
+    )
+    assert len(findings) == 1
+    assert "sleep-free" in findings[0].message
+
+
+def test_sd011_flags_unbounded_retry_with_backoff(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio
+
+        async def forever(client):
+            while True:
+                try:
+                    await client.push()
+                except Exception:
+                    pass
+                await asyncio.sleep(1.0)
+        """,
+        ["SD011"],
+    )
+    assert len(findings) == 1
+    assert "unbounded" in findings[0].message
+
+
+def test_sd011_silent_on_paced_bounded_and_actor_loops(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio
+
+        async def bounded(client):
+            # bounded: the success path returns, failures break out
+            while True:
+                try:
+                    return await client.fetch()
+                except Exception:
+                    break
+
+        async def actor(self, loop, sock):
+            # recv-paced loop: the outside world paces it, typed
+            # handlers are deliberate control flow
+            while not self._stopped:
+                try:
+                    data = await loop.sock_recvfrom(sock, 65535)
+                except (ValueError, KeyError):
+                    continue
+                await asyncio.sleep(0)
+
+        async def progress(self, task):
+            # the condition makes progress (calls something)
+            while not task.done():
+                try:
+                    await asyncio.shield(task)
+                except Exception:
+                    continue
+
+        async def policy_routed(self, policy, client):
+            while not self._stopped:
+                try:
+                    await policy.call("relay", client.fetch)
+                except Exception:
+                    pass
+        """,
+        ["SD011"],
+    )
+    assert findings == []
+
+
 # --- the gate (same entry point as `make lint` / CI) -----------------------
 
 

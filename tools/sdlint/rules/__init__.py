@@ -3,4 +3,5 @@
 from . import asyncrules  # noqa: F401  SD001-SD003
 from . import lockorder  # noqa: F401  SD004
 from . import jaxrules  # noqa: F401  SD005-SD006
-from . import telemetryrules  # noqa: F401  SD007-SD009
+from . import telemetryrules  # noqa: F401  SD007-SD010
+from . import resiliencerules  # noqa: F401  SD011

@@ -1,0 +1,164 @@
+"""Resilience rules.
+
+SD011  unbounded / sleep-free retry loops
+
+The resilience layer (``spacedrive_tpu/utils/resilience.py``) exists so
+retry behavior is bounded and jittered in ONE place. A hand-rolled
+retry loop that swallows exceptions and spins again is the failure mode
+this PR removed from the federation relay leg: with no sleep it
+busy-hammers a dead dependency (and a core); with no bound it retries
+forever. SD011 flags both shapes so new ones route through
+``ResiliencePolicy`` (or at minimum gain a sleep and a bound) instead.
+
+What counts:
+
+- the loop condition is *unbounded-ish* — ``while True`` /
+  ``while 1`` / ``while not self._flag`` (a bare attribute or name
+  flag). Conditions that call something (``while not task.done()``)
+  are progress checks, not retry loops, and are exempt;
+- the loop body contains a ``try`` whose handler *swallows* the
+  exception (no ``raise``, no ``break``/``return``) so the loop
+  iterates again after a failure.
+
+Findings:
+
+- **sleep-free retry**: no backoff-shaped await/call anywhere in the
+  loop body (``*.sleep`` / ``*.wait`` / ``*.wait_for`` / a resilience
+  ``*.call``) — the loop retries at CPU speed;
+- **unbounded retry**: the condition is the constant ``True`` and the
+  body has no ``break``/``return`` at all — nothing ever ends the
+  retrying, bounded backoff or not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, call_name, rule, walk_shallow
+
+# a call whose final dotted segment matches one of these counts as
+# pacing between attempts: explicit backoff (asyncio.sleep, time.sleep,
+# Event.wait, asyncio.wait_for, Condition.wait, ResiliencePolicy.call)
+# or blocking on external input (recv/read/accept/get loops are paced
+# by the outside world, not spinning on a failure)
+_BACKOFF_TAILS = {
+    "sleep", "wait", "wait_for", "call",
+    "recv", "recvfrom", "sock_recv", "sock_recvfrom", "sock_accept",
+    "read", "readexactly", "readuntil", "accept", "get", "join",
+    "acquire", "take",
+}
+
+# handler annotations that count as a BROAD swallow — catching one of
+# these and continuing means *any* failure becomes a silent retry
+_BROAD_EXCEPTS = {"Exception", "BaseException"}
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:  # bare except
+        return True
+    types = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    for t in types:
+        name = t.attr if isinstance(t, ast.Attribute) else (
+            t.id if isinstance(t, ast.Name) else None
+        )
+        if name in _BROAD_EXCEPTS:
+            return True
+    return False
+
+
+def _is_unbounded_condition(test: ast.AST) -> tuple[bool, bool]:
+    """(unbounded-ish, literally-infinite). ``while True`` is both;
+    ``while not self._stopped`` is unbounded-ish (an external flag, not
+    loop progress); anything involving a call is neither."""
+    if isinstance(test, ast.Constant) and test.value:
+        return True, True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        if isinstance(test.operand, (ast.Name, ast.Attribute)):
+            return True, False
+    return False, False
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler neither re-raises nor exits the loop —
+    the next iteration is a retry."""
+    for node in walk_shallow(handler):
+        if isinstance(node, (ast.Raise, ast.Break, ast.Return)):
+            return False
+    return True
+
+
+def _loop_has_backoff(loop: ast.While) -> bool:
+    for node in walk_shallow(loop):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name is not None and name.rsplit(".", 1)[-1] in _BACKOFF_TAILS:
+                return True
+    return False
+
+
+def _loop_has_exit(loop: ast.While) -> bool:
+    for node in walk_shallow(loop):
+        if node is loop:
+            continue
+        if isinstance(node, (ast.Break, ast.Return)):
+            return True
+        # a nested loop's breaks exit that loop, not this one — but
+        # walk_shallow already stops at function boundaries only, so
+        # accept any break/return as "an exit exists" (conservative:
+        # fewer findings, no false positives on complex drivers)
+    return False
+
+
+@rule(
+    "SD011",
+    "unbounded-retry",
+    "retry loops that swallow exceptions without backoff (busy-hammering "
+    "a dead dependency) or without any bound (retrying forever) — route "
+    "through utils.resilience.ResiliencePolicy instead",
+)
+def check_unbounded_retry(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.While):
+            continue
+        unboundedish, infinite = _is_unbounded_condition(node.test)
+        if not unboundedish:
+            continue
+        swallowing = [
+            h
+            for t in walk_shallow(node)
+            if isinstance(t, ast.Try)
+            for h in t.handlers
+            if _handler_swallows(h)
+        ]
+        if not swallowing:
+            continue
+        if not _loop_has_backoff(node):
+            yield ctx.finding(
+                "SD011",
+                node,
+                "sleep-free retry: this loop swallows exceptions and "
+                "retries with no backoff — a dead dependency gets "
+                "hammered at CPU speed; add jittered backoff or use "
+                "utils.resilience.ResiliencePolicy",
+            )
+        elif (
+            infinite
+            and any(_handler_is_broad(h) for h in swallowing)
+            and not _loop_has_exit(node)
+        ):
+            # narrow typed handlers (TimeoutError, OSError) read as
+            # deliberate control flow; only a broad catch-and-continue
+            # with literally no way out is "retries forever"
+            yield ctx.finding(
+                "SD011",
+                node,
+                "unbounded retry: `while True` swallows exceptions and "
+                "has no break/return — it retries forever; bound the "
+                "attempts or gate on a circuit breaker "
+                "(utils.resilience.ResiliencePolicy)",
+            )
