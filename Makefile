@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check chaos
+.PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check \
+	bench-warm chaos
 
 lint:
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
@@ -33,9 +34,19 @@ chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py \
 		tests/test_resilience.py -q -m '' -p no:cacheprovider
 
-# perf trajectory gate: diff the two most recent BENCH_r*.json rounds,
-# fail on a >15% files/s regression in any comparable throughput series
-# (link-bound e2e rates are excused on blocked/congested runs)
+# warm-pass bench: cold index → mutate 1% of files in place → warm
+# index on the same node, recording warm files/s, journal hit rate, and
+# bytes-hashed into BENCH_E2E (config_warm). CI-safe sizes on the CPU
+# platform; on the TPU rig run `python bench_e2e.py` for the full set.
+bench-warm:
+	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=warm SD_E2E_FILES=800 \
+		SD_E2E_REPEATS=2 SD_BENCH_WAIT=0 $(PY) bench_e2e.py
+
+# perf trajectory gate: diff the two most recent BENCH_r*.json rounds
+# AND (when BENCH_E2E_prev.json exists) the previous → current
+# BENCH_E2E per-config rates incl. the warm-pass metrics; fail on a
+# >15% regression in any comparable throughput series (link-bound e2e
+# rates are excused on blocked/congested runs)
 bench-check:
 	$(PY) tools/bench_compare.py --dir .
 

@@ -193,6 +193,99 @@ async def run_scan(data_dir: str, corpus: str, *, use_device: bool,
         await node.shutdown()
 
 
+def mutate_corpus(root: str, pct: float, seed: int = 21) -> tuple[int, int]:
+    """In-place mutate `pct`% of the corpus (same sizes, so the
+    dirty-range rehash applies); returns (files_mutated, bytes_written).
+    Mutations land inside the cas_id header range so they are always
+    content-visible."""
+    rng = random.Random(seed)
+    names = sorted(
+        f for f in os.listdir(root)
+        if os.path.isfile(os.path.join(root, f)) and not f.startswith(".")
+    )
+    n = max(1, int(len(names) * pct / 100.0))
+    written = 0
+    for name in rng.sample(names, n):
+        p = os.path.join(root, name)
+        size = os.stat(p).st_size
+        if size == 0:
+            with open(p, "ab") as f:  # empty files can only grow
+                f.write(b"!")
+            written += 1
+            continue
+        with open(p, "r+b") as f:
+            blob = rng.randbytes(min(64, size))
+            # clamp so the write never extends the file — a grown file
+            # would take the full-rehash path and skew the dirty-range
+            # bytes-hashed evidence
+            f.seek(rng.randrange(0, min(size - len(blob), 8192) + 1))
+            f.write(blob)
+            written += len(blob)
+    return n, written
+
+
+async def run_warm_scan(data_dir: str, corpus: str, *, use_device: bool,
+                        backend: str, mutate_pct: float) -> dict:
+    """Cold pass → mutate pct% in place → warm pass, on ONE node (the
+    journal lives in the library DB, so the warm pass must see it).
+    Returns cold/warm chain timings plus the journal verdict deltas."""
+    from spacedrive_tpu.jobs.manager import JobBuilder
+    from spacedrive_tpu.location.indexer.job import IndexerJob
+    from spacedrive_tpu.location.locations import LocationCreateArgs
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.object.file_identifier.job import FileIdentifierJob
+    from spacedrive_tpu.object.media.job import MediaProcessorJob
+    from spacedrive_tpu.telemetry import counter_value
+
+    node = Node(data_dir, use_device=use_device, with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        lib = await node.create_library("bench-warm")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+
+        async def chain() -> float:
+            t0 = time.perf_counter()
+            for job_cls in (IndexerJob, FileIdentifierJob, MediaProcessorJob):
+                init = {"location_id": loc["id"]}
+                if job_cls is FileIdentifierJob:
+                    init["backend"] = backend
+                await JobBuilder(job_cls(init)).spawn(node.jobs, lib)
+                await node.jobs.wait_idle()
+            return time.perf_counter() - t0
+
+        cold_s = await chain()
+        mutated, _ = mutate_corpus(corpus, mutate_pct)
+
+        def snap() -> dict:
+            return {
+                k: counter_value("sd_index_journal_ops_total", result=k)
+                for k in ("hit", "miss", "invalidated", "bypassed")
+            } | {
+                "bytes_hashed": counter_value("sd_index_bytes_hashed_total"),
+                "bytes_saved": counter_value(
+                    "sd_index_journal_bytes_saved_total"),
+            }
+
+        before = snap()
+        warm_s = await chain()
+        delta = {k: round(snap()[k] - before[k], 1) for k in before}
+        files = lib.db.count("file_path", "is_dir = 0", ())
+        consults = delta["hit"] + delta["miss"] + delta["invalidated"] \
+            + delta["bypassed"]
+        return {
+            "files": files,
+            "mutated_files": mutated,
+            "cold_s": cold_s,
+            "warm_s": warm_s,
+            "journal": delta,
+            "journal_hit_rate": round(delta["hit"] / consults, 4)
+            if consults else None,
+        }
+    finally:
+        await node.shutdown()
+
+
 def probe_link(wait_budget: float | None = None) -> float:
     """Best-of-3 host→device bandwidth (GB/s). With a wait budget, sits
     out congestion spikes (bounded); with 0 it just measures NOW —
@@ -445,6 +538,55 @@ def config_5(tmp: str, n_images: int, repeats: int, probes: dict) -> dict:
         "cpu1_mpairs_per_s": round(pairs / cpu_s / 1e6, 1),
         "vs_cpu1": round(cpu_s / device_s, 3),
         "vs_cpu16_projected": round(cpu_s / device_s / CPU_BASELINE_CORES, 3),
+    }
+
+
+def config_warm(tmp: str, n_files: int, repeats: int, probes: dict) -> dict:
+    """Warm-pass config: cold index → mutate SD_E2E_MUTATE_PCT% of the
+    files in place → warm index on the SAME node. The headline is
+    `warm_files_per_s` and the warm/cold speedup; the journal verdict
+    deltas prove the speedup came from skipped work, not weather. The
+    acceptance bar (≤1% mutated): warm ≥10× cold, hit rate ≥99%, and
+    warm bytes-hashed ∝ changed bytes (the dirty-range chunks)."""
+    pct = float(os.environ.get("SD_E2E_MUTATE_PCT", "1"))
+    log(f"config warm: {n_files} mixed files, mutate {pct}%…")
+    corpus = os.path.join(tmp, "corpusW")
+    build_mixed_corpus(corpus, n_files)
+    probes["pre"] = round(probe_link(0), 3)
+    runs = []
+    for r in range(max(1, repeats)):
+        # fresh corpus per rep: mutations accumulate otherwise
+        if r:
+            shutil.rmtree(corpus, ignore_errors=True)
+            build_mixed_corpus(corpus, n_files)
+        data_dir = os.path.join(tmp, f"node-warm-{r}")
+        res = asyncio.run(run_warm_scan(
+            data_dir, corpus, use_device=True, backend="tpu",
+            mutate_pct=pct,
+        ))
+        runs.append(res)
+        log(f"  [warm #{r}] cold {res['cold_s']:.1f}s  warm "
+            f"{res['warm_s']:.1f}s  hit-rate {res['journal_hit_rate']}  "
+            f"bytes hashed {res['journal']['bytes_hashed']:.0f}")
+        shutil.rmtree(data_dir, ignore_errors=True)
+    probes["post"] = round(probe_link(0), 3)
+    med, lo, hi = median_spread([r["warm_s"] for r in runs])
+    chosen = min(runs, key=lambda r: abs(r["warm_s"] - med))
+    files = chosen["files"]
+    return {
+        "name": "warm re-index: journal hits + dirty-range rehash "
+                f"({pct}% of files mutated in place)",
+        "files": files,
+        "mutated_files": chosen["mutated_files"],
+        "mutate_pct": pct,
+        "cold_files_per_s": round(files / chosen["cold_s"], 1),
+        "warm_files_per_s": round(files / med, 1),
+        "warm_s_spread": [round(lo, 2), round(med, 2), round(hi, 2)],
+        "warm_speedup_vs_cold": round(chosen["cold_s"] / med, 2),
+        "journal_hit_rate": chosen["journal_hit_rate"],
+        "journal_ops": chosen["journal"],
+        "warm_bytes_hashed": chosen["journal"]["bytes_hashed"],
+        "warm_bytes_saved": chosen["journal"]["bytes_saved"],
     }
 
 
@@ -1023,6 +1165,7 @@ CONFIG_METRICS = {
     "config3": "device_thumbs_per_s",
     "config4": "device_clips_per_s",
     "config5": "device_mpairs_per_s",
+    "config_warm": "warm_files_per_s",
 }
 
 
@@ -1071,7 +1214,7 @@ def main() -> None:
 
     configure_compilation_cache()
     which = os.environ.get(
-        "SD_E2E_CONFIGS", "compose,1,3,4,5,decode").split(",")
+        "SD_E2E_CONFIGS", "compose,1,3,4,5,warm,decode").split(",")
     n_files = int(os.environ.get("SD_E2E_FILES", "10000"))
     n_images = int(os.environ.get("SD_E2E_IMAGES", "256"))
     n_clips = int(os.environ.get("SD_E2E_CLIPS", "8"))
@@ -1108,6 +1251,9 @@ def main() -> None:
             results["config4"] = probed(config_4, tmp, n_clips, repeats)
         if "5" in which:
             results["config5"] = probed(config_5, tmp, n_images, repeats)
+        if "warm" in which:
+            results["config_warm"] = probed(
+                config_warm, tmp, n_files, max(1, repeats - 1))
         if "decode" in which:
             results["decode_scaling"] = decode_scaling(tmp, n_images)
         results["total_seconds"] = round(time.perf_counter() - t_all, 1)
@@ -1144,6 +1290,12 @@ def main() -> None:
         log(f"KEEPING previous BENCH_E2E.json (health {health_score(prev)} > "
             f"{health_score(results)}); this attempt → BENCH_E2E_attempt.json")
     else:
+        if prev is not None:
+            # archive the replaced artifact: tools/bench_compare.py
+            # gates the prev → current pair (warm files/s etc.)
+            with open("BENCH_E2E_prev.json", "w") as f:
+                json.dump(prev, f, indent=2)
+                f.write("\n")
         with open("BENCH_E2E.json", "w") as f:
             f.write(doc + "\n")
     print(doc, flush=True)

@@ -310,6 +310,33 @@ MIGRATIONS: list[list[str]] = [
     # (device-computed, ops/phash_jax.py; no reference counterpart —
     # spacedrive dedups by exact cas_id only)
     ["ALTER TABLE object ADD COLUMN phash BLOB"],
+    # v2 -> v3: persistent index journal (location/indexer/journal.py) —
+    # per-path stat identity (inode/dev/mtime_ns/size as u64 LE blobs)
+    # vouching for derived results (cas_id column for SQL joins; the
+    # msgpack payload carries thumb/media/phash vouches and the
+    # dirty-range chunk cache). `stale=1` marks watcher-invalidated
+    # entries whose chunk cache is still useful for dirty-range rehash.
+    [
+        """
+        CREATE TABLE index_journal (
+            location_id       INTEGER NOT NULL REFERENCES location(id)
+                              ON DELETE CASCADE,
+            materialized_path TEXT NOT NULL,
+            name              TEXT COLLATE NOCASE NOT NULL,
+            extension         TEXT COLLATE NOCASE NOT NULL,
+            inode             BLOB,
+            dev               BLOB,
+            mtime_ns          BLOB,
+            size              BLOB,
+            cas_id            TEXT,
+            payload           BLOB,
+            stale             INTEGER NOT NULL DEFAULT 0,
+            date_vouched      TEXT,
+            PRIMARY KEY (location_id, materialized_path, name, extension)
+        )
+        """,
+        "CREATE INDEX idx_index_journal_cas ON index_journal(cas_id)",
+    ],
 ]
 
 # The version every migrated database reports via PRAGMA user_version.

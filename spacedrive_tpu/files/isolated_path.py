@@ -46,6 +46,11 @@ class FilePathMetadata:
     created_at: datetime
     modified_at: datetime
     hidden: bool
+    # exact stat identity for the index journal (datetime fields above
+    # lose sub-ms precision through float timestamps; the journal's
+    # "unchanged" verdict must be lossless)
+    mtime_ns: int = 0
+    dev: int = 0
 
     @classmethod
     def from_path(cls, path: str | os.PathLike, stat: os.stat_result | None = None) -> "FilePathMetadata":
@@ -56,6 +61,8 @@ class FilePathMetadata:
             created_at=datetime.fromtimestamp(getattr(st, "st_birthtime", st.st_ctime), timezone.utc),
             modified_at=datetime.fromtimestamp(st.st_mtime, timezone.utc),
             hidden=path_is_hidden(path),
+            mtime_ns=st.st_mtime_ns,
+            dev=st.st_dev,
         )
 
 

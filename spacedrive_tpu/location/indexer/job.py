@@ -22,6 +22,7 @@ from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
 from ...jobs.manager import register_job
 from ...telemetry import span
+from .journal import IndexJournal, Identity, key_of
 from .rules import load_rules_for_location
 from .walker import walk, walk_single_dir
 
@@ -30,10 +31,33 @@ logger = logging.getLogger(__name__)
 BATCH_SIZE = 1000  # ref:indexer_job.rs:47
 
 
-def _entry_to_step_dict(entry) -> dict[str, Any]:
+class _JournalCheck:
+    """Per-walk index-journal consult, counting verdicts for the walk
+    span (the counters themselves increment inside IndexJournal)."""
+
+    def __init__(self, journal: IndexJournal, loc_id: int):
+        self.journal = journal
+        self.loc_id = loc_id
+        self.counts: dict[str, int] = {}
+
+    def __call__(self, iso, meta) -> str:
+        verdict, _entry = self.journal.lookup(
+            self.loc_id, key_of(iso), Identity.from_metadata(meta)
+        )
+        self.counts[verdict] = self.counts.get(verdict, 0) + 1
+        if verdict == "hit" and meta.size_in_bytes:
+            # a vouched unchanged file: its whole sampled message will
+            # never be read/hashed/shipped this pass
+            from ...ops.cas import message_len
+
+            self.journal.bytes_saved(message_len(meta.size_in_bytes))
+        return verdict
+
+
+def _entry_to_step_dict(entry, update: bool = False) -> dict[str, Any]:
     iso = entry.iso_file_path
     meta = entry.metadata
-    return {
+    d = {
         "pub_id": entry.pub_id,
         "materialized_path": iso.materialized_path,
         "name": iso.name,
@@ -46,6 +70,15 @@ def _entry_to_step_dict(entry) -> dict[str, Any]:
         "hidden": bool(meta.hidden) if meta else False,
         "object_id": entry.object_id,
     }
+    if update and not iso.is_dir:
+        # a changed row whose identity the journal does NOT vouch for
+        # must lose its cas_id/object link so the identifier re-hashes
+        # the new content (a journal `hit` here means only metadata —
+        # e.g. the hidden flag — changed, so the cas is still current).
+        # Without a journal verdict (bypassed/disabled) err on re-hash:
+        # a stale cas_id is worse than a redundant one.
+        d["clear_cas"] = entry.journal_verdict != "hit"
+    return d
 
 
 @register_job
@@ -75,8 +108,11 @@ class IndexerJob(StatefulJob):
             scan_read_time=0.0, db_write_time=0.0, indexing_errors=0,
         )
         if self.init.get("shallow"):
-            rules, iso_factory, fetcher, remover = self._walk_env(ctx)
-            result = walk_single_dir(root, rules, iso_factory, fetcher, remover)
+            rules, iso_factory, fetcher, remover, jcheck = self._walk_env(ctx)
+            result = walk_single_dir(
+                root, rules, iso_factory, fetcher, remover,
+                journal_check=jcheck,
+            )
             self.steps.extend(self._steps_from_result(result))
         else:
             self.steps.extend(self._run_walk(ctx, root, None))
@@ -123,18 +159,28 @@ class IndexerJob(StatefulJob):
                 if (r["materialized_path"], r["name"], r["extension"]) not in found
             ]
 
-        return rules, iso_factory, file_paths_fetcher, to_remove_fetcher
+        return (
+            rules, iso_factory, file_paths_fetcher, to_remove_fetcher,
+            _JournalCheck(IndexJournal(library.db), loc_id),
+        )
 
     def _run_walk(self, ctx: JobContext, root: str, accepted: bool | None) -> list[dict]:
         """One bounded walk; leftover dirs become 'walk' continuation
         steps so arbitrarily large locations index completely."""
-        rules, iso_factory, fetcher, remover = self._walk_env(ctx)
-        with span("walk"):
+        rules, iso_factory, fetcher, remover, jcheck = self._walk_env(ctx)
+        with span("walk") as walk_span:
             result = walk(
                 root, rules, iso_factory, fetcher, remover,
                 update_notifier=lambda p, n: None,
                 initial_accepted_by_children=accepted,
+                journal_check=jcheck,
             )
+            if jcheck.counts:
+                # journal verdicts over EVERY walked file (unchanged
+                # files included) — the warm-pass hit-rate evidence
+                walk_span.annotate(
+                    **{f"journal_{k}": v for k, v in jcheck.counts.items()}
+                )
         steps = self._steps_from_result(result)
         for leftover in result.to_walk:
             steps.append(
@@ -157,7 +203,8 @@ class IndexerJob(StatefulJob):
         for i in range(0, len(result.to_update), BATCH_SIZE):
             steps.append(
                 {"kind": "update", "entries": [
-                    _entry_to_step_dict(e) for e in result.to_update[i:i + BATCH_SIZE]
+                    _entry_to_step_dict(e, update=True)
+                    for e in result.to_update[i:i + BATCH_SIZE]
                 ]}
             )
         removals = [r["pub_id"] for r in result.to_remove]
@@ -212,14 +259,20 @@ class IndexerJob(StatefulJob):
             if update:
                 # only the fields the local UPDATE below mutates sync —
                 # identity fields (path/name/location) can't have changed
+                fields = [
+                    ("hidden", e["hidden"]),
+                    ("size_in_bytes_bytes", e["size"]),
+                    ("inode", e["inode"]),
+                    ("date_modified", e["modified_at"]),
+                ]
+                if e.get("clear_cas"):
+                    # content changed and the journal doesn't vouch for
+                    # the old cas: void it (and the object link) so the
+                    # identifier's orphan query re-hashes this row
+                    fields.extend([("cas_id", None), ("object_id", None)])
                 ops.extend(
                     sync.shared_update("file_path", rid, f, v)
-                    for f, v in [
-                        ("hidden", e["hidden"]),
-                        ("size_in_bytes_bytes", e["size"]),
-                        ("inode", e["inode"]),
-                        ("date_modified", e["modified_at"]),
-                    ]
+                    for f, v in fields
                 )
             else:
                 ops.extend(
@@ -247,9 +300,11 @@ class IndexerJob(StatefulJob):
         def writes(conn):
             for e in entries:
                 if update:
+                    clear = ", cas_id=NULL, object_id=NULL" if e.get("clear_cas") else ""
                     conn.execute(
-                        "UPDATE file_path SET inode=?, size_in_bytes_bytes=?, "
-                        "date_modified=?, hidden=?, date_indexed=? WHERE pub_id=?",
+                        f"UPDATE file_path SET inode=?, size_in_bytes_bytes=?, "
+                        f"date_modified=?, hidden=?, date_indexed=?{clear} "
+                        f"WHERE pub_id=?",
                         (
                             u64_blob(e["inode"]), u64_blob(e["size"]),
                             e["modified_at"], int(e["hidden"]), date_indexed,

@@ -1,0 +1,517 @@
+"""Persistent per-location index journal — never hash a byte twice.
+
+The journal maps a file_path key `(location_id, materialized_path,
+name, extension)` to its last-known stat identity
+`(inode, dev, mtime_ns, size)` and the derived results that identity
+vouches for: `cas_id`, a thumbnail-stored flag, the media-metadata
+digest, the duplicate-detector pHash, and the dirty-range chunk cache
+(`ops.cas.ChunkCache`). Consumers — the walker, the file identifier,
+the media processor, the duplicate detector — consult it BEFORE reading
+any byte: an identity match means the cached result is current, so a
+warm pass stats files but only reads/hashes/ships/thumbnails the
+changed ones.
+
+Truth discipline (the journal may only ever make a pass FASTER, never
+wrong):
+
+- a verdict is `hit` only when every identity field matches exactly
+  (`st_mtime_ns`, not the float mtime) AND the entry is not stale;
+- journal writes happen strictly AFTER the store/DB commit they vouch
+  for (identifier: after the object-link sync write; thumbnails: after
+  the rendezvous confirms the webp is in the store) — a crash between
+  commit and journal write costs a redundant rehash, never a lie;
+- watcher change events mark entries `stale` (targeted invalidation)
+  instead of deleting them: a stale entry never vouches, but its chunk
+  cache still powers the dirty-range rehash;
+- any malformed row/payload (torn write, version drift) reads as
+  `bypassed` and is dropped — the pass degrades to a cold rehash.
+
+`SD_INDEX_JOURNAL=0` disables consults AND writes (every lookup counts
+as `bypassed`).
+
+Verdict counters: `sd_index_journal_ops_total{result=...}` plus
+`sd_index_journal_bytes_saved_total` (see docs/performance.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sqlite3
+from dataclasses import dataclass
+from typing import Any
+
+from ...db.database import blob_u64, now_iso, u64_blob
+from ...ops.cas import ChunkCache
+from ...telemetry import metrics as _tm
+
+logger = logging.getLogger(__name__)
+
+#: payload format version; a mismatch reads as a miss and is rewritten
+JOURNAL_FORMAT = 1
+
+#: verdict vocabulary (the metric's `result` label)
+HIT, MISS, INVALIDATED, BYPASSED = "hit", "miss", "invalidated", "bypassed"
+
+
+def enabled() -> bool:
+    return os.environ.get("SD_INDEX_JOURNAL", "1") != "0"
+
+
+@dataclass(frozen=True)
+class Identity:
+    """Exact stat identity — all four fields must match for a hit."""
+
+    inode: int
+    dev: int
+    mtime_ns: int
+    size: int
+
+    @classmethod
+    def from_stat(cls, st: os.stat_result) -> "Identity":
+        return cls(st.st_ino, st.st_dev, st.st_mtime_ns, st.st_size)
+
+    @classmethod
+    def from_metadata(cls, meta: Any) -> "Identity | None":
+        """From files.isolated_path.FilePathMetadata (walker plumbing)."""
+        if meta is None or not getattr(meta, "mtime_ns", 0):
+            return None
+        return cls(meta.inode, meta.dev, meta.mtime_ns, meta.size_in_bytes)
+
+
+def stat_identity(path: str | os.PathLike) -> Identity | None:
+    """The sanctioned stat for journal-governed pipelines (sdlint SD012
+    flags direct ``os.stat`` in those modules). None when unreadable."""
+    try:
+        return Identity.from_stat(os.stat(path))
+    except OSError:
+        return None
+
+
+# key = (materialized_path, name, extension) within one location
+Key = tuple[str, str, str]
+
+
+def key_of(row_or_iso: Any) -> Key:
+    """Key from a file_path DB row (dict) or an IsolatedFilePathData."""
+    if isinstance(row_or_iso, dict):
+        return (
+            row_or_iso["materialized_path"],
+            row_or_iso["name"],
+            row_or_iso["extension"] or "",
+        )
+    return (
+        row_or_iso.materialized_path,
+        row_or_iso.name,
+        row_or_iso.extension or "",
+    )
+
+
+@dataclass
+class JournalEntry:
+    identity: Identity | None
+    stale: bool
+    cas_id: str | None
+    thumb: bool = False
+    media_digest: str | None = None
+    phash: bytes | None = None
+    chunks: ChunkCache | None = None
+
+
+def _decode_payload(blob: Any) -> dict | None:
+    """Strictly validated payload decode; None = corrupt/foreign."""
+    if blob is None:
+        return {}
+    if not isinstance(blob, bytes):
+        return None
+    try:
+        import msgpack
+
+        obj = msgpack.unpackb(blob, raw=False)
+    except Exception:  # noqa: BLE001 - torn/corrupt payload
+        return None
+    if not isinstance(obj, dict) or obj.get("v") != JOURNAL_FORMAT:
+        return None
+    return obj
+
+
+class IndexJournal:
+    """Journal access bound to one library DB. Location scoping rides
+    in each call's `location_id` (duplicates span locations)."""
+
+    def __init__(self, db: Any):
+        self.db = db
+
+    # ---- consult -------------------------------------------------------
+
+    def lookup(
+        self, location_id: int, key: Key, identity: Identity | None,
+        count_invalidated: bool = True,
+    ) -> tuple[str, JournalEntry | None]:
+        """(verdict, entry). `hit` entries vouch for their cached
+        results; `invalidated` entries are returned too — their chunk
+        cache still powers dirty-range rehash. Every call counts on
+        `sd_index_journal_ops_total`; a pipeline RE-consulting a file
+        the walker already judged this pass (the identifier pulling the
+        chunk cache) passes `count_invalidated=False` so one changed
+        file counts one invalidation, keeping the hit rate per-file."""
+        if not enabled():
+            _tm.INDEX_JOURNAL_OPS.inc(result="bypassed")
+            return BYPASSED, None
+        mat, name, ext = key
+        try:
+            row = self.db.query_one(
+                "SELECT * FROM index_journal WHERE location_id = ? AND "
+                "materialized_path = ? AND name = ? AND extension = ?",
+                (location_id, mat, name, ext),
+            )
+        except sqlite3.Error:
+            _tm.INDEX_JOURNAL_OPS.inc(result="bypassed")
+            return BYPASSED, None
+        if row is None:
+            _tm.INDEX_JOURNAL_OPS.inc(result="miss")
+            return MISS, None
+        entry = self._entry_of(row)
+        if entry is None:
+            # corrupt row: drop it so the next pass starts clean
+            self._delete_key(location_id, key)
+            _tm.INDEX_JOURNAL_OPS.inc(result="bypassed")
+            return BYPASSED, None
+        if (
+            not entry.stale
+            and identity is not None
+            and entry.identity == identity
+        ):
+            _tm.INDEX_JOURNAL_OPS.inc(result="hit")
+            return HIT, entry
+        if count_invalidated:
+            _tm.INDEX_JOURNAL_OPS.inc(result="invalidated")
+        return INVALIDATED, entry
+
+    def _entry_of(self, row: dict) -> JournalEntry | None:
+        payload = _decode_payload(row.get("payload"))
+        if payload is None:
+            return None
+        try:
+            ident = None
+            if row.get("inode") is not None:
+                ident = Identity(
+                    blob_u64(row["inode"]), blob_u64(row["dev"]),
+                    blob_u64(row["mtime_ns"]), blob_u64(row["size"]),
+                )
+            chunks = None
+            if payload.get("chunks") is not None:
+                chunks = ChunkCache.from_payload(payload["chunks"])
+                if chunks is None:
+                    return None  # torn chunk cache → whole row suspect
+            cas = row.get("cas_id")
+            media = payload.get("media")
+            phash = payload.get("phash")
+            if cas is not None and not isinstance(cas, str):
+                return None
+            if media is not None and not isinstance(media, str):
+                return None
+            if phash is not None and (
+                not isinstance(phash, bytes) or len(phash) != 8
+            ):
+                return None
+            return JournalEntry(
+                identity=ident,
+                stale=bool(row.get("stale")),
+                cas_id=cas,
+                thumb=bool(payload.get("thumb")),
+                media_digest=media,
+                phash=phash,
+                chunks=chunks,
+            )
+        except (TypeError, ValueError):
+            return None
+
+    # ---- record --------------------------------------------------------
+
+    def record_cas(
+        self,
+        location_id: int,
+        key: Key,
+        identity: Identity,
+        cas_id: str,
+        chunks: ChunkCache | None = None,
+    ) -> None:
+        """Fresh vouch after the identifier's DB commit. Replaces the
+        identity and cas; carries forward nothing (content changed ⇒
+        thumb/media/phash vouches are void)."""
+        if not enabled():
+            return
+        payload: dict[str, Any] = {"v": JOURNAL_FORMAT}
+        if chunks is not None:
+            payload["chunks"] = chunks.to_payload()
+        self._write(location_id, key, identity, cas_id, payload)
+
+    def record_many(
+        self,
+        location_id: int,
+        records: list[
+            tuple[Key, Identity, str, ChunkCache | None, JournalEntry | None]
+        ],
+    ) -> None:
+        """Batch vouch (one transaction — an identifier window writes
+        up to 1024×accelerators rows; per-row commits would dominate).
+        Each record may carry the PRIOR journal entry: when the
+        recomputed cas matches its cas_id the content is unchanged (an
+        mtime-only touch), so the thumb/media/phash vouches carry
+        forward instead of forcing a re-thumbnail + EXIF re-probe."""
+        if not enabled() or not records:
+            return
+        import msgpack
+
+        stamp = now_iso()
+        rows = []
+        for (mat, name, ext), ident, cas, chunks, carry in records:
+            payload: dict[str, Any] = {"v": JOURNAL_FORMAT}
+            if chunks is not None:
+                payload["chunks"] = chunks.to_payload()
+            if carry is not None and carry.cas_id == cas:
+                if carry.thumb:
+                    payload["thumb"] = True
+                if carry.media_digest is not None:
+                    payload["media"] = carry.media_digest
+                if carry.phash is not None:
+                    payload["phash"] = carry.phash
+            rows.append((
+                location_id, mat, name, ext,
+                u64_blob(ident.inode), u64_blob(ident.dev),
+                u64_blob(ident.mtime_ns), u64_blob(ident.size),
+                cas, msgpack.packb(payload), stamp,
+            ))
+        try:
+            self.db.executemany(
+                "INSERT INTO index_journal (location_id, materialized_path, "
+                "name, extension, inode, dev, mtime_ns, size, cas_id, "
+                "payload, stale, date_vouched) "
+                "VALUES (?,?,?,?,?,?,?,?,?,?,0,?) "
+                "ON CONFLICT (location_id, materialized_path, name, extension) "
+                "DO UPDATE SET inode=excluded.inode, dev=excluded.dev, "
+                "mtime_ns=excluded.mtime_ns, size=excluded.size, "
+                "cas_id=excluded.cas_id, payload=excluded.payload, "
+                "stale=0, date_vouched=excluded.date_vouched",
+                rows,
+            )
+        except sqlite3.Error:
+            logger.exception("index journal batch write failed (non-fatal)")
+
+    def _write(
+        self, location_id: int, key: Key, identity: Identity | None,
+        cas_id: str | None, payload: dict,
+    ) -> None:
+        import msgpack
+
+        mat, name, ext = key
+        try:
+            self.db.execute(
+                "INSERT INTO index_journal (location_id, materialized_path, "
+                "name, extension, inode, dev, mtime_ns, size, cas_id, "
+                "payload, stale, date_vouched) "
+                "VALUES (?,?,?,?,?,?,?,?,?,?,0,?) "
+                "ON CONFLICT (location_id, materialized_path, name, extension) "
+                "DO UPDATE SET inode=excluded.inode, dev=excluded.dev, "
+                "mtime_ns=excluded.mtime_ns, size=excluded.size, "
+                "cas_id=excluded.cas_id, payload=excluded.payload, "
+                "stale=0, date_vouched=excluded.date_vouched",
+                (
+                    location_id, mat, name, ext,
+                    u64_blob(identity.inode) if identity else None,
+                    u64_blob(identity.dev) if identity else None,
+                    u64_blob(identity.mtime_ns) if identity else None,
+                    u64_blob(identity.size) if identity else None,
+                    cas_id,
+                    msgpack.packb(payload),
+                    now_iso(),
+                ),
+            )
+        except sqlite3.Error:
+            logger.exception("index journal write failed (non-fatal)")
+
+    def _amend_payload(
+        self, location_id: int, key: Key, cas_id: str | None, **updates: Any,
+    ) -> None:
+        """Merge fields into a FRESH entry's payload. Refuses when the
+        row is missing, stale, or vouches a different cas — an amend
+        must never resurrect an invalidated vouch."""
+        if not enabled():
+            return
+        import msgpack
+
+        mat, name, ext = key
+        try:
+            with self.db.transaction() as conn:
+                row = conn.execute(
+                    "SELECT payload, cas_id, stale FROM index_journal "
+                    "WHERE location_id = ? AND materialized_path = ? "
+                    "AND name = ? AND extension = ?",
+                    (location_id, mat, name, ext),
+                ).fetchone()
+                if row is None or row["stale"]:
+                    return
+                if cas_id is not None and row["cas_id"] != cas_id:
+                    return
+                payload = _decode_payload(row["payload"])
+                if payload is None:
+                    return
+                payload["v"] = JOURNAL_FORMAT
+                payload.update(updates)
+                conn.execute(
+                    "UPDATE index_journal SET payload = ?, date_vouched = ? "
+                    "WHERE location_id = ? AND materialized_path = ? "
+                    "AND name = ? AND extension = ?",
+                    (msgpack.packb(payload), now_iso(), location_id, mat,
+                     name, ext),
+                )
+        except sqlite3.Error:
+            logger.exception("index journal amend failed (non-fatal)")
+
+    def vouch_thumb(self, location_id: int, key: Key, cas_id: str) -> None:
+        """Mark the thumbnail stored — call ONLY after the webp landed
+        in the store (crash between store and this write is safe: the
+        next pass re-checks the store and re-vouches)."""
+        self._amend_payload(location_id, key, cas_id, thumb=True)
+
+    def vouch_media(self, location_id: int, key: Key, cas_id: str | None,
+                    digest: str) -> None:
+        """Record the media-metadata digest after the media_data upsert.
+        An empty digest is a valid vouch: "probed, nothing to extract"
+        — it stops warm passes from re-probing EXIF-less files."""
+        self._amend_payload(location_id, key, cas_id, media=digest)
+
+    def record_phash(self, location_id: int, key: Key, cas_id: str | None,
+                     phash: bytes) -> None:
+        self._amend_payload(location_id, key, cas_id, phash=bytes(phash))
+
+    # ---- invalidate ----------------------------------------------------
+
+    def mark_stale(self, location_id: int, key: Key) -> int:
+        """Targeted watcher invalidation: the entry stops vouching but
+        keeps its chunk cache for the dirty-range rehash."""
+        if not enabled():
+            return 0
+        mat, name, ext = key
+        try:
+            n = self.db.execute(
+                "UPDATE index_journal SET stale = 1 WHERE location_id = ? "
+                "AND materialized_path = ? AND name = ? AND extension = ? "
+                "AND stale = 0",
+                (location_id, mat, name, ext),
+            ).rowcount
+        except sqlite3.Error:
+            return 0
+        if n:
+            _tm.INDEX_JOURNAL_OPS.inc(n, result="invalidated")
+        return n
+
+    def mark_stale_subtree(self, location_id: int, prefix: str) -> int:
+        """Invalidate every entry under a materialized-path prefix
+        (lost watcher events / RESCAN: unknown depths changed)."""
+        if not enabled():
+            return 0
+        try:
+            n = self.db.execute(
+                "UPDATE index_journal SET stale = 1 WHERE location_id = ? "
+                "AND substr(materialized_path, 1, ?) = ? AND stale = 0",
+                (location_id, len(prefix), prefix),
+            ).rowcount
+        except sqlite3.Error:
+            return 0
+        if n:
+            _tm.INDEX_JOURNAL_OPS.inc(n, result="invalidated")
+        return n
+
+    def _delete_key(self, location_id: int, key: Key) -> None:
+        mat, name, ext = key
+        try:
+            self.db.execute(
+                "DELETE FROM index_journal WHERE location_id = ? AND "
+                "materialized_path = ? AND name = ? AND extension = ?",
+                (location_id, mat, name, ext),
+            )
+        except sqlite3.Error:
+            pass
+
+    def delete_path(self, location_id: int, key: Key,
+                    subtree_prefix: str | None = None) -> None:
+        """Remove journal rows for a deleted path (and, for a removed
+        directory, its whole subtree)."""
+        if not enabled():
+            return
+        self._delete_key(location_id, key)
+        if subtree_prefix is not None:
+            try:
+                self.db.execute(
+                    "DELETE FROM index_journal WHERE location_id = ? AND "
+                    "substr(materialized_path, 1, ?) = ?",
+                    (location_id, len(subtree_prefix), subtree_prefix),
+                )
+            except sqlite3.Error:
+                pass
+
+    def rename_path(
+        self, location_id: int, old_key: Key, new_key: Key,
+        old_prefix: str | None = None, new_prefix: str | None = None,
+    ) -> None:
+        """A rename moves the key but keeps every vouch: content,
+        thumbnail, and media are untouched by a rename. For a directory,
+        pass the old/new materialized-path prefixes to move the subtree."""
+        if not enabled():
+            return
+        try:
+            # landing on an existing key would violate the PK: clear it
+            self._delete_key(location_id, new_key)
+            self.db.execute(
+                "UPDATE index_journal SET materialized_path = ?, name = ?, "
+                "extension = ? WHERE location_id = ? AND "
+                "materialized_path = ? AND name = ? AND extension = ?",
+                (*new_key, location_id, *old_key),
+            )
+            if old_prefix is not None and new_prefix is not None:
+                rows = self.db.query(
+                    "SELECT materialized_path, name, extension FROM "
+                    "index_journal WHERE location_id = ? AND "
+                    "substr(materialized_path, 1, ?) = ?",
+                    (location_id, len(old_prefix), old_prefix),
+                )
+                for r in rows:
+                    moved = new_prefix + r["materialized_path"][len(old_prefix):]
+                    self._delete_key(
+                        location_id, (moved, r["name"], r["extension"])
+                    )
+                    self.db.execute(
+                        "UPDATE index_journal SET materialized_path = ? "
+                        "WHERE location_id = ? AND materialized_path = ? "
+                        "AND name = ? AND extension = ?",
+                        (moved, location_id, r["materialized_path"],
+                         r["name"], r["extension"]),
+                    )
+        except sqlite3.Error:
+            logger.exception("index journal rename failed (non-fatal)")
+
+    def bytes_saved(self, n: int) -> None:
+        if n > 0:
+            _tm.INDEX_JOURNAL_BYTES_SAVED.inc(n)
+
+
+def prune_orphans(db: Any) -> int:
+    """Drop journal rows whose file_path row vanished — the journal's
+    share of the orphan-remover pass (object/orphan_remover.py). Uses
+    the DB as the liveness source instead of re-stat'ing paths on disk."""
+    try:
+        n = db.execute(
+            "DELETE FROM index_journal WHERE NOT EXISTS ("
+            "SELECT 1 FROM file_path fp WHERE "
+            "fp.location_id = index_journal.location_id AND "
+            "fp.materialized_path = index_journal.materialized_path AND "
+            "fp.name = index_journal.name AND "
+            "fp.extension = index_journal.extension)"
+        ).rowcount
+    except sqlite3.Error:
+        return 0
+    if n:
+        _tm.INDEX_JOURNAL_OPS.inc(n, result="invalidated")
+    return n

@@ -33,6 +33,11 @@ class WalkedEntry:
     metadata: FilePathMetadata | None
     pub_id: bytes = field(default_factory=lambda: uuid.uuid4().bytes)
     object_id: int | None = None  # set for to_update entries
+    # index-journal verdict for file entries ("hit"|"miss"|"invalidated"|
+    # "bypassed"; None when no journal was consulted) — a non-hit on a
+    # to_update entry tells the job to clear cas_id so the identifier
+    # re-hashes the changed content
+    journal_verdict: str | None = None
 
     def key(self):
         return self.iso_file_path
@@ -61,8 +66,12 @@ class WalkResult:
 #        materialized_path, name, extension, is_dir}
 #   to_remove_db_fetcher(parent_iso, found_iso_paths) -> rows
 #       {pub_id, cas_id, object_id, ...}
+#   journal_check(iso, metadata) -> verdict string — the index-journal
+#       consult for every walked FILE (location/indexer/journal.py);
+#       injected like the DB fetchers so the walker stays hermetic
 FilePathsFetcher = Callable[[list[IsolatedFilePathData]], list[dict]]
 ToRemoveFetcher = Callable[[IsolatedFilePathData, list[IsolatedFilePathData]], list[dict]]
+JournalCheck = Callable[[IsolatedFilePathData, FilePathMetadata], str]
 
 
 def walk(
@@ -74,6 +83,7 @@ def walk(
     update_notifier: Callable[[str, int], None] | None = None,
     limit: int = 100_000,
     initial_accepted_by_children: bool | None = None,
+    journal_check: JournalCheck | None = None,
 ) -> WalkResult:
     """Full recursive walk from `root` (ref:walk.rs:119-200). When the
     limit is hit, the remaining dirs come back in `to_walk` so callers
@@ -101,7 +111,9 @@ def walk(
         if len(indexed_paths) >= limit:
             break
 
-    walked, to_update = _filter_existing_paths(indexed_paths, file_paths_db_fetcher)
+    walked, to_update = _filter_existing_paths(
+        indexed_paths, file_paths_db_fetcher, journal_check
+    )
     return WalkResult(walked, to_update, to_walk, to_remove, errors, paths_and_sizes)
 
 
@@ -111,6 +123,7 @@ def walk_single_dir(
     iso_file_path_factory: Callable[[str, bool], IsolatedFilePathData],
     file_paths_db_fetcher: FilePathsFetcher,
     to_remove_db_fetcher: ToRemoveFetcher,
+    journal_check: JournalCheck | None = None,
 ) -> WalkResult:
     """Shallow walk (one directory, no recursion) — the light-rescan
     path (ref:walk.rs:265 walk_single_dir, shallow.rs)."""
@@ -121,7 +134,9 @@ def walk_single_dir(
         root, ToWalkEntry(root), indexer_rules, iso_file_path_factory,
         to_remove_db_fetcher, indexed_paths, None, errors, None,
     )
-    walked, to_update = _filter_existing_paths(indexed_paths, file_paths_db_fetcher)
+    walked, to_update = _filter_existing_paths(
+        indexed_paths, file_paths_db_fetcher, journal_check
+    )
     return WalkResult(walked, to_update, [], removed, errors, {root: size})
 
 
@@ -243,12 +258,23 @@ def _inner_walk_single_dir(
 def _filter_existing_paths(
     indexed_paths: dict[IsolatedFilePathData, WalkedEntry],
     file_paths_db_fetcher: FilePathsFetcher,
+    journal_check: JournalCheck | None = None,
 ) -> tuple[list[WalkedEntry], list[WalkedEntry]]:
     """Split into (to_create, to_update) against existing DB rows
     (ref:walk.rs:334-430): an existing row updates when inode, mtime
-    (±1 ms) or hidden changed — directory sizes are ignored."""
+    (±1 ms) or hidden changed — directory sizes are ignored. Every FILE
+    entry additionally gets its index-journal verdict (the per-file
+    hit/miss/invalidated stream a warm pass is measured by)."""
     if not indexed_paths:
         return [], []
+    if journal_check is not None:
+        for iso, entry in indexed_paths.items():
+            if not iso.is_dir and entry.metadata is not None:
+                try:
+                    entry.journal_verdict = journal_check(iso, entry.metadata)
+                except Exception:  # noqa: BLE001 - journal must not kill walks
+                    logger.exception("journal_check failed")
+                    entry.journal_verdict = None
     try:
         rows = file_paths_db_fetcher(list(indexed_paths.keys()))
     except Exception:  # noqa: BLE001 - treat fetch failure as "no rows"

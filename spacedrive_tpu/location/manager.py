@@ -28,6 +28,7 @@ from ..db.database import now_iso
 from ..files.isolated_path import IsolatedFilePathData
 from ..telemetry.events import WATCHER_EVENTS
 from ..utils.tasks import supervise
+from .indexer.journal import IndexJournal, key_of
 from .locations import deep_rescan_sub_path, light_scan_location
 from .watcher import EventKind, WatchEvent, new_watcher
 
@@ -141,6 +142,7 @@ class LocationManager:
         self.events_applied += 1
         db = entry.library.db
         loc_id = entry.location["id"]
+        journal = IndexJournal(db)
         kind = event.kind
         try:
             if kind == EventKind.RENAME:
@@ -154,8 +156,14 @@ class LocationManager:
                 self._apply_remove(db, loc_id, rel, event.is_dir)
                 return
             if kind == EventKind.RESCAN:
-                # events were lost at unknown depths — full rescan
-                entry.deep_dirs.add("/" + rel.strip("/"))
+                # events were lost at unknown depths — full rescan, and
+                # the journal stops vouching for the whole subtree (the
+                # losses may hide sub-mtime-granularity modifications)
+                sub = "/" + rel.strip("/")
+                journal.mark_stale_subtree(
+                    loc_id, sub if sub.endswith("/") else sub + "/"
+                )
+                entry.deep_dirs.add(sub)
             elif kind == EventKind.MODIFY and rel == "" and event.is_dir:
                 return  # attrib touch on the location root: nothing to do
             elif kind == EventKind.CREATE and event.is_dir:
@@ -163,8 +171,16 @@ class LocationManager:
                 # per-child events: recursively scan the dir itself
                 entry.deep_dirs.add("/" + rel.strip("/"))
             else:
-                # CREATE/MODIFY file: shallow rescan of the parent batches
-                # new/changed files into the indexer→identifier pipeline
+                # CREATE/MODIFY file: a TARGETED journal invalidation —
+                # the entry stops vouching (its chunk cache stays for
+                # the dirty-range rehash) — then a shallow rescan of the
+                # parent batches the changed file into the
+                # indexer→identifier pipeline; unchanged siblings stay
+                # journal-vouched through that rescan
+                iso = IsolatedFilePathData.from_relative_str(
+                    loc_id, rel, False
+                )
+                journal.mark_stale(loc_id, key_of(iso))
                 parent = os.path.dirname(rel)
                 entry.dirty_dirs.add("/" + parent.replace(os.sep, "/").strip("/"))
             self._schedule_flush(entry)
@@ -175,6 +191,20 @@ class LocationManager:
         self, db: Any, loc_id: int, old_rel: str, new_rel: str, is_dir: bool
     ) -> None:
         old_iso = IsolatedFilePathData.from_relative_str(loc_id, old_rel, is_dir)
+        # a rename changes no content: the journal entry MOVES with the
+        # file, keeping its cas/thumb/media vouches — no re-hash, no
+        # re-thumbnail (the cheapest possible "targeted re-index")
+        _new_iso = IsolatedFilePathData.from_relative_str(loc_id, new_rel, is_dir)
+        IndexJournal(db).rename_path(
+            loc_id, key_of(old_iso), key_of(_new_iso),
+            *(
+                (
+                    f"{old_iso.materialized_path}{old_iso.name}/",
+                    f"{_new_iso.materialized_path}{_new_iso.name}/",
+                )
+                if is_dir else (None, None)
+            ),
+        )
         row = db.find_one(
             "file_path",
             location_id=loc_id,
@@ -213,8 +243,13 @@ class LocationManager:
 
     def _apply_remove(self, db: Any, loc_id: int, rel: str, is_dir: bool) -> None:
         # the event's is_dir can be unknowable post-deletion: try file then dir
+        journal = IndexJournal(db)
         for as_dir in ([is_dir] if is_dir else [False, True]):
             iso = IsolatedFilePathData.from_relative_str(loc_id, rel, as_dir)
+            journal.delete_path(
+                loc_id, key_of(iso),
+                f"{iso.materialized_path}{iso.name}/" if as_dir else None,
+            )
             row = db.find_one(
                 "file_path",
                 location_id=loc_id,

@@ -23,6 +23,7 @@ from ..files.kind import ObjectKind
 from ..jobs import StatefulJob
 from ..jobs.job import JobContext, StepResult
 from ..jobs.manager import register_job
+from ..location.indexer import journal as _journal
 from ..ops import phash_jax
 
 logger = logging.getLogger(__name__)
@@ -47,7 +48,8 @@ class DuplicateDetectorJob(StatefulJob):
             params.append(int(self.init["location_id"]))
         rows = db.query(
             "SELECT o.id AS object_id, fp.cas_id, fp.location_id, "
-            "fp.materialized_path, fp.name, fp.extension, fp.is_dir "
+            "fp.materialized_path, fp.name, fp.extension, fp.is_dir, "
+            "fp.size_in_bytes_bytes "
             "FROM object o JOIN file_path fp ON fp.object_id = o.id "
             f"WHERE {' AND '.join(conds)} GROUP BY o.id",
             params,
@@ -61,6 +63,14 @@ class DuplicateDetectorJob(StatefulJob):
             phase="phash",
         )
 
+    def _location(self, ctx: JobContext, location_id: int) -> dict | None:
+        locs = self.data.setdefault("_loc_cache", {})
+        loc = locs.get(location_id)
+        if loc is None:
+            loc = ctx.library.db.find_one("location", id=location_id)
+            locs[location_id] = loc
+        return loc
+
     def _decode_gray(self, ctx: JobContext, row: dict) -> np.ndarray | None:
         """Original-first decode: JPEG draft mode pulls a 1/8-scale DCT
         decode, so cost stays low while avoiding the distance inflation
@@ -68,11 +78,7 @@ class DuplicateDetectorJob(StatefulJob):
         thumbnail is the fallback when the original is gone/undecodable."""
         from PIL import Image
 
-        locs = self.data.setdefault("_loc_cache", {})
-        loc = locs.get(row["location_id"])
-        if loc is None:
-            loc = ctx.library.db.find_one("location", id=row["location_id"])
-            locs[row["location_id"]] = loc
+        loc = self._location(ctx, row["location_id"])
         if loc is not None:
             from ..files.isolated_path import full_path_from_db_row
 
@@ -102,23 +108,70 @@ class DuplicateDetectorJob(StatefulJob):
     async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
         import asyncio
 
+        from ..db.database import blob_u64
+
         rows = step["rows"]
-        grays = await asyncio.to_thread(
-            lambda: [self._decode_gray(ctx, r) for r in rows]
-        )
-        ok = [(r, g) for r, g in zip(rows, grays) if g is not None]
-        skipped = len(rows) - len(ok)
+        journal = _journal.IndexJournal(ctx.library.db)
+
+        def consult(r: dict):
+            """Journal-vouched pHash: skip the original's full decode
+            when a fresh entry for this exact cas already carries one."""
+            from ..files.isolated_path import full_path_from_db_row
+
+            loc = self._location(ctx, r["location_id"])
+            if loc is None:
+                return None
+            # count_invalidated=False: the walker already counted this
+            # pass's invalidations — keep the hit rate per-file
+            verdict, entry = journal.lookup(
+                r["location_id"], _journal.key_of(r),
+                _journal.stat_identity(full_path_from_db_row(loc["path"], r)),
+                count_invalidated=False,
+            )
+            if (
+                verdict == _journal.HIT and entry is not None
+                and entry.phash is not None and entry.cas_id == r["cas_id"]
+            ):
+                journal.bytes_saved(blob_u64(r["size_in_bytes_bytes"]) or 0)
+                return entry.phash
+            return None
+
+        def decode_all():
+            cached, grays = [], []
+            for r in rows:
+                ph = consult(r)
+                cached.append(ph)
+                grays.append(None if ph is not None else self._decode_gray(ctx, r))
+            return cached, grays
+
+        cached, grays = await asyncio.to_thread(decode_all)
+        ok = [
+            (r, g) for r, g, c in zip(rows, grays, cached)
+            if g is not None and c is None
+        ]
+        reused = [(r, c) for r, c in zip(rows, cached) if c is not None]
+        skipped = len(rows) - len(ok) - len(reused)
+        updates: list[tuple[bytes, int]] = [
+            (ph, row["object_id"]) for row, ph in reused
+        ]
+        hashed_pairs: list[tuple[dict, bytes]] = []
         if ok:
             batch = np.stack([g for _r, g in ok])
             hashes = await asyncio.to_thread(phash_jax.phash_batch, batch)
+            for (row, _g), h in zip(ok, hashes):
+                updates.append((h.tobytes(), row["object_id"]))
+                hashed_pairs.append((row, h.tobytes()))
+        if updates:
             ctx.library.db.executemany(
-                "UPDATE object SET phash = ? WHERE id = ?",
-                [
-                    (h.tobytes(), row["object_id"])
-                    for (row, _g), h in zip(ok, hashes)
-                ],
+                "UPDATE object SET phash = ? WHERE id = ?", updates
+            )
+        # journal writes ordered after the phash rows committed
+        for row, ph in hashed_pairs:
+            journal.record_phash(
+                row["location_id"], _journal.key_of(row), row["cas_id"], ph
             )
         self.run_metadata["hashed"] += len(ok)
+        self.run_metadata["reused"] = self.run_metadata.get("reused", 0) + len(reused)
         self.run_metadata["skipped"] += skipped
         ctx.progress(completed_task_count=step_number + 1)
         return StepResult()

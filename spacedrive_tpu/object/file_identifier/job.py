@@ -29,6 +29,7 @@ from ...files.kind import ObjectKind
 from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
 from ...jobs.manager import register_job
+from ...location.indexer import journal as _journal
 from ...ops import cas
 from ...telemetry import metrics as _tm
 from ...telemetry import span
@@ -105,6 +106,7 @@ class FileIdentifierJob(StatefulJob):
         self.run_metadata.update(
             total_orphan_paths=total, created_objects=0, linked_objects=0,
             hash_time=0.0, db_time=0.0,
+            journal_hits=0, journal_dirty_rehash=0,
         )
         ctx.progress(
             task_count=n_steps,
@@ -115,7 +117,14 @@ class FileIdentifierJob(StatefulJob):
         """Read+dispatch stage: one cursor window of rows, their sampled
         bytes, and — on the device path — the hash batch already
         dispatched (async) so back-to-back windows pipeline transfers.
-        Runs on a worker thread; disk I/O never blocks the loop."""
+        Runs on a worker thread; disk I/O never blocks the loop.
+
+        The index journal is consulted per row BEFORE any byte is read:
+        a `hit` reuses the vouched cas_id with zero I/O; an invalidated
+        entry with a chunk cache and an unchanged message length takes
+        the host dirty-range rehash (only dirty chunks pay BLAKE3, zero
+        bytes shipped to the device); everything else rides the device
+        batch as before."""
         d = self.data
         params: list[Any] = [d["location_id"]]
         where = orphan_where_clause(self.init.get("sub_path"))
@@ -127,24 +136,80 @@ class FileIdentifierJob(StatefulJob):
             tuple(params) + (cursor, d["chunk_size"]),
         )
         loc_path = d["location_path"]
+        loc_id = d["location_id"]
+        journal = _journal.IndexJournal(library.db)
         metas: list[dict | None] = []
         messages: list[bytes] = []
         msg_rows: list[dict] = []
+        resolved: dict[int, str] = {}  # row id -> cas from journal/dirty-range
+        # row id -> (key, identity, cas, chunk cache, prior entry) to
+        # vouch after commit; the prior entry lets an unchanged-content
+        # re-record (mtime-only touch) keep its thumb/media/phash vouches
+        to_record: dict[int, tuple] = {}
+        jstats = {"hit": 0, "dirty": 0, "dirty_chunks": 0}
         for row in rows:
             full = _row_full_path(loc_path, row)
             size = blob_u64(row["size_in_bytes_bytes"]) or 0
+            key = _journal.key_of(row)
             if size == 0:
                 metas.append({"row": row, "cas_id": None})
+                # journal the empty file (cas sentinel "") so warm-pass
+                # walks get a `hit` instead of an eternal miss
+                ident = _journal.stat_identity(full)
+                if ident is not None:
+                    to_record[row["id"]] = (key, ident, "", None, None)
                 continue
+            ident = _journal.stat_identity(full)
+            entry = None
+            if ident is not None:
+                # the walker already counted this file's verdict this
+                # pass — don't double-count the invalidation here
+                verdict, entry = journal.lookup(
+                    loc_id, key, ident, count_invalidated=False
+                )
+                if verdict == _journal.HIT and entry.cas_id:
+                    # vouched: skip the read, the hash, and the transfer
+                    resolved[row["id"]] = entry.cas_id
+                    journal.bytes_saved(cas.message_len(size))
+                    jstats["hit"] += 1
+                    metas.append({"row": row, "cas_id": "journal"})
+                    continue
             try:
                 msg = cas.read_message(full, size)
             except OSError as e:
                 metas.append(None)
                 logger.debug("identifier: unreadable %s: %s", full, e)
                 continue
+            if (
+                ident is not None
+                and entry is not None
+                and entry.chunks is not None
+                and entry.chunks.msg_len == len(msg)
+                and len(msg) > cas.CHUNK_LEN
+            ):
+                try:
+                    cas_id, cache, n_dirty, hashed = cas.dirty_range_rehash(
+                        msg, entry.chunks
+                    )
+                except ValueError:
+                    cache = None
+                else:
+                    resolved[row["id"]] = cas_id
+                    to_record[row["id"]] = (key, ident, cas_id, cache, entry)
+                    journal.bytes_saved(len(msg) - hashed)
+                    _tm.INDEX_BYTES_HASHED.inc(hashed)
+                    jstats["dirty"] += 1
+                    jstats["dirty_chunks"] += n_dirty
+                    metas.append({"row": row, "cas_id": "journal"})
+                    continue
             messages.append(msg)
             msg_rows.append(row)
             metas.append({"row": row, "cas_id": "pending"})
+            if ident is not None:
+                # cas filled in post-hash; digest-only chunk cache so the
+                # FIRST in-place modification can already diff chunks
+                to_record[row["id"]] = (key, ident, None,
+                                        cas.build_chunk_cache(msg), entry)
         backend = d["backend"]
         use_device = backend in ("tpu", "device") or (
             backend == "auto" and cas._device_available()
@@ -172,7 +237,7 @@ class FileIdentifierJob(StatefulJob):
 
         else:
             finisher = lambda: cas.cas_ids(messages, backend)
-        return rows, metas, messages, msg_rows, finisher
+        return rows, metas, messages, msg_rows, finisher, resolved, to_record, jstats
 
     async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
         import asyncio
@@ -215,13 +280,22 @@ class FileIdentifierJob(StatefulJob):
         take_time = time.perf_counter() - t0
         if window is None:
             return StepResult()
-        rows, metas, messages, msg_rows, finisher = window
+        rows, metas, messages, msg_rows, finisher, resolved, to_record, jstats = window
         d["cursor"] = rows[-1]["id"]
 
         _tm.IDENTIFIER_BATCH_FILL.observe(len(rows) / d["chunk_size"])
-        async with span("identify.hash",
-                        nbytes=sum(len(m) for m in messages)) as hash_span:
+        msg_bytes = sum(len(m) for m in messages)
+        async with span("identify.hash", nbytes=msg_bytes) as hash_span:
             cas_ids = await asyncio.to_thread(finisher)
+            if jstats["hit"] or jstats["dirty"]:
+                # journal verdict on the trace: how much of this window
+                # the journal spared the device
+                hash_span.annotate(
+                    journal_hits=jstats["hit"],
+                    journal_dirty_rehash=jstats["dirty"],
+                    journal_dirty_chunks=jstats["dirty_chunks"],
+                )
+        _tm.INDEX_BYTES_HASHED.inc(msg_bytes)
         # run_metadata keeps its historical take+finish meaning; the
         # STAGE metric must cover only the finisher, or feeder wait
         # (its own series) would masquerade as device-hash time
@@ -230,10 +304,23 @@ class FileIdentifierJob(StatefulJob):
                                              stage="hash")
 
         by_row_id = {r["id"]: c for r, c in zip(msg_rows, cas_ids)}
+        by_row_id.update(resolved)
 
         t1 = time.perf_counter()
         async with span("identify.db"):
             created, linked = self._link_objects(library, rows, by_row_id)
+            # journal vouches ONLY after the cas/object sync write
+            # committed: a crash in between costs a redundant rehash on
+            # resume, never a journal entry ahead of the DB
+            records = []
+            for row_id, (key, ident, cas_hex, cache, carry) in to_record.items():
+                if cas_hex is None:
+                    cas_hex = by_row_id.get(row_id)
+                if cas_hex is not None:  # "" = vouched-empty sentinel
+                    records.append((key, ident, cas_hex, cache, carry))
+            _journal.IndexJournal(library.db).record_many(
+                d["location_id"], records
+            )
         db_time = time.perf_counter() - t1
         _tm.IDENTIFIER_STAGE_SECONDS.observe(db_time, stage="db")
         _tm.IDENTIFIER_FILES.inc(len(rows))
@@ -253,6 +340,13 @@ class FileIdentifierJob(StatefulJob):
                 "linked_objects": self.run_metadata["linked_objects"] + linked,
                 "hash_time": round(self.run_metadata["hash_time"] + hash_time, 4),
                 "db_time": round(self.run_metadata["db_time"] + db_time, 4),
+                "journal_hits": (
+                    self.run_metadata.get("journal_hits", 0) + jstats["hit"]
+                ),
+                "journal_dirty_rehash": (
+                    self.run_metadata.get("journal_dirty_rehash", 0)
+                    + jstats["dirty"]
+                ),
             },
         )
 

@@ -13,17 +13,27 @@ import logging
 import os
 from typing import Any
 
-from ...db.database import escape_like
+import hashlib
+
+from ...db.database import blob_u64, escape_like
 from ...files.isolated_path import full_path_from_db_row as _full_path
 from ...files.isolated_path import materialized_prefix
 from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
 from ...jobs.manager import register_job
+from ...location.indexer import journal as _journal
 from .media_data import ImageMetadata
 
 logger = logging.getLogger(__name__)
 
 BATCH_SIZE = 10  # ref:media_processor/job.rs:50
+
+
+def _media_digest(cols: dict) -> str:
+    """Stable digest of an extracted media_data row — the journal's
+    "this metadata is already in the DB" vouch."""
+    canon = repr(sorted(cols.items())).encode()
+    return hashlib.blake2b(canon, digest_size=8).hexdigest()
 
 # extensions we can thumbnail / extract exif from (decodable subset of
 # the reference's FILTERED_{IMAGE,VIDEO}_EXTENSIONS; videos get a
@@ -66,32 +76,79 @@ class MediaProcessorJob(StatefulJob):
             sub_filter = " AND materialized_path LIKE ? ESCAPE '\\'"
             params.append(escape_like(materialized_prefix(self.init['sub_path'])) + "%")
         rows = library.db.query(
-            f"SELECT id, pub_id, cas_id, object_id, materialized_path, name, extension "
+            f"SELECT id, pub_id, cas_id, object_id, materialized_path, name, "
+            f"extension, size_in_bytes_bytes "
             f"FROM file_path WHERE location_id = ? AND is_dir = 0 "
             f"AND object_id IS NOT NULL AND cas_id IS NOT NULL "
             f"AND extension IN ({qmarks}){sub_filter}",
             tuple(params),
         )
 
-        # dispatch ALL thumbnails up-front to the node thumbnailer actor
-        # (ref:job.rs:148-156); the job only awaits counts later.
+        # consult the index journal per row BEFORE dispatching work: a
+        # fresh entry vouching this exact cas_id skips the thumbnail
+        # dispatch (thumb already stored) and the EXIF re-extract —
+        # the warm-pass "never re-thumbnail an unchanged byte" half.
+        # Off-loop: the loop stats + SELECTs once per media file, which
+        # on a 100k-file location would stall the event loop for seconds
+        # (the identifier runs its consults inside to_thread the same way)
+        import asyncio
+
+        journal = _journal.IndexJournal(library.db)
+        loc_path = self.data["location_path"]
+
+        def consult_all() -> dict[int, "_journal.JournalEntry | None"]:
+            out: dict[int, "_journal.JournalEntry | None"] = {}
+            for r in rows:
+                # count_invalidated=False: the walker already judged
+                # changed files this pass — don't double-count here
+                verdict, entry = journal.lookup(
+                    loc_id, _journal.key_of(r),
+                    _journal.stat_identity(_full_path(loc_path, r)),
+                    count_invalidated=False,
+                )
+                out[r["id"]] = (
+                    entry
+                    if verdict == _journal.HIT and entry is not None
+                    and entry.cas_id == r["cas_id"]
+                    else None
+                )
+            return out
+
+        vouched = await asyncio.to_thread(consult_all)
+
+        # dispatch remaining thumbnails up-front to the node thumbnailer
+        # actor (ref:job.rs:148-156); the job only awaits counts later.
         thumbnailer = getattr(getattr(library, "node", None), "thumbnailer", None)
         dispatched = 0
         thumb_batch_id = 0
+        thumb_vouch: list[list] = []  # keys to vouch post-rendezvous
         if thumbnailer is not None and rows:
-            loc_path = self.data["location_path"]
-            batch = [
-                (r["cas_id"], _full_path(loc_path, r)) for r in rows
-            ]
-            thumb_batch_id = thumbnailer.new_indexed_thumbnails_batch(
-                library.id, batch, background=False
-            )
+            batch = []
+            for r in rows:
+                entry = vouched[r["id"]]
+                if entry is not None and entry.thumb:
+                    journal.bytes_saved(blob_u64(r["size_in_bytes_bytes"]) or 0)
+                    continue
+                batch.append((r["cas_id"], _full_path(loc_path, r)))
+                thumb_vouch.append(
+                    [*_journal.key_of(r), r["cas_id"]]
+                )
+            if batch:
+                thumb_batch_id = thumbnailer.new_indexed_thumbnails_batch(
+                    library.id, batch, background=False
+                )
             dispatched = len(batch)
         self.data["thumbs_dispatched"] = dispatched
 
-        exif_rows = [
-            r for r in rows if (r["extension"] or "").lower() in MEDIA_DATA_EXTENSIONS
-        ]
+        exif_rows = []
+        for r in rows:
+            if (r["extension"] or "").lower() not in MEDIA_DATA_EXTENSIONS:
+                continue
+            entry = vouched[r["id"]]
+            if entry is not None and entry.media_digest is not None:
+                journal.bytes_saved(blob_u64(r["size_in_bytes_bytes"]) or 0)
+                continue
+            exif_rows.append(r)
         for i in range(0, len(exif_rows), BATCH_SIZE):
             chunk = exif_rows[i:i + BATCH_SIZE]
             self.steps.append(
@@ -106,6 +163,10 @@ class MediaProcessorJob(StatefulJob):
                     "kind": "wait_thumbnails",
                     "count": dispatched,
                     "batch_id": thumb_batch_id,
+                    # journal vouches written AFTER the rendezvous, and
+                    # only for thumbs verifiably in the store — so the
+                    # journal can never claim a thumb a crash swallowed
+                    "vouch": thumb_vouch,
                 }
             )
         labeler = getattr(getattr(library, "node", None), "image_labeler", None)
@@ -145,6 +206,8 @@ class MediaProcessorJob(StatefulJob):
     def _extract_media_data(self, ctx: JobContext, step: dict) -> StepResult:
         library = ctx.library
         loc_path = self.data["location_path"]
+        loc_id = self.data["location_id"]
+        journal = _journal.IndexJournal(library.db)
         extracted = skipped = 0
         for fp_id, object_id in step["ids"]:
             row = library.db.find_one("file_path", id=fp_id)
@@ -161,12 +224,22 @@ class MediaProcessorJob(StatefulJob):
                 meta = ImageMetadata.from_path(full)
             if meta is None:
                 skipped += 1
+                # still a vouch: "probed, nothing extractable" — stops
+                # warm passes from re-reading EXIF-less files forever
+                journal.vouch_media(
+                    loc_id, _journal.key_of(row), row["cas_id"], ""
+                )
                 continue
             cols = meta.to_row(object_id)
             library.db.upsert("media_data", {"object_id": object_id}, **{
                 k: v for k, v in cols.items() if k != "object_id"
             })
             extracted += 1
+            # vouch ordered after the media_data upsert committed
+            journal.vouch_media(
+                loc_id, _journal.key_of(row), row["cas_id"],
+                _media_digest(cols),
+            )
         return StepResult(
             metadata={
                 "media_data_extracted": self.run_metadata["media_data_extracted"] + extracted,
@@ -179,10 +252,23 @@ class MediaProcessorJob(StatefulJob):
         WaitThumbnails step) — per dispatched batch, so unrelated
         background thumbnail work can't stall this job. After a resume
         the id is from a dead process; `wait_batch` treats unknown ids
-        as done (the actor re-queues persisted work on its own)."""
+        as done (the actor re-queues persisted work on its own).
+
+        After the rendezvous, journal-vouch each dispatched thumbnail
+        that is VERIFIABLY in the store (`store.exists`, never the
+        actor's counters): the vouch is ordered after the webp landed on
+        disk, so a `thumbnail.persist` crash between store and the
+        actor's own state journal can leave the actor re-doing work but
+        never leaves this journal claiming an absent thumb."""
         thumbnailer = getattr(getattr(ctx.library, "node", None), "thumbnailer", None)
         if thumbnailer is not None:
             await thumbnailer.wait_batch(step.get("batch_id", 0))
+            journal = _journal.IndexJournal(ctx.library.db)
+            loc_id = self.data["location_id"]
+            lib_id = str(ctx.library.id)
+            for mat, name, ext, cas_hex in step.get("vouch", []):
+                if thumbnailer.store.exists(lib_id, cas_hex):
+                    journal.vouch_thumb(loc_id, (mat, name, ext), cas_hex)
         return StepResult()
 
     async def _wait_labels(self, ctx: JobContext, step: dict) -> StepResult:

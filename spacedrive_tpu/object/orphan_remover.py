@@ -20,7 +20,13 @@ BATCH = 512  # ref:orphan_remover.rs:63
 
 
 def process_clean_up(db) -> int:
-    """One full clean-up pass; returns objects removed."""
+    """One full clean-up pass; returns objects removed. Also prunes
+    index-journal rows whose file_path vanished: liveness comes from
+    the journal/DB join (location/indexer/journal.prune_orphans), never
+    from re-stat'ing paths on disk — a vanished row must not keep a
+    stale vouch alive."""
+    from ..location.indexer.journal import prune_orphans
+
     removed = 0
     while True:
         rows = db.query(
@@ -29,6 +35,9 @@ def process_clean_up(db) -> int:
             (BATCH,),
         )
         if not rows:
+            pruned = prune_orphans(db)
+            if pruned:
+                logger.debug("pruned %d orphaned journal rows", pruned)
             return removed
         ids = [r["id"] for r in rows]
         qmarks = ",".join("?" for _ in ids)

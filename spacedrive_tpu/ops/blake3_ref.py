@@ -150,6 +150,41 @@ def blake3_hex(data: bytes, out_len: int = 32) -> str:
     return blake3(data, out_len).hex()
 
 
+# --- chunk-level tree API (incremental / dirty-range rehash) ---------------
+#
+# BLAKE3 is a Merkle tree over 1024-byte chunks: the root digest is a
+# pure function of the per-chunk chaining values. Exposing the chunk CV
+# and the CV→root merge lets a caller cache CVs per chunk and, when a
+# file changes in place, recompute only the *dirty* chunks' CVs before
+# re-merging — bit-identical to a full rehash (ops/cas.py dirty-range).
+
+
+def chunk_chaining_value(chunk: bytes, counter: int) -> bytes:
+    """Interior (non-root) chaining value of chunk number `counter` —
+    32 bytes (8 LE u32 words). Only valid for multi-chunk messages: a
+    single-chunk message compresses with the ROOT flag instead."""
+    return struct.pack("<8I", *_chunk_cv(chunk, counter, is_root=False)[:8])
+
+
+def parent_chaining_value(left: bytes, right: bytes) -> bytes:
+    """Interior parent CV over two packed 32-byte child CVs."""
+    out = _parent(
+        list(struct.unpack("<8I", left)), list(struct.unpack("<8I", right)),
+        is_root=False,
+    )
+    return struct.pack("<8I", *out[:8])
+
+
+def root_digest_from_pair(left: bytes, right: bytes, out_len: int = 32) -> bytes:
+    """Root digest when the whole tree reduces to two subtree CVs."""
+    assert out_len <= 64, "extended XOF output not implemented"
+    out = _parent(
+        list(struct.unpack("<8I", left)), list(struct.unpack("<8I", right)),
+        is_root=True,
+    )
+    return struct.pack("<16I", *out)[:out_len]
+
+
 class StreamingBlake3:
     """Incremental hasher for unbounded inputs (validator full-file hash,
     ref:core/src/object/validation/hash.rs:9-25 reads 1MiB blocks).

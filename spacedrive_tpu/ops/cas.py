@@ -16,14 +16,21 @@ of compiled shapes (ragged lengths are masked in-kernel).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import struct
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
 
-from .blake3_ref import StreamingBlake3
+from .blake3_ref import (
+    CHUNK_LEN,
+    StreamingBlake3,
+    chunk_chaining_value,
+    parent_chaining_value,
+    root_digest_from_pair,
+)
 from . import blake3_jax
 
 SAMPLE_COUNT = 4
@@ -73,6 +80,183 @@ def read_message(path: str | os.PathLike, size: int | None = None) -> bytes:
                 raise OSError(f"short read at {off} in {path}")
             parts.append(buf)
     return b"".join(parts)
+
+
+def message_len(size: int) -> int:
+    """Length of the hashed message for a file of `size` bytes."""
+    return 8 + size if size <= MINIMUM_FILE_SIZE else LARGE_MSG_LEN
+
+
+# --- dirty-range rehash (incremental indexing, location/indexer/journal) ---
+#
+# The cas_id message is hashed by BLAKE3 as a Merkle tree over 1024-byte
+# chunks. Caching a cheap content digest per chunk plus the tree's
+# chaining values lets a warm pass on a modified file recompute only the
+# chunks whose bytes actually changed (and their log-depth path of
+# parents) — bit-identical to a full rehash, with zero bytes shipped to
+# the device. Unchanged chunks cost one blake2b per 1 KiB (C-speed);
+# only dirty chunks pay the BLAKE3 compression.
+#
+# Cache shape per file: `digests` (16-byte blake2b per chunk, built on
+# EVERY journal record — cheap enough for the cold/device path) and
+# `levels` (the CV tree, built the first time a file takes the host
+# dirty-range path — the device path cannot observe interior CVs).
+
+CHUNK_DIGEST_LEN = 16
+
+
+def _split_chunks(message: bytes) -> list[bytes]:
+    return [message[i:i + CHUNK_LEN] for i in range(0, len(message), CHUNK_LEN)]
+
+
+def chunk_digests(message: bytes) -> list[bytes]:
+    """Cheap per-chunk content digests (blake2b-128, C-speed) — the
+    dirty detector, NOT part of the cas_id itself."""
+    return [
+        hashlib.blake2b(c, digest_size=CHUNK_DIGEST_LEN).digest()
+        for c in _split_chunks(message)
+    ]
+
+
+@dataclass
+class ChunkCache:
+    """Per-file dirty-range state carried by the index journal."""
+
+    msg_len: int
+    digests: list[bytes]
+    # CV tree: levels[0] = per-chunk CVs, each upper level the pairwise
+    # parents (odd node carried up), topmost level exactly 2 nodes.
+    # None until the file first takes the host dirty-range path.
+    levels: list[list[bytes]] | None = None
+
+    def to_payload(self) -> dict:
+        return {
+            "len": self.msg_len,
+            "dig": self.digests,
+            "cvs": self.levels,
+        }
+
+    @classmethod
+    def from_payload(cls, obj: Any) -> "ChunkCache | None":
+        """Strict validation: anything malformed returns None (the
+        caller degrades to a cold rehash — never a wrong cas_id)."""
+        if not isinstance(obj, dict):
+            return None
+        msg_len, digests, levels = obj.get("len"), obj.get("dig"), obj.get("cvs")
+        if not isinstance(msg_len, int) or msg_len <= 0:
+            return None
+        n = (msg_len + CHUNK_LEN - 1) // CHUNK_LEN
+        if (
+            not isinstance(digests, list) or len(digests) != n
+            or any(
+                not isinstance(d, bytes) or len(d) != CHUNK_DIGEST_LEN
+                for d in digests
+            )
+        ):
+            return None
+        if levels is not None:
+            if not isinstance(levels, list) or not levels:
+                return None
+            want = n
+            for i, level in enumerate(levels):
+                if (
+                    not isinstance(level, list) or len(level) != want
+                    or any(not isinstance(cv, bytes) or len(cv) != 32 for cv in level)
+                ):
+                    return None
+                want = (want + 1) // 2
+            if len(levels[-1]) != 2:
+                return None
+        return cls(msg_len, list(digests), levels)
+
+
+def build_chunk_cache(message: bytes) -> ChunkCache:
+    """Digest-only cache (cheap) — recorded alongside a device-hashed
+    cas_id so the FIRST in-place modification can already diff chunks."""
+    return ChunkCache(len(message), chunk_digests(message))
+
+
+def _build_levels(cvs: list[bytes]) -> list[list[bytes]]:
+    levels = [cvs]
+    while len(levels[-1]) > 2:
+        cur = levels[-1]
+        nxt = [
+            parent_chaining_value(cur[j], cur[j + 1])
+            for j in range(0, len(cur) - 1, 2)
+        ]
+        if len(cur) % 2:
+            nxt.append(cur[-1])
+        levels.append(nxt)
+    return levels
+
+
+def _root_cas_id(levels: list[list[bytes]]) -> str:
+    top = levels[-1]
+    return root_digest_from_pair(top[0], top[1], 8).hex()
+
+
+def host_rehash_with_cache(message: bytes) -> tuple[str, ChunkCache]:
+    """Full host rehash that CAPTURES the CV tree, so the next
+    modification of this file pays only for its dirty chunks. Only
+    valid for multi-chunk messages (single chunks use the ROOT flag)."""
+    chunks = _split_chunks(message)
+    if len(chunks) < 2:
+        raise ValueError("host_rehash_with_cache needs >= 2 chunks")
+    cvs = [chunk_chaining_value(c, i) for i, c in enumerate(chunks)]
+    levels = _build_levels(cvs)
+    cache = ChunkCache(len(message), chunk_digests(message), levels)
+    return _root_cas_id(levels), cache
+
+
+def dirty_range_rehash(
+    message: bytes, cache: ChunkCache
+) -> tuple[str, ChunkCache, int, int]:
+    """Rehash `message` reusing `cache` from its previous version.
+    Returns (cas_id, refreshed cache, dirty_chunks, bytes_rehashed) —
+    the cas_id is bit-identical to a full rehash (golden-tested).
+
+    Requires an unchanged message length (a size change moves every
+    sample offset, so the whole message is new — callers full-rehash).
+    """
+    if len(message) != cache.msg_len:
+        raise ValueError("message length changed; dirty-range does not apply")
+    chunks = _split_chunks(message)
+    if len(chunks) < 2:
+        raise ValueError("dirty-range needs >= 2 chunks")
+    digests = chunk_digests(message)
+    dirty = [i for i, d in enumerate(digests) if d != cache.digests[i]]
+    if cache.levels is None:
+        # no CV tree yet (cas came off the device): one full host rehash
+        # builds it; every later modification pays only its dirty chunks
+        cas, fresh = host_rehash_with_cache(message)
+        return cas, fresh, len(dirty), len(message)
+    levels = [list(level) for level in cache.levels]
+    hashed = 0
+    for i in dirty:
+        levels[0][i] = chunk_chaining_value(chunks[i], i)
+        hashed += len(chunks[i])
+    # bubble the dirty paths up: parent j covers children 2j / 2j+1;
+    # an unpaired last node is carried (copied), not compressed
+    dirty_nodes = set(dirty)
+    for depth in range(len(levels) - 1):
+        cur, nxt = levels[depth], levels[depth + 1]
+        parents = set()
+        for i in dirty_nodes:
+            j = i // 2
+            if j in parents:
+                continue
+            if 2 * j + 1 < len(cur):
+                nxt[j] = parent_chaining_value(cur[2 * j], cur[2 * j + 1])
+            else:
+                nxt[j] = cur[2 * j]
+            parents.add(j)
+        dirty_nodes = parents
+    return (
+        _root_cas_id(levels),
+        ChunkCache(cache.msg_len, digests, levels),
+        len(dirty),
+        hashed,
+    )
 
 
 def cas_id_cpu(path: str | os.PathLike, size: int | None = None) -> str:

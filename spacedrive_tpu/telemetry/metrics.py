@@ -171,6 +171,27 @@ CAS_BACKEND_FALLBACK = REGISTRY.counter(
     "cas_ids('auto') device failures that degraded to the host backend",
 )
 
+# --- index journal (location/indexer/journal.py) ----------------------------
+
+INDEX_JOURNAL_OPS = REGISTRY.counter(
+    "sd_index_journal_ops_total",
+    "index-journal consults by verdict: hit (identity matches, cached "
+    "result reused), miss (no usable entry), invalidated (entry present "
+    "but stale/identity changed), bypassed (journal disabled or entry "
+    "corrupt — degraded to a cold pass)",
+    labels=("result",),  # hit | miss | invalidated | bypassed
+)
+INDEX_JOURNAL_BYTES_SAVED = REGISTRY.counter(
+    "sd_index_journal_bytes_saved_total",
+    "bytes NOT read/hashed/shipped because the journal vouched for them "
+    "(journal hits plus clean chunks of dirty-range rehashes)",
+)
+INDEX_BYTES_HASHED = REGISTRY.counter(
+    "sd_index_bytes_hashed_total",
+    "message bytes actually hashed by the identifier (device batches "
+    "plus dirty chunks of host dirty-range rehashes)",
+)
+
 # --- pipeline device/host split (identify + thumbnail drivers) --------------
 
 PIPELINE_DEVICE_SECONDS = REGISTRY.histogram(

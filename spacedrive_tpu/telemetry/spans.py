@@ -62,11 +62,13 @@ class Span:
     __slots__ = (
         "stage", "nbytes", "path", "_t0", "_t0_wall", "_token",
         "_trace_token", "duration", "trace_id", "span_id", "parent_id",
+        "fields",
     )
 
     def __init__(self, stage: str, nbytes: int = 0):
         self.stage = stage
         self.nbytes = int(nbytes)
+        self.fields: dict[str, Any] | None = None
         self.path = stage  # parent-prefixed on enter
         self._t0 = 0.0
         self._t0_wall = 0.0
@@ -80,6 +82,14 @@ class Span:
     def add_bytes(self, n: int) -> None:
         """Attribute more bytes mid-span (e.g. per-file in a loop)."""
         self.nbytes += int(n)
+
+    def annotate(self, **fields: Any) -> None:
+        """Attach small scalar fields to the span record (ring + trace
+        export) — e.g. the index-journal verdict counts of an identify
+        window. Keep values to scalars; this is NOT a payload channel."""
+        if self.fields is None:
+            self.fields = {}
+        self.fields.update(fields)
 
     # -- sync protocol --
 
@@ -127,6 +137,8 @@ class Span:
             "span_id": self.span_id,
             "parent_id": self.parent_id,
         }
+        if self.fields:
+            rec["fields"] = dict(self.fields)
         with _recent_lock:
             _recent.append(rec)
         _trace.record_span({**rec, "t0": self._t0_wall})

@@ -181,6 +181,8 @@ def export(trace_id: str | None = None) -> dict[str, Any]:
             args["bytes"] = rec["bytes"]
         if rec.get("error"):
             args["error"] = rec["error"]
+        if rec.get("fields"):
+            args.update(rec["fields"])  # span.annotate() scalars
         events.append(
             {
                 "name": rec.get("stage", "?"),

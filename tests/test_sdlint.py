@@ -911,6 +911,88 @@ def test_sd011_silent_on_paced_bounded_and_actor_loops(tmp_path):
     assert findings == []
 
 
+# --- SD012 journal-bypass --------------------------------------------------
+
+
+def run_scoped(tmp_path, relpath, source, rules=None):
+    """Like run_on, but places the fixture at a repo-shaped relative
+    path — SD012 scopes by path (journal-governed modules only)."""
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    findings, errors = analyze_paths([f], rules)
+    assert not errors, errors
+    return findings
+
+
+SD012_SOURCE = """
+    import os
+    from pathlib import Path
+
+    def sizes(paths):
+        return [os.stat(p).st_size for p in paths]
+
+    def slurp(p):
+        return open(p, "rb").read()
+
+    def slurp2(p):
+        return Path(p).read_bytes()
+"""
+
+
+def test_sd012_flags_stat_and_full_read_in_scoped_modules(tmp_path):
+    findings = run_scoped(
+        tmp_path,
+        "spacedrive_tpu/location/indexer/helper.py",
+        SD012_SOURCE,
+        ["SD012"],
+    )
+    assert len(findings) == 3
+    assert rules_of(findings) == ["SD012"]
+    findings = run_scoped(
+        tmp_path,
+        "spacedrive_tpu/object/file_identifier/job.py",
+        "import os\n\ndef f(p):\n    return os.path.getsize(p)\n",
+        ["SD012"],
+    )
+    assert len(findings) == 1
+
+
+def test_sd012_silent_outside_scope_and_in_journal_itself(tmp_path):
+    # the journal module OWNS the raw stat (allowlisted)
+    assert run_scoped(
+        tmp_path,
+        "spacedrive_tpu/location/indexer/journal.py",
+        SD012_SOURCE,
+        ["SD012"],
+    ) == []
+    # leaf codec modules are out of scope: they do the decided work
+    assert run_scoped(
+        tmp_path,
+        "spacedrive_tpu/object/media/thumbnail/process.py",
+        SD012_SOURCE,
+        ["SD012"],
+    ) == []
+
+
+def test_sd012_silent_on_journal_idiom(tmp_path):
+    findings = run_scoped(
+        tmp_path,
+        "spacedrive_tpu/location/indexer/helper.py",
+        """
+        from . import journal as _journal
+
+        def check(path, f):
+            ident = _journal.stat_identity(path)  # sanctioned stat
+            head = f.read(1024)                   # bounded read is fine
+            exists = __import__("os").path.exists(path)
+            return ident, head, exists
+        """,
+        ["SD012"],
+    )
+    assert findings == []
+
+
 # --- the gate (same entry point as `make lint` / CI) -----------------------
 
 

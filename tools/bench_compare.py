@@ -21,6 +21,13 @@ Comparison rules:
   device-side rates: e2e numbers under a congested link measure the
   container's network weather, not the code.
 
+BENCH_E2E leg: when ``BENCH_E2E_prev.json`` and ``BENCH_E2E.json`` both
+exist (bench_e2e.py archives the replaced artifact), the per-config
+rate series (``config1.device_files_per_s``, …,
+``config_warm.warm_files_per_s`` + the warm journal hit rate) gate with
+the same threshold; a config carrying ``blocked: congested-link`` on
+either side is excused — its rates measured the tunnel, not the code.
+
 Usage:
     python tools/bench_compare.py [--dir .] [--threshold 0.15] [old new]
 Exit codes: 0 ok / nothing to compare, 1 regression, 2 bad invocation.
@@ -95,6 +102,60 @@ def compare(old: dict[str, Any], new: dict[str, Any],
             "skipped": skipped}
 
 
+_E2E_CONFIGS = ("config1", "config3", "config4", "config5", "config_warm")
+# higher-is-better ratio series gated alongside the rates
+_E2E_RATIOS = ("journal_hit_rate", "warm_speedup_vs_cold")
+
+
+def e2e_series(doc: dict[str, Any]) -> dict[str, float]:
+    """Comparable {config.metric: value} rates from a BENCH_E2E doc.
+    Blocked configs contribute nothing — their numbers measured the
+    congested link, so neither side of a diff may lean on them."""
+    out: dict[str, float] = {}
+    for cfg in _E2E_CONFIGS:
+        sec = doc.get(cfg)
+        if not isinstance(sec, dict) or sec.get("blocked"):
+            continue
+        for k, v in sec.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            if _RATE_NAME.search(k) or k in _E2E_RATIOS:
+                out[f"{cfg}.{k}"] = float(v)
+    return out
+
+
+def compare_e2e(old: dict[str, Any], new: dict[str, Any],
+                threshold: float = DEFAULT_THRESHOLD) -> dict[str, Any]:
+    """Diff two BENCH_E2E documents (same result shape as compare())."""
+    old_s, new_s = e2e_series(old), e2e_series(new)
+    checked: list[dict[str, Any]] = []
+    regressions: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    for name in sorted(old_s):
+        if name not in new_s:
+            cfg = name.split(".")[0]
+            reason = (
+                "blocked (congested link) in one run"
+                if (old.get(cfg) or {}).get("blocked")
+                or (new.get(cfg) or {}).get("blocked")
+                else "absent in newer run"
+            )
+            skipped.append(f"{name}: {reason}")
+            continue
+        ov, nv = old_s[name], new_s[name]
+        if ov <= 0:
+            skipped.append(f"{name}: non-positive baseline {ov}")
+            continue
+        delta = (nv - ov) / ov
+        rec = {"name": name, "old": ov, "new": nv,
+               "delta_pct": round(delta * 100, 2)}
+        checked.append(rec)
+        if delta < -threshold:
+            regressions.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "skipped": skipped}
+
+
 def latest_pair(bench_dir: str) -> tuple[str, str] | None:
     files = sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json")))
     if len(files) < 2:
@@ -118,38 +179,63 @@ def main(argv: list[str] | None = None) -> int:
         print("bench-compare: pass exactly two files (old new), or none",
               file=sys.stderr)
         return 2
+    def render(label: str, result: dict[str, Any]) -> None:
+        print(f"bench-compare: {label}  (gate: -{args.threshold:.0%})")
+        for rec in result["checked"]:
+            mark = "REGRESSION" if rec in result["regressions"] else "ok"
+            print(f"  {mark:>10}  {rec['name']}: {rec['old']:g} -> "
+                  f"{rec['new']:g}  ({rec['delta_pct']:+.1f}%)")
+        for note in result["skipped"]:
+            print(f"     skipped  {note}")
+        if not result["checked"]:
+            print("  no comparable series (metric renamed between rounds?)")
+
+    total_regressions = 0
+
     if args.files:
-        old_path, new_path = args.files
+        pairs: list[tuple[str, str]] = [tuple(args.files)]
     else:
         pair = latest_pair(args.dir)
-        if pair is None:
+        pairs = [pair] if pair else []
+        if not pairs:
             print("bench-compare: fewer than two BENCH_r*.json rounds — "
                   "nothing to gate")
-            return 0
-        old_path, new_path = pair
 
-    try:
-        with open(old_path) as f:
-            old = json.load(f)
-        with open(new_path) as f:
-            new = json.load(f)
-    except (OSError, ValueError) as e:
-        print(f"bench-compare: cannot read bench JSON: {e}", file=sys.stderr)
-        return 2
+    for old_path, new_path in pairs:
+        try:
+            with open(old_path) as f:
+                old = json.load(f)
+            with open(new_path) as f:
+                new = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench-compare: cannot read bench JSON: {e}",
+                  file=sys.stderr)
+            return 2
+        result = compare(old, new, args.threshold)
+        render(f"{os.path.basename(old_path)} -> "
+               f"{os.path.basename(new_path)}", result)
+        total_regressions += len(result["regressions"])
 
-    result = compare(old, new, args.threshold)
-    print(f"bench-compare: {os.path.basename(old_path)} -> "
-          f"{os.path.basename(new_path)}  (gate: -{args.threshold:.0%})")
-    for rec in result["checked"]:
-        mark = "REGRESSION" if rec in result["regressions"] else "ok"
-        print(f"  {mark:>10}  {rec['name']}: {rec['old']:g} -> "
-              f"{rec['new']:g}  ({rec['delta_pct']:+.1f}%)")
-    for note in result["skipped"]:
-        print(f"     skipped  {note}")
-    if not result["checked"]:
-        print("  no comparable series (metric renamed between rounds?)")
-    if result["regressions"]:
-        print(f"bench-compare: {len(result['regressions'])} series regressed "
+    # BENCH_E2E leg (only in --dir mode; explicit pairs stay BENCH_r)
+    if not args.files:
+        e2e_prev = os.path.join(args.dir, "BENCH_E2E_prev.json")
+        e2e_cur = os.path.join(args.dir, "BENCH_E2E.json")
+        if os.path.exists(e2e_prev) and os.path.exists(e2e_cur):
+            try:
+                with open(e2e_prev) as f:
+                    old = json.load(f)
+                with open(e2e_cur) as f:
+                    new = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"bench-compare: cannot read BENCH_E2E JSON: {e}",
+                      file=sys.stderr)
+                return 2
+            result = compare_e2e(old, new, args.threshold)
+            render("BENCH_E2E_prev.json -> BENCH_E2E.json", result)
+            total_regressions += len(result["regressions"])
+
+    if total_regressions:
+        print(f"bench-compare: {total_regressions} series regressed "
               f"past the {args.threshold:.0%} gate", file=sys.stderr)
         return 1
     return 0

@@ -5,3 +5,4 @@ from . import lockorder  # noqa: F401  SD004
 from . import jaxrules  # noqa: F401  SD005-SD006
 from . import telemetryrules  # noqa: F401  SD007-SD010
 from . import resiliencerules  # noqa: F401  SD011
+from . import journalrules  # noqa: F401  SD012
