@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check \
-	bench-warm chaos
+	bench-warm bench-autotune chaos
 
 lint:
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
@@ -41,6 +41,17 @@ chaos:
 bench-warm:
 	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=warm SD_E2E_FILES=800 \
 		SD_E2E_REPEATS=2 SD_BENCH_WAIT=0 $(PY) bench_e2e.py
+
+# closed-loop autotuner A/B: the SAME identifier pass static
+# (SD_AUTOTUNE=0) vs adaptive, on a clean link and on one throttled
+# deterministically through the fault plane's feeder.fetch stall point.
+# Records BENCH_AUTOTUNE.json; `make bench-check` gates it (adaptive
+# ≥1.3x static throttled, ≥0.95x static clean). CI-safe sizes on the
+# CPU platform; on the TPU rig run `python bench_e2e.py` for the full
+# set (autotune rides the default config list).
+bench-autotune:
+	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=autotune SD_E2E_FILES=8000 \
+		SD_E2E_REPEATS=2 $(PY) bench_e2e.py
 
 # perf trajectory gate: diff the two most recent BENCH_r*.json rounds
 # AND (when BENCH_E2E_prev.json exists) the previous → current

@@ -50,7 +50,8 @@ class _JournalCheck:
             # never be read/hashed/shipped this pass
             from ...ops.cas import message_len
 
-            self.journal.bytes_saved(message_len(meta.size_in_bytes))
+            self.journal.bytes_saved(message_len(meta.size_in_bytes),
+                                     location_id=self.loc_id)
         return verdict
 
 

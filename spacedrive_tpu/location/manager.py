@@ -28,13 +28,21 @@ from ..db.database import now_iso
 from ..files.isolated_path import IsolatedFilePathData
 from ..telemetry.events import WATCHER_EVENTS
 from ..utils.tasks import supervise
-from .indexer.journal import IndexJournal, key_of
+from .indexer.journal import IndexJournal, key_of, stat_identity
 from .locations import deep_rescan_sub_path, light_scan_location
 from .watcher import EventKind, WatchEvent, new_watcher
 
 logger = logging.getLogger(__name__)
 
 DEBOUNCE = 0.2  # event settle window before shallow rescans fire
+# Journal-verdict-driven debounce sizing (PR 7 follow-up): a burst
+# whose events the index journal still vouches for — rename storms
+# (vouches MOVE, zero re-work) and touch storms (stat identity
+# unchanged) — needs consolidation, not per-event rescans, so the
+# settle window widens with the vouched count, up to DEBOUNCE_MAX. A
+# burst of real content changes keeps the snappy base window.
+DEBOUNCE_MAX = 2.0
+DEBOUNCE_WIDEN_MIN = 4  # vouched events before the window starts widening
 
 
 @dataclass
@@ -46,6 +54,11 @@ class _Watched:
     dirty_dirs: set[str] = field(default_factory=set)  # shallow rescan targets
     deep_dirs: set[str] = field(default_factory=set)  # recursive rescan targets
     flush_handle: Any = None
+    # current-burst accounting (reset at each flush)
+    burst_total: int = 0
+    burst_vouched: int = 0
+    last_event: float = 0.0     # monotonic time of the last counted event
+    last_debounce: float = 0.0  # last window emitted on the ring
 
 
 class LocationManager:
@@ -56,6 +69,9 @@ class LocationManager:
         self._watched: dict[tuple[str, int], _Watched] = {}
         self.ignore_paths: set[str] = set()
         self.events_applied = 0
+        # debounce sizing (instance attrs so tests can compress time)
+        self.debounce = DEBOUNCE
+        self.debounce_max = DEBOUNCE_MAX
         # in-flight debounced rescans: retained so they can't be
         # GC-cancelled mid-flush and shutdown can drain them (sdlint SD003)
         self._flush_tasks: set[asyncio.Task] = set()
@@ -149,12 +165,30 @@ class LocationManager:
                 old_rel = self._rel(entry, event.old_path or "")
                 if old_rel is not None:
                     old_rel = old_rel.replace(os.sep, "/")
+                    # a rename moves the journal vouches wholesale — if
+                    # the old entry was vouching, this event needs NO
+                    # rescan, so it counts toward the vouched burst and
+                    # pushes any PENDING rescan out (widened window)
+                    # instead of letting it fire mid-storm
+                    old_iso = IsolatedFilePathData.from_relative_str(
+                        loc_id, old_rel, event.is_dir
+                    )
+                    _, jentry = journal.lookup(
+                        loc_id, key_of(old_iso), None, count=False,
+                    )
+                    self._count_burst(
+                        entry,
+                        vouched=jentry is not None and not jentry.stale,
+                    )
                     self._apply_rename(db, loc_id, old_rel, rel, event.is_dir)
+                    if entry.flush_handle is not None:
+                        self._schedule_flush(entry)
                     return
                 kind = EventKind.CREATE  # renamed in from outside = create
             if kind == EventKind.REMOVE:
                 self._apply_remove(db, loc_id, rel, event.is_dir)
                 return
+            vouched = False
             if kind == EventKind.RESCAN:
                 # events were lost at unknown depths — full rescan, and
                 # the journal stops vouching for the whole subtree (the
@@ -180,9 +214,28 @@ class LocationManager:
                 iso = IsolatedFilePathData.from_relative_str(
                     loc_id, rel, False
                 )
-                journal.mark_stale(loc_id, key_of(iso))
+                jkey = key_of(iso)
+                if kind == EventKind.MODIFY:
+                    # burst sizing: a MODIFY whose journal entry still
+                    # has the dirty-range fast path (entry present,
+                    # size unchanged — a touch/attrib storm, or an
+                    # in-place mutation the chunk cache re-vouches in
+                    # ~ms) counts as vouched: the rescan it needs is
+                    # near-free, so coalescing beats firing per event
+                    _, jentry = journal.lookup(
+                        loc_id, jkey, None, count=False
+                    )
+                    st = stat_identity(event.path)
+                    vouched = (
+                        jentry is not None
+                        and jentry.identity is not None
+                        and st is not None
+                        and st.size == jentry.identity.size
+                    )
+                journal.mark_stale(loc_id, jkey)
                 parent = os.path.dirname(rel)
                 entry.dirty_dirs.add("/" + parent.replace(os.sep, "/").strip("/"))
+            self._count_burst(entry, vouched=vouched)
             self._schedule_flush(entry)
         except Exception:
             logger.exception("watcher event application failed: %s", event)
@@ -272,12 +325,51 @@ class LocationManager:
 
     # --- debounced shallow rescan --------------------------------------
 
+    def _count_burst(self, entry: _Watched, vouched: bool) -> None:
+        """Accumulate the current burst's journal verdicts (reset at
+        each flush): `vouched` events are ones the index journal still
+        has a free/near-free path for — rename storms (vouches MOVE)
+        and touch storms (size-stable entries the dirty-range rehash
+        re-vouches in ~ms)."""
+        import time
+
+        now = time.monotonic()
+        if (
+            entry.flush_handle is None
+            and now - entry.last_event > self.debounce_max
+        ):
+            # a rename-only storm schedules no flush, so its counters
+            # never reset through _flush — a later lone event must not
+            # inherit the stale widened window
+            entry.burst_total = 0
+            entry.burst_vouched = 0
+        entry.last_event = now
+        entry.burst_total += 1
+        if vouched:
+            entry.burst_vouched += 1
+
+    def _debounce_window(self, entry: _Watched) -> float:
+        """Journal-verdict-driven settle window: a burst DOMINATED by
+        vouched events widens linearly with the vouched count (each
+        extra event is more evidence the storm is churn, not content),
+        capped at `debounce_max`; real content-change bursts keep the
+        snappy base window."""
+        if (
+            entry.burst_vouched < DEBOUNCE_WIDEN_MIN
+            or entry.burst_vouched * 2 < entry.burst_total
+        ):
+            return self.debounce
+        widen = entry.burst_vouched / DEBOUNCE_WIDEN_MIN
+        return min(self.debounce_max, self.debounce * widen)
+
     def _schedule_flush(self, entry: _Watched) -> None:
         if entry.flush_handle is not None:
             entry.flush_handle.cancel()
         loop = asyncio.get_running_loop()
+        window = self._debounce_window(entry)
+        entry.last_debounce = window
         entry.flush_handle = loop.call_later(
-            DEBOUNCE, self._spawn_flush, loop, entry
+            window, self._spawn_flush, loop, entry
         )
 
     def _spawn_flush(self, loop: asyncio.AbstractEventLoop,
@@ -294,12 +386,18 @@ class LocationManager:
         dirs, entry.dirty_dirs = entry.dirty_dirs, set()
         deep, entry.deep_dirs = entry.deep_dirs, set()
         entry.flush_handle = None
+        total, entry.burst_total = entry.burst_total, 0
+        vouched, entry.burst_vouched = entry.burst_vouched, 0
         # flight-recorder record of the burst: when an index storm hits,
-        # "what watcher activity preceded it" is the first question
+        # "what watcher activity preceded it" is the first question —
+        # and the vouched/total split says whether the debounce sizing
+        # read the storm right
         WATCHER_EVENTS.emit(
             "burst_flush",
             location=entry.location.get("id"),
             shallow_dirs=len(dirs), deep_dirs=len(deep),
+            events=total, vouched=vouched,
+            debounce_s=round(entry.last_debounce, 3),
         )
         # a deep scan of an ancestor covers shallow/deep scans below it
         def covered(sub: str, by: str) -> bool:

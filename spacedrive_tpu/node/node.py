@@ -16,6 +16,7 @@ from typing import Any
 
 from ..jobs.manager import JobManager
 from ..object.media.thumbnail.actor import Thumbnailer
+from ..parallel import autotune as _autotune
 from ..object.orphan_remover import OrphanRemoverActor
 from ..tasks.system import TaskSystem
 from ..telemetry.events import LoopLagMonitor
@@ -86,6 +87,10 @@ class Node:
 
         self.router = mount()  # ref:lib.rs Node::new returns (node, router)
         self.loop_monitor = LoopLagMonitor()
+        # the process-wide closed-loop autotuner: started with the node
+        # so pipeline policies adapt while jobs run (SD_AUTOTUNE=0 keeps
+        # every policy at the static defaults and starts nothing)
+        self.autotuner = _autotune.CONTROLLER
         self._started = False
 
     # --- identity ------------------------------------------------------
@@ -125,6 +130,7 @@ class Node:
 
         install_loop_excepthook(asyncio.get_running_loop())
         self.loop_monitor.start()
+        self.autotuner.start()
         # bind the thumbnailer to THIS loop up front: enqueues arrive
         # from worker threads (non-indexed walker) and can only wake the
         # actor thread-safely once it knows its owning loop
@@ -248,6 +254,7 @@ class Node:
                 await cloud.shutdown()
                 await cloud.client.close()
         await self.loop_monitor.stop()
+        await self.autotuner.stop()
         await self.thumbnailer.shutdown()
         if self.image_labeler is not None:
             await self.image_labeler.shutdown()

@@ -1,6 +1,9 @@
 """file_identifier — links orphan file_paths to content-addressed
 Objects. Parity: ref:core/src/object/file_identifier/."""
 
-from .job import FileIdentifierJob, CHUNK_SIZE
+# the reference's 100-file CPU parity chunk now lives with the other
+# pipeline sizing in the autotuner's policy module
+from ...parallel.autotune import IDENTIFY_CPU_WINDOW as CHUNK_SIZE
+from .job import FileIdentifierJob
 
 __all__ = ["FileIdentifierJob", "CHUNK_SIZE"]

@@ -31,22 +31,21 @@ from ...jobs.job import JobContext, JobError, StepResult
 from ...jobs.manager import register_job
 from ...location.indexer import journal as _journal
 from ...ops import cas
+from ...parallel import autotune as _autotune
 from ...telemetry import metrics as _tm
 from ...telemetry import span
 from ...telemetry import profiler as _profiler
 
 logger = logging.getLogger(__name__)
 
-CHUNK_SIZE = 100            # ref:mod.rs:34 (CPU parity constant)
-DEVICE_CHUNK_SIZE = 1024    # device batches amortize dispatch overhead,
-# PER accelerator: a v5e-8 window is 8192 rows dp-sharded so every chip
-# hashes a warm 1024-row shard from ONE dispatch (parallel/mesh
-# accelerator_count × this constant)
-PIPELINE_DEPTH = 3          # windows in flight: reads AND device
-# transfers for up to PIPELINE_DEPTH windows overlap the current
-# window's hash + DB writes — see execute_step's WindowPipeline; grows
-# with the accelerator count (feeder.pipeline_depth) because sharded
-# windows drain n× faster
+# Window/depth sizing lives in the per-workload "identify"
+# PipelinePolicy (parallel/autotune.py): the static base is
+# IDENTIFY_DEVICE_WINDOW rows per accelerator (a v5e-8 window is 8192
+# rows dp-sharded so every chip hashes a warm 1024-row shard from ONE
+# dispatch) with feeder.pipeline_depth windows in flight; the
+# closed-loop controller widens/narrows both from observed feeder
+# wait, link probes, and occupancy. CPU backends keep the reference's
+# 100-row parity chunk (autotune.IDENTIFY_CPU_WINDOW, ref:mod.rs:34).
 
 
 def orphan_where_clause(sub_path_mat: str | None = None) -> str:
@@ -82,9 +81,15 @@ class FileIdentifierJob(StatefulJob):
         if backend in ("tpu", "device", "auto"):
             from ...parallel.mesh import accelerator_count
 
-            default_chunk = DEVICE_CHUNK_SIZE * accelerator_count()
+            # the STATIC base sizes the step estimate; live windows are
+            # re-read from the policy per fetch (an autotuned window may
+            # grow — fewer windows than steps, the extras no-op — or
+            # shrink — execute_step drains via more_steps)
+            default_chunk = (
+                _autotune.IDENTIFY_DEVICE_WINDOW * accelerator_count()
+            )
         else:
-            default_chunk = CHUNK_SIZE
+            default_chunk = _autotune.IDENTIFY_CPU_WINDOW
         chunk = self.init.get("chunk_size") or default_chunk
 
         params: list[Any] = [loc_id]
@@ -130,10 +135,11 @@ class FileIdentifierJob(StatefulJob):
         where = orphan_where_clause(self.init.get("sub_path"))
         if self.init.get("sub_path"):
             params.append(escape_like(materialized_prefix(self.init['sub_path'])) + "%")
+        limit = self._window_limit()
         # cursor pagination by id (ref:file_identifier_job.rs:126-165)
         rows = library.db.query(
             f"SELECT * FROM file_path WHERE {where} AND id > ? ORDER BY id LIMIT ?",
-            tuple(params) + (cursor, d["chunk_size"]),
+            tuple(params) + (cursor, limit),
         )
         loc_path = d["location_path"]
         loc_id = d["location_id"]
@@ -170,7 +176,8 @@ class FileIdentifierJob(StatefulJob):
                 if verdict == _journal.HIT and entry.cas_id:
                     # vouched: skip the read, the hash, and the transfer
                     resolved[row["id"]] = entry.cas_id
-                    journal.bytes_saved(cas.message_len(size))
+                    journal.bytes_saved(cas.message_len(size),
+                                        location_id=loc_id)
                     jstats["hit"] += 1
                     metas.append({"row": row, "cas_id": "journal"})
                     continue
@@ -196,7 +203,8 @@ class FileIdentifierJob(StatefulJob):
                 else:
                     resolved[row["id"]] = cas_id
                     to_record[row["id"]] = (key, ident, cas_id, cache, entry)
-                    journal.bytes_saved(len(msg) - hashed)
+                    journal.bytes_saved(len(msg) - hashed,
+                                        location_id=loc_id)
                     _tm.INDEX_BYTES_HASHED.inc(hashed)
                     jstats["dirty"] += 1
                     jstats["dirty_chunks"] += n_dirty
@@ -237,12 +245,30 @@ class FileIdentifierJob(StatefulJob):
 
         else:
             finisher = lambda: cas.cas_ids(messages, backend)
-        return rows, metas, messages, msg_rows, finisher, resolved, to_record, jstats
+        return (rows, metas, messages, msg_rows, finisher, resolved,
+                to_record, jstats, limit)
+
+    def _window_limit(self) -> int:
+        """Rows for the next cursor window. An explicit init
+        ``chunk_size`` pins it; device backends read the LIVE
+        "identify" PipelinePolicy (the autotuner's seam — each fetch
+        sees the current window sizing); CPU backends keep the
+        reference parity chunk recorded at init."""
+        d = self.data
+        if self.init.get("chunk_size"):
+            return d["chunk_size"]
+        if d["backend"] in ("tpu", "device", "auto"):
+            from ...parallel.mesh import accelerator_count
+
+            return _autotune.policy("identify").identify_window_rows(
+                accelerator_count()
+            )
+        return d["chunk_size"]
 
     async def execute_step(self, ctx: JobContext, step: dict, step_number: int) -> StepResult:
         import asyncio
 
-        from ...parallel import WindowPipeline, pipeline_depth
+        from ...parallel import WindowPipeline
         from ...parallel.mesh import accelerator_count
 
         library = ctx.library
@@ -257,10 +283,12 @@ class FileIdentifierJob(StatefulJob):
         if self._pipeline is None:
             # The producer chains cursor windows back-to-back: window
             # N+1's disk reads and device dispatch start as soon as N's
-            # reads finish, so up to PIPELINE_DEPTH transfers are in
+            # reads finish, so up to feeder-depth transfers are in
             # flight while this step's hashes complete and its DB writes
             # run (SURVEY §7 hard part #2). Fetches are side-effect-free,
-            # so a pause/resume simply re-reads in-flight windows.
+            # so a pause/resume simply re-reads in-flight windows. The
+            # depth is a LIVE policy read (autotuner seam): each parked
+            # window re-checks the current bound.
             def fetch(cursor):
                 window = self._fetch_window(library, cursor)
                 rows = window[0]
@@ -270,7 +298,9 @@ class FileIdentifierJob(StatefulJob):
 
             self._pipeline = WindowPipeline(
                 fetch, d["cursor"],
-                depth=pipeline_depth(accelerator_count(), base=PIPELINE_DEPTH),
+                depth=lambda: _autotune.policy("identify").feeder_depth(
+                    accelerator_count()
+                ),
                 # window[2] = the sampled messages riding the H2D link
                 measure=lambda w: sum(len(m) for m in w[2]),
             )
@@ -280,10 +310,11 @@ class FileIdentifierJob(StatefulJob):
         take_time = time.perf_counter() - t0
         if window is None:
             return StepResult()
-        rows, metas, messages, msg_rows, finisher, resolved, to_record, jstats = window
+        (rows, metas, messages, msg_rows, finisher, resolved, to_record,
+         jstats, limit) = window
         d["cursor"] = rows[-1]["id"]
 
-        _tm.IDENTIFIER_BATCH_FILL.observe(len(rows) / d["chunk_size"])
+        _tm.IDENTIFIER_BATCH_FILL.observe(len(rows) / limit)
         msg_bytes = sum(len(m) for m in messages)
         async with span("identify.hash", nbytes=msg_bytes) as hash_span:
             cas_ids = await asyncio.to_thread(finisher)
@@ -333,8 +364,14 @@ class FileIdentifierJob(StatefulJob):
                                           pipeline="identify")
 
         errors = [f"unreadable file_path {r['id']}" for m, r in zip(metas, rows) if m is None]
+        # the step count was estimated from the STATIC window at init;
+        # if the autotuner shrank windows mid-job there are more windows
+        # than steps — on the last step, keep draining until the cursor
+        # is exhausted (an extra step against a dry pipeline no-ops)
+        more_steps = [] if self.steps else [{"kind": "identify"}]
         return StepResult(
             errors=errors,
+            more_steps=more_steps,
             metadata={
                 "created_objects": self.run_metadata["created_objects"] + created,
                 "linked_objects": self.run_metadata["linked_objects"] + linked,

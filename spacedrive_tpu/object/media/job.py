@@ -127,7 +127,10 @@ class MediaProcessorJob(StatefulJob):
             for r in rows:
                 entry = vouched[r["id"]]
                 if entry is not None and entry.thumb:
-                    journal.bytes_saved(blob_u64(r["size_in_bytes_bytes"]) or 0)
+                    journal.bytes_saved(
+                        blob_u64(r["size_in_bytes_bytes"]) or 0,
+                        location_id=loc_id,
+                    )
                     continue
                 batch.append((r["cas_id"], _full_path(loc_path, r)))
                 thumb_vouch.append(
@@ -146,7 +149,10 @@ class MediaProcessorJob(StatefulJob):
                 continue
             entry = vouched[r["id"]]
             if entry is not None and entry.media_digest is not None:
-                journal.bytes_saved(blob_u64(r["size_in_bytes_bytes"]) or 0)
+                journal.bytes_saved(
+                    blob_u64(r["size_in_bytes_bytes"]) or 0,
+                    location_id=loc_id,
+                )
                 continue
             exif_rows.append(r)
         for i in range(0, len(exif_rows), BATCH_SIZE):

@@ -25,6 +25,7 @@ import os
 import secrets
 from typing import Any, Sequence
 
+from ....parallel import autotune as _autotune
 from ....telemetry import metrics as _tm
 from ....telemetry import span
 from ....telemetry import trace as _trace
@@ -45,7 +46,8 @@ from .store import ThumbnailStore, get_shard_hex
 logger = logging.getLogger(__name__)
 
 GENERATION_TIMEOUT_S = 30  # ref:process.rs:172
-DEVICE_BATCH = 32  # images per device dispatch PER accelerator
+# images per device dispatch per accelerator: autotune.THUMB_DEVICE_BATCH
+# via the "thumbnail" PipelinePolicy (read live in _device_chunk)
 
 
 ThumbKey = tuple[str, str, str]  # (namespace, shard, cas_id)
@@ -82,7 +84,9 @@ class Thumbnailer:
         self._pending: collections.Counter[str] = collections.Counter()
         self._cond: asyncio.Condition | None = None
         self._wake: asyncio.Event | None = None
-        self._chunk_rows: int | None = None  # lazily scaled DEVICE_BATCH
+        self._chunk_rows: int | None = None  # explicit override (tests);
+        # None → read the live "thumbnail" PipelinePolicy per batch
+        self._accel: int | None = None  # cached accelerator count
         self._worker: asyncio.Task | None = None
         self._stopped = False
         self.generated = 0
@@ -338,12 +342,17 @@ class Thumbnailer:
             await self._process_batch_traced(batch)
 
     def _device_chunk(self) -> int:
-        """Images per device dispatch, scaled once per process by the
-        accelerator count: a dp-sharded resize splits the chunk over
-        every chip, so each still sees DEVICE_BATCH rows. CPU-only
-        hosts keep the parity constant (virtual devices share cores —
-        bigger host chunks would only add latency)."""
-        if self._chunk_rows is None:
+        """Images per device dispatch: the live "thumbnail"
+        PipelinePolicy scaled by the accelerator count (a dp-sharded
+        resize splits the chunk over every chip, so each still sees the
+        per-device batch). CPU-only hosts keep the parity base (virtual
+        devices share cores — bigger host chunks would only add
+        latency). Read per batch, so an autotuner adjustment lands on
+        the next batch; an explicit ``_chunk_rows`` (tests, chaos
+        harness) always wins."""
+        if self._chunk_rows is not None:
+            return self._chunk_rows
+        if self._accel is None:
             n = 1
             if self.use_device:
                 try:
@@ -352,8 +361,8 @@ class Thumbnailer:
                     n = accelerator_count()
                 except Exception:  # noqa: BLE001 - no usable jax
                     n = 1
-            self._chunk_rows = DEVICE_BATCH * n
-        return self._chunk_rows
+            self._accel = n
+        return _autotune.policy("thumbnail").thumb_chunk_rows(self._accel)
 
     async def _process_batch_traced(self, batch: Batch) -> None:
         """Stage-overlapped chunk loop.

@@ -270,10 +270,13 @@ def cas_id_from_bytes_cpu(content: bytes) -> str:
     return StreamingBlake3().update(message_from_bytes(content)).hexdigest()[:16]
 
 
-DEVICE_BATCH = 1024  # max rows per dispatch PER DEVICE (see cas_ids_begin)
-# the tail ladder: at most 3 compiled programs per bucket, and a
-# 5-file tail pads to 32 rows, not 1024
-BATCH_LADDER = (32, 256, DEVICE_BATCH)
+# The pad ladder and per-device dispatch cap live in the autotuner's
+# policy module (parallel/autotune.py) — the ONE home for pipeline
+# sizing constants (sdlint SD013). Re-exported here because the ladder
+# is also the compiled-shape vocabulary this module packs against.
+from ..parallel.autotune import BATCH_LADDER
+
+DEVICE_BATCH = BATCH_LADDER[-1]  # max rows per dispatch PER DEVICE
 
 
 def batch_ladder(n_devices: int = 1) -> tuple[int, ...]:
@@ -416,7 +419,17 @@ def cas_ids_begin(
         b.indices.append(i)
         b.messages.append(msg)
 
-    step = device_batch(n_dev)
+    # dispatch quantum: the autotuner's current per-device rung × device
+    # count (static top rung = device_batch, bit-identical to the
+    # pre-autotune path). Smaller rungs keep every compiled shape warm —
+    # parts still pack through the same ladder (pack_canonical_batch).
+    from ..parallel import autotune as _autotune
+
+    step = min(
+        device_batch(n_dev),
+        _autotune.policy("identify").dispatch_rows_per_device()
+        * max(1, n_dev),
+    )
     in_flight: list[tuple[_Bucket, int, Any]] = []
     used_devices = False  # did any part actually shard over `devs`?
     try:

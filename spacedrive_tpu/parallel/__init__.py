@@ -5,6 +5,7 @@ maps to batch-parallel device meshes here; §7 hard part #2 — the
 host-side read pipeline that keeps the device fed.
 """
 
+from . import autotune
 from .feeder import PipelineStats, WindowPipeline, pipeline_depth
 from .mesh import (
     AXES,
@@ -22,6 +23,7 @@ from .mesh import (
 __all__ = [
     "AXES",
     "PipelineStats",
+    "autotune",
     "WindowPipeline",
     "accelerator_count",
     "batch_sharding",

@@ -64,7 +64,7 @@ class WindowPipeline(Generic[T]):
         self,
         fetch: Callable[[Any], "tuple[Any, T] | None"],
         start_key: Any,
-        depth: int = 3,
+        depth: "int | Callable[[], int]" = 3,
         measure: Callable[[T], int] | None = None,
     ):
         # `measure(window) -> bytes` attributes each fetched window's
@@ -81,7 +81,11 @@ class WindowPipeline(Generic[T]):
         # close() just flips the flag under the condition and notifies.
         self._buf: collections.deque = collections.deque()
         self._cond = threading.Condition()
-        self._depth = max(1, depth)
+        # `depth` may be a callable (the autotuner's live policy read):
+        # _put re-evaluates it per parked window, so a mid-job depth
+        # adjustment takes effect on the very next fetch
+        self._depth = depth if callable(depth) else None
+        self._static_depth = 1 if callable(depth) else max(1, depth)
         self._stop = threading.Event()
         self._done = False
         self._fetch = fetch
@@ -160,6 +164,16 @@ class WindowPipeline(Generic[T]):
         self._thread.start()
         return True
 
+    def _depth_now(self) -> int:
+        """Current read-ahead bound; a broken policy callable degrades
+        to depth 1 (throttled, never wedged or unbounded)."""
+        if self._depth is None:
+            return self._static_depth
+        try:
+            return max(1, int(self._depth()))
+        except Exception:  # noqa: BLE001 - policy reads must never kill reads
+            return 1
+
     def _put(self, item) -> bool:
         """Park one window (or the end-of-stream sentinel) for the
         consumer; blocks while `depth` windows are already parked and
@@ -170,7 +184,7 @@ class WindowPipeline(Generic[T]):
         with self._cond:
             while (
                 item is not None
-                and len(self._buf) >= self._depth
+                and len(self._buf) >= self._depth_now()
                 and not self._stop.is_set()
             ):
                 self._cond.wait()

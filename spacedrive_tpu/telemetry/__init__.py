@@ -50,6 +50,11 @@ def reset() -> None:
     clear_recent()
     trace.clear()
     events.clear_all()
+    # the index journal's per-location runtime counters + stats cache
+    # live like registry series (lazy import: journal imports metrics)
+    from ..location.indexer.journal import reset_runtime
+
+    reset_runtime()
 
 
 def trace_export(trace_id=None):

@@ -105,6 +105,7 @@ WATCHDOG_EVENTS = ring("watchdog")  # slow-op firings
 LOOP_EVENTS = ring("loop")         # event-loop-lag samples over threshold
 FAULT_EVENTS = ring("faults")      # injected-fault activations (utils/faults)
 RESILIENCE_EVENTS = ring("resilience")  # retries, breaker transitions, demotions
+AUTOTUNE_EVENTS = ring("autotune")  # closed-loop tuning decisions (w/ trace_id)
 
 
 def record_error(source: str, exc: BaseException | None,

@@ -106,6 +106,8 @@ def local_snapshot(node: Any = None) -> dict[str, Any]:
         for lib in getattr(getattr(node, "libraries", None), "libraries",
                            {}).values():
             try:
+                from ..location.indexer.journal import IndexJournal
+
                 libraries[str(lib.id)] = {
                     "name": lib.name,
                     "instance_label": peer_label(lib.sync.instance),
@@ -116,6 +118,10 @@ def local_snapshot(node: Any = None) -> dict[str, Any]:
                     "head_seconds": lib.sync.clock.peek_last().as_unix(),
                     "watermarks": lib.sync.replication_watermarks(),
                     "lag_seconds": lib.sync.observe_replication_lag(),
+                    # per-location index-journal effectiveness (entry
+                    # counts from the DB, hit rate / bytes saved from
+                    # this process) — the warm-pass story, mesh-wide
+                    "index_journal": IndexJournal(lib.db).location_stats(),
                 }
             except Exception:  # noqa: BLE001 - snapshots degrade, never fail
                 libraries[str(lib.id)] = {"name": getattr(lib, "name", "?")}

@@ -271,4 +271,14 @@ def evaluate(node: Any = None) -> dict[str, Any]:
     for v in subsystems.values():
         if _RANK[v["status"]] > _RANK[overall]:
             overall = v["status"]
-    return {"status": overall, "subsystems": subsystems}
+    out = {"status": overall, "subsystems": subsystems}
+    try:
+        # the autotuner's knob state rides health (and therefore every
+        # federation snapshot → GET /mesh): a node quietly running at a
+        # demoted rung or 8× windows is a capacity fact operators need
+        from ..parallel.autotune import snapshot as _autotune_snapshot
+
+        out["autotune"] = _autotune_snapshot()
+    except Exception:  # noqa: BLE001 - health reads never fail
+        pass
+    return out

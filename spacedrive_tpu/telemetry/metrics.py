@@ -293,6 +293,30 @@ FEEDER_RESTARTS = REGISTRY.counter(
     "window-pipeline producer threads restarted after a crash",
 )
 
+# --- closed-loop autotuner (parallel/autotune.py) ---------------------------
+
+AUTOTUNE_DECISIONS = REGISTRY.counter(
+    "sd_autotune_decisions_total",
+    "autotuner knob adjustments, by workload and direction",
+    labels=("workload", "action"),  # identify|thumbnail × promote|demote
+)
+AUTOTUNE_WINDOW_SCALE = REGISTRY.gauge(
+    "sd_autotune_window_scale",
+    "current multiplier on the static host window / chunk rows",
+    labels=("workload",),
+)
+AUTOTUNE_RUNG = REGISTRY.gauge(
+    "sd_autotune_batch_rung",
+    "current per-device dispatch rung index into the batch ladder "
+    "(0 = smallest, never above the DeviceLadder demotion cap)",
+    labels=("workload",),
+)
+AUTOTUNE_DEPTH_EXTRA = REGISTRY.gauge(
+    "sd_autotune_depth_extra",
+    "additive adjustment the autotuner applies to the feeder depth",
+    labels=("workload",),
+)
+
 # --- event loop health (telemetry/events.py LoopLagMonitor) -----------------
 
 EVENT_LOOP_LAG = REGISTRY.gauge(

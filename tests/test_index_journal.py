@@ -686,6 +686,158 @@ async def test_watcher_events_invalidate_journal(tmp_path):
     library.close()
 
 
+@pytest.mark.asyncio
+async def test_rename_storm_widens_debounce_instead_of_per_event_rescans(
+    tmp_path, monkeypatch
+):
+    """ISSUE-8 satellite (PR 7 follow-up): a synthetic rename storm —
+    every event's journal entry still vouching — must WIDEN the settle
+    window (coalescing the burst) instead of firing per-event rescans;
+    a burst of real content changes keeps the snappy base window."""
+    import spacedrive_tpu.location.manager as manager_mod
+    from spacedrive_tpu.location.manager import LocationManager, _Watched
+    from spacedrive_tpu.location.watcher import EventKind, WatchEvent
+
+    loc_path = tmp_path / "storm"
+    loc_path.mkdir()
+    n = 12
+    for i in range(n):
+        (loc_path / f"f{i}.bin").write_bytes(os.urandom(1500))
+    library = _mk_library(tmp_path)
+    mgr = JobManager(TaskSystem(2))
+    location = LocationCreateArgs(path=str(loc_path)).create(library)
+    await _scan(library, location, mgr)
+    journal = IndexJournal(library.db)
+    loc_id = location["id"]
+    for i in range(n):
+        assert journal.lookup(
+            loc_id, ("/", f"f{i}", "bin"),
+            journal_mod.stat_identity(loc_path / f"f{i}.bin"),
+            count_invalidated=False,
+        )[0] == "hit"
+
+    class _FakeNode:
+        jobs = mgr
+
+    rescans: list[str] = []
+
+    async def fake_light_scan(lib, loc, sub, jobs):
+        rescans.append(sub)
+
+    monkeypatch.setattr(manager_mod, "light_scan_location", fake_light_scan)
+    manager = LocationManager(_FakeNode())
+    manager.debounce = 0.05
+    manager.debounce_max = 0.4
+    entry = _Watched(library=library, location=location, watcher=None)
+
+    # one real content change opens the burst (schedules a flush at the
+    # base window)…
+    with open(loc_path / "f0.bin", "r+b") as f:
+        f.write(b"X")
+    await manager._on_event(
+        entry, WatchEvent(EventKind.MODIFY, str(loc_path / "f0.bin"))
+    )
+    assert entry.last_debounce == pytest.approx(manager.debounce)
+
+    # …then the rename storm lands: every event is journal-vouched, so
+    # the PENDING rescan gets pushed out with a widened window
+    for i in range(1, n):
+        os.replace(loc_path / f"f{i}.bin", loc_path / f"g{i}.bin")
+        await manager._on_event(
+            entry,
+            WatchEvent(
+                EventKind.RENAME, str(loc_path / f"g{i}.bin"),
+                old_path=str(loc_path / f"f{i}.bin"),
+            ),
+        )
+    assert entry.burst_vouched >= n - 1
+    assert entry.last_debounce > manager.debounce
+    assert entry.last_debounce <= manager.debounce_max
+    # the storm triggered ZERO rescans while it ran
+    assert rescans == []
+
+    # after the widened window settles, exactly ONE flush fires, with
+    # one shallow rescan for the single real change
+    await asyncio.sleep(entry.last_debounce + 0.2)
+    for _ in range(50):
+        if rescans and not manager._flush_tasks:
+            break
+        await asyncio.sleep(0.05)
+    assert len(rescans) == 1
+    # the renames were applied precisely (vouches moved, rows renamed)
+    assert library.db.find_one("file_path", name="g3") is not None
+    assert journal.lookup(
+        loc_id, ("/", "g3", "bin"),
+        journal_mod.stat_identity(loc_path / "g3.bin"),
+        count_invalidated=False,
+    )[0] == "hit"
+    # burst accounting reset by the flush
+    assert entry.burst_total == 0 and entry.burst_vouched == 0
+    await mgr.system.shutdown()
+    library.close()
+
+
+@pytest.mark.asyncio
+async def test_touch_storm_widens_content_storm_does_not(tmp_path, monkeypatch):
+    """MODIFY bursts: size-stable (touch/attrib) events are vouched —
+    the dirty-range path re-vouches them in ~ms — so the window widens;
+    size-changing content writes are NOT vouched and the window stays at
+    the base."""
+    import spacedrive_tpu.location.manager as manager_mod
+    from spacedrive_tpu.location.manager import LocationManager, _Watched
+    from spacedrive_tpu.location.watcher import EventKind, WatchEvent
+
+    loc_path = tmp_path / "touchy"
+    loc_path.mkdir()
+    n = 8
+    for i in range(n):
+        (loc_path / f"t{i}.bin").write_bytes(os.urandom(1200))
+    library = _mk_library(tmp_path)
+    mgr = JobManager(TaskSystem(2))
+    location = LocationCreateArgs(path=str(loc_path)).create(library)
+    await _scan(library, location, mgr)
+
+    class _FakeNode:
+        jobs = mgr
+
+    async def fake_light_scan(lib, loc, sub, jobs):
+        pass
+
+    monkeypatch.setattr(manager_mod, "light_scan_location", fake_light_scan)
+    manager = LocationManager(_FakeNode())
+    manager.debounce = 0.05
+    manager.debounce_max = 0.4
+    entry = _Watched(library=library, location=location, watcher=None)
+
+    # touch storm: mtime bumps, size unchanged → vouched burst widens
+    for i in range(n):
+        os.utime(loc_path / f"t{i}.bin")
+        await manager._on_event(
+            entry, WatchEvent(EventKind.MODIFY, str(loc_path / f"t{i}.bin"))
+        )
+    assert entry.burst_vouched == n
+    assert entry.last_debounce > manager.debounce
+    if entry.flush_handle is not None:
+        entry.flush_handle.cancel()
+        entry.flush_handle = None
+    entry.burst_total = entry.burst_vouched = 0
+
+    # content storm: every write GROWS the file (size change = real
+    # work pending) → nothing vouches, base window holds
+    for i in range(n):
+        with open(loc_path / f"t{i}.bin", "ab") as f:
+            f.write(os.urandom(64))
+        await manager._on_event(
+            entry, WatchEvent(EventKind.MODIFY, str(loc_path / f"t{i}.bin"))
+        )
+    assert entry.burst_vouched == 0
+    assert entry.last_debounce == pytest.approx(manager.debounce)
+    if entry.flush_handle is not None:
+        entry.flush_handle.cancel()
+    await mgr.system.shutdown()
+    library.close()
+
+
 # --- bench_compare: BENCH_E2E warm-pass gating -----------------------------
 
 

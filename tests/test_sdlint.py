@@ -993,6 +993,79 @@ def test_sd012_silent_on_journal_idiom(tmp_path):
     assert findings == []
 
 
+# --- SD013 policy-bypass-constant ------------------------------------------
+
+
+SD013_SOURCE = """
+    DEVICE_BATCH = 32
+    PIPELINE_DEPTH = 3
+    CHUNK_SIZE = 100
+    BATCH_LADDER = (32, 256, 1024)
+    WINDOW_ROWS = 8 * 1024
+
+    class Feeder:
+        MAX_DEPTH = 8
+"""
+
+
+def test_sd013_flags_hardcoded_sizing_in_pipeline_modules(tmp_path):
+    findings = run_scoped(
+        tmp_path,
+        "spacedrive_tpu/parallel/feeder.py",
+        SD013_SOURCE,
+        ["SD013"],
+    )
+    assert len(findings) == 6  # incl. the class-level MAX_DEPTH
+    assert rules_of(findings) == ["SD013"]
+    findings = run_scoped(
+        tmp_path,
+        "spacedrive_tpu/ops/cas.py",
+        "DEVICE_BATCH = 1024\n",
+        ["SD013"],
+    )
+    assert len(findings) == 1
+
+
+def test_sd013_silent_on_derived_and_non_sizing_constants(tmp_path):
+    findings = run_scoped(
+        tmp_path,
+        "spacedrive_tpu/object/media/thumbnail/actor.py",
+        """
+        from ....parallel.autotune import BATCH_LADDER
+
+        DEVICE_BATCH = BATCH_LADDER[-1]   # derived: follows the seam
+        GENERATION_TIMEOUT_S = 30         # not a sizing knob
+
+        def chunk(policy, n):
+            rows = 32 * n                 # function-local: policy-fed
+            return policy.thumb_chunk_rows(n)
+
+        def fetch(depth=3):               # defaults come from callers
+            return depth
+        """,
+        ["SD013"],
+    )
+    assert findings == []
+
+
+def test_sd013_silent_outside_scope_and_in_autotune_itself(tmp_path):
+    # the policy module OWNS the real constants (allowlisted)
+    assert run_scoped(
+        tmp_path,
+        "spacedrive_tpu/parallel/autotune.py",
+        SD013_SOURCE,
+        ["SD013"],
+    ) == []
+    # media/job.py's BATCH_SIZE batches DB writes (reference parity),
+    # not device work — deliberately out of scope
+    assert run_scoped(
+        tmp_path,
+        "spacedrive_tpu/object/media/job.py",
+        "BATCH_SIZE = 10\n",
+        ["SD013"],
+    ) == []
+
+
 # --- the gate (same entry point as `make lint` / CI) -----------------------
 
 

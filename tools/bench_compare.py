@@ -28,6 +28,13 @@ rate series (``config1.device_files_per_s``, …,
 the same threshold; a config carrying ``blocked: congested-link`` on
 either side is excused — its rates measured the tunnel, not the code.
 
+BENCH_AUTOTUNE leg: when ``BENCH_AUTOTUNE.json`` exists (``make
+bench-autotune``), the adaptive series gates ABSOLUTELY rather than
+against a previous round: adaptive must be ≥1.3× static on the
+fault-plane-throttled link and ≥0.95× static on the clean link — a
+controller that loses to the config it replaced is a regression by
+definition, no history needed.
+
 Usage:
     python tools/bench_compare.py [--dir .] [--threshold 0.15] [old new]
 Exit codes: 0 ok / nothing to compare, 1 regression, 2 bad invocation.
@@ -156,6 +163,37 @@ def compare_e2e(old: dict[str, Any], new: dict[str, Any],
             "skipped": skipped}
 
 
+# the autotune A/B's absolute bars (mirrored in bench_e2e.py — the
+# recorder stamps its own verdict, this gate re-derives it from the
+# recorded rates so a hand-edited verdict cannot sneak past)
+AUTOTUNE_THROTTLED_MIN = 1.3
+AUTOTUNE_CLEAN_MIN = 0.95
+
+
+def check_autotune(doc: dict[str, Any]) -> dict[str, Any]:
+    """Gate a BENCH_AUTOTUNE document (same result shape as compare():
+    {checked, regressions, skipped})."""
+    checked: list[dict[str, Any]] = []
+    regressions: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    for leg, floor in (("throttled", AUTOTUNE_THROTTLED_MIN),
+                       ("clean", AUTOTUNE_CLEAN_MIN)):
+        # the recorded figure is the median of per-pair ratios (each
+        # pair ran back-to-back, so the box's load drift cancels)
+        ratio = doc.get(f"{leg}_adaptive_vs_static")
+        if not isinstance(ratio, (int, float)) or isinstance(ratio, bool):
+            skipped.append(f"autotune.{leg}: ratio missing")
+            continue
+        rec = {"name": f"autotune.{leg}_adaptive_vs_static",
+               "old": floor, "new": round(float(ratio), 3),
+               "delta_pct": round((float(ratio) - floor) * 100, 2)}
+        checked.append(rec)
+        if ratio < floor:
+            regressions.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "skipped": skipped}
+
+
 def latest_pair(bench_dir: str) -> tuple[str, str] | None:
     files = sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json")))
     if len(files) < 2:
@@ -232,6 +270,19 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
             result = compare_e2e(old, new, args.threshold)
             render("BENCH_E2E_prev.json -> BENCH_E2E.json", result)
+            total_regressions += len(result["regressions"])
+        at_path = os.path.join(args.dir, "BENCH_AUTOTUNE.json")
+        if os.path.exists(at_path):
+            try:
+                with open(at_path) as f:
+                    at_doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"bench-compare: cannot read BENCH_AUTOTUNE JSON: {e}",
+                      file=sys.stderr)
+                return 2
+            result = check_autotune(at_doc)
+            render("BENCH_AUTOTUNE.json (absolute adaptive-vs-static bars)",
+                   result)
             total_regressions += len(result["regressions"])
 
     if total_regressions:

@@ -1,0 +1,124 @@
+"""Autotuner policy-seam rules.
+
+SD013  hard-coded batch/depth sizing constant bypassing PipelinePolicy
+
+ISSUE 8 moved every pipeline sizing knob — the cas dispatch ladder,
+the thumbnailer's per-device batch, the identifier's window rows, the
+feeder's read-ahead depth — into ``parallel/autotune.py``'s per-workload
+``PipelinePolicy`` so the closed-loop controller has ONE seam to adjust
+and ``SD_AUTOTUNE=0`` has one switch to pin. A new module-level
+``SOME_BATCH = 512`` in a pipeline module silently re-opens the old
+world: a constant the controller cannot see, tuned for one rig, exempt
+from the DeviceLadder demotion clamp.
+
+Scope (path-based): the modules the refactor drained — ``ops/cas.py``,
+``object/file_identifier/``, ``object/media/thumbnail/actor.py``,
+``parallel/feeder.py``. ``parallel/autotune.py`` is the allowlisted
+owner of the real constants. Out of scope on purpose: blake3/resize
+kernel modules (their CHUNK_LEN/BUCKETS are wire-format and compiled
+-shape vocabulary, not load knobs) and ``object/media/job.py`` (its
+``BATCH_SIZE`` batches DB writes, reference parity — not device work).
+
+Flags module- or class-level ``NAME = <numeric literal>`` assignments
+whose NAME carries a sizing token (``BATCH``, ``DEPTH``, ``WINDOW``,
+``LADDER``, ``RUNG``, ``CHUNK_SIZE``, ``CHUNK_ROWS``) and whose value
+is a literal number / tuple of numbers (possibly with arithmetic).
+Derived values (``DEVICE_BATCH = BATCH_LADDER[-1]``) are the sanctioned
+idiom — they follow the policy module — and stay silent, as do
+function-local temporaries and defaults (callers pass policy reads).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import FileContext, Finding, rule
+
+#: path fragments this rule governs (posix-style, as analyze_paths sees)
+SCOPED_FRAGMENTS = (
+    "ops/cas.py",
+    "object/file_identifier/",
+    "object/media/thumbnail/actor.py",
+    "parallel/feeder.py",
+)
+
+#: the policy module owns the real constants
+ALLOWLIST_FRAGMENTS = ("parallel/autotune.py",)
+
+_SIZING_NAME = re.compile(
+    r"(^|_)(BATCH|DEPTH|WINDOW|LADDER|RUNG)(_|$)"
+    r"|CHUNK_SIZE|CHUNK_ROWS"
+)
+
+
+def _in_scope(path: str) -> bool:
+    if any(frag in path for frag in ALLOWLIST_FRAGMENTS):
+        return False
+    return any(frag in path for frag in SCOPED_FRAGMENTS)
+
+
+def _is_numeric_literal(node: ast.AST) -> bool:
+    """A literal number, arithmetic over literals (``8 * 1024``), or a
+    tuple/list of those — the shapes a hard-coded sizing constant
+    takes. Anything referring to a Name/Attribute is derived and means
+    the author routed through (or at least to) another seam."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) and not isinstance(
+            node.value, bool
+        )
+    if isinstance(node, ast.UnaryOp):
+        return _is_numeric_literal(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_numeric_literal(node.left) and _is_numeric_literal(
+            node.right
+        )
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return bool(node.elts) and all(
+            _is_numeric_literal(e) for e in node.elts
+        )
+    return False
+
+
+def _const_assigns(tree: ast.Module) -> Iterator[tuple[str, ast.AST]]:
+    """(name, value-node) for module- and class-level assignments —
+    function bodies are skipped (locals and defaults come from policy
+    reads at the call sites)."""
+    scopes: list[ast.AST] = [tree]
+    while scopes:
+        scope = scopes.pop()
+        for stmt in getattr(scope, "body", ()):
+            if isinstance(stmt, ast.ClassDef):
+                scopes.append(stmt)
+            elif isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        yield tgt.id, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if isinstance(stmt.target, ast.Name):
+                    yield stmt.target.id, stmt.value
+
+
+@rule(
+    "SD013",
+    "policy-bypass-constant",
+    "hard-coded batch/depth/window sizing constant in a pipeline module "
+    "— pipeline sizing lives in parallel/autotune.py's PipelinePolicy "
+    "so the closed-loop controller (and SD_AUTOTUNE=0) can govern it",
+)
+def check_policy_bypass_constant(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    for name, value in _const_assigns(ctx.tree):
+        if not _SIZING_NAME.search(name):
+            continue
+        if not _is_numeric_literal(value):
+            continue
+        yield ctx.finding(
+            "SD013",
+            value,
+            f"`{name}` hard-codes pipeline sizing outside the autotuner "
+            "seam: move it into parallel/autotune.py (PipelinePolicy / "
+            "its static bases) and read it through autotune.policy(...)",
+        )
