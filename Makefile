@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check \
-	bench-warm bench-autotune chaos
+	bench-warm bench-autotune bench-mesh chaos
 
 lint:
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
@@ -52,6 +52,16 @@ bench-warm:
 bench-autotune:
 	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=autotune SD_E2E_FILES=8000 \
 		SD_E2E_REPEATS=2 $(PY) bench_e2e.py
+
+# mesh-parallel scaling bench: the SAME corpus identify-distributed by
+# the same engine on 1 node vs 2 in-process nodes (loopback duplex,
+# real WORK wire + leases + HLC/LWW merge), recording files/s and
+# scaling_efficiency into BENCH_E2E (config_mesh); `make bench-check`
+# gates the series. In-process peers share a GIL — cross-host peers
+# only scale better (note rides the artifact).
+bench-mesh:
+	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=mesh SD_E2E_FILES=800 \
+		SD_E2E_REPEATS=2 SD_BENCH_WAIT=0 $(PY) bench_e2e.py
 
 # perf trajectory gate: diff the two most recent BENCH_r*.json rounds
 # AND (when BENCH_E2E_prev.json exists) the previous → current

@@ -354,19 +354,33 @@ def timed_runs(corpus_dir: str, tmp: str, tag: str, phase: str,
     return out
 
 
-def probed(config_fn, *args) -> dict:
+def probed(config_fn, *args, link_bound: bool = True) -> dict:
     """Run a config with link probes bracketing its DEVICE measurements
     (the config fn fills `probes` via timed_runs or its own timing
     loop) and annotate the result: device figures are trustworthy only
-    if the link was healthy both immediately before and after them."""
+    if the link was healthy both immediately before and after them.
+
+    ``link_bound=False`` marks a config whose headline rates move ~0
+    device bytes (journal-bound warm passes, in-process mesh scaling):
+    a congested probe is recorded as *context* (``link_context``), never
+    a ``blocked`` stamp — stamping these blocked would make
+    tools/bench_compare.py excuse REAL warm-path regressions as
+    weather."""
     probes: dict = {}
     result = config_fn(*args, probes)
     result["link_probe_gbps"] = probes
     if probes and min(probes.values()) < CONGESTION_GBPS:
-        result["blocked"] = "congested-link"
-        log(f"  CONFIG BLOCKED: link probe {min(probes.values()):.2f} GB/s < "
-            f"{CONGESTION_GBPS} — device figures measure the tunnel, "
-            "not the framework")
+        if link_bound:
+            result["blocked"] = "congested-link"
+            log(f"  CONFIG BLOCKED: link probe {min(probes.values()):.2f} "
+                f"GB/s < {CONGESTION_GBPS} — device figures measure the "
+                "tunnel, not the framework")
+        else:
+            result["link_context"] = "congested-link"
+            log("  link congested during config — context only: this "
+                "config's headline rates move ~0 device bytes, so they "
+                "measure the code and STILL gate (only its cold/ "
+                "link-sensitive side rates are excused)")
     return result
 
 
@@ -588,6 +602,144 @@ def config_warm(tmp: str, n_files: int, repeats: int, probes: dict) -> dict:
         "warm_bytes_hashed": chosen["journal"]["bytes_hashed"],
         "warm_bytes_saved": chosen["journal"]["bytes_saved"],
     }
+
+
+# --- config_mesh: 1-node vs 2-node mesh-parallel index (ISSUE 9) -----------
+#
+# The scaling proof for work-stealing shard dispatch: the SAME corpus
+# is identify-distributed by the SAME engine (location/indexer/mesh.py)
+# once on a lone node (every shard self-stolen, sequential) and once
+# across two REAL in-process nodes linked by the loopback duplex
+# (p2p/loopback.py — the wire plane, leases, steals, and HLC/LWW merge
+# all run for real). The walk/save leg is untimed (metadata-only); the
+# timed window is the distributed identify pass. Caveat recorded in the
+# artifact: in-process peers share one GIL and the threaded C BLAKE3
+# already uses every core, so a 1–2-core rig's 2-node figure is a
+# FLOOR for what distinct hosts (separate GILs, separate cores,
+# separate page caches) would show.
+
+MESH_NODES = 2
+
+
+async def _mesh_arm(data_dir: str, corpus: str, *, pair: bool) -> dict:
+    """One timed arm: walk+save (untimed) then the distributed identify
+    window, on a lone node (``pair=False``) or a loopback mesh pair."""
+    from spacedrive_tpu.jobs.manager import JobBuilder
+    from spacedrive_tpu.location.indexer.job import IndexerJob
+    from spacedrive_tpu.location.indexer.mesh import distribute_location_index
+    from spacedrive_tpu.location.locations import LocationCreateArgs
+
+    nodes = []
+    lib_b = None
+    try:
+        if pair:
+            from spacedrive_tpu.p2p.loopback import make_mesh_pair
+
+            a, b, lib, lib_b, _tasks = await make_mesh_pair(data_dir)
+            nodes = [a, b]
+        else:
+            from spacedrive_tpu.node import Node
+
+            a = Node(os.path.join(data_dir, "solo"), use_device=False,
+                     with_labeler=False)
+            a.config.config.p2p.enabled = False
+            await a.start()
+            nodes = [a]
+            lib = await a.create_library("mesh-bench")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+        await JobBuilder(IndexerJob({"location_id": loc["id"]})).spawn(
+            a.jobs, lib)
+        await a.jobs.wait_idle()
+        if lib_b is not None:
+            # settle the walk/save replication BEFORE the timed window:
+            # the file_path create-op flood belongs to the (untimed)
+            # walk leg; the timed window must measure the distributed
+            # identify pass, not op ingest of rows the single arm never
+            # replicates. Converged = identical op-log counts (file
+            # counts alone leave field-update ops still in flight).
+            want = lib.db.count("crdt_operation")
+            deadline = time.perf_counter() + 300
+            while time.perf_counter() < deadline:
+                if lib_b.db.count("crdt_operation") >= want:
+                    break
+                actor = getattr(lib_b, "ingest", None)
+                if actor is not None:
+                    actor.notify()
+                await asyncio.sleep(0.2)
+        t0 = time.perf_counter()
+        stats = await distribute_location_index(
+            a, lib, loc["id"], run_indexer=False)
+        dt = time.perf_counter() - t0
+        files = lib.db.count("file_path", "is_dir = 0", ())
+        identified = lib.db.count(
+            "file_path", "is_dir = 0 AND cas_id IS NOT NULL", ())
+        return {"seconds": dt, "files": files, "identified": identified,
+                "stats": stats}
+    finally:
+        for node in nodes:
+            await node.shutdown()
+
+
+def config_mesh(tmp: str, n_files: int, repeats: int, probes: dict) -> dict:
+    """1-node vs 2-node distributed index of the same corpus; records
+    files/s both ways plus scaling_efficiency (gated by bench-check)."""
+    n_files = int(os.environ.get("SD_MESH_FILES", str(min(n_files, 2000))))
+    log(f"config mesh: {n_files} mixed files, 1-node vs {MESH_NODES}-node "
+        "(in-process peers)…")
+    corpus = os.path.join(tmp, "corpusM")
+    build_mixed_corpus(corpus, n_files)
+    probes["pre"] = round(probe_link(0), 3)
+    arms: dict[str, list[dict]] = {"mesh1": [], "mesh2": []}
+    for r in range(max(1, repeats)):
+        # interleave arms, order alternating, so box-load drift lands
+        # on both sides of every comparison (the autotune discipline)
+        order = ("mesh1", "mesh2") if r % 2 == 0 else ("mesh2", "mesh1")
+        for arm in order:
+            data_dir = os.path.join(tmp, f"node-mesh-{arm}-{r}")
+            res = asyncio.run(_mesh_arm(
+                data_dir, corpus, pair=(arm == "mesh2")))
+            arms[arm].append(res)
+            log(f"  [{arm} #{r}] identify {res['seconds']:.2f}s "
+                f"({res['files'] / res['seconds']:,.0f} files/s)  "
+                f"remote_shards={res['stats']['remote_shards']}")
+            shutil.rmtree(data_dir, ignore_errors=True)
+    probes["post"] = round(probe_link(0), 3)
+    med1, lo1, hi1 = median_spread([r["seconds"] for r in arms["mesh1"]])
+    med2, lo2, hi2 = median_spread([r["seconds"] for r in arms["mesh2"]])
+    files = arms["mesh1"][0]["files"]
+    fps1, fps2 = files / med1, files / med2
+    last2 = arms["mesh2"][-1]
+    scaling = fps2 / fps1
+    result = {
+        "name": "mesh-parallel index: work-stealing shard dispatch, "
+                f"1-node vs {MESH_NODES}-node in-process peers",
+        "files": files,
+        "shards": last2["stats"]["shards"],
+        "remote_shards": last2["stats"]["remote_shards"],
+        "mesh1_files_per_s": round(fps1, 1),
+        "mesh1_seconds_spread": [round(lo1, 2), round(med1, 2),
+                                 round(hi1, 2)],
+        "mesh2_files_per_s": round(fps2, 1),
+        "mesh2_seconds_spread": [round(lo2, 2), round(med2, 2),
+                                 round(hi2, 2)],
+        "scaling": round(scaling, 3),
+        "scaling_efficiency": round(scaling / MESH_NODES, 3),
+        "host_cores": os.cpu_count(),
+        "note": (
+            "in-process peers share ONE GIL: per-entry orchestration "
+            "(journal consults, object linking, op ingest) serializes "
+            "across both 'nodes', and the threaded C BLAKE3 already "
+            "uses every host core in the 1-node arm — so on a small "
+            "host this 2-node figure is a floor/overhead measurement, "
+            "not the design's scaling. The harness exists so real "
+            "multi-host rigs (a GIL, cores, and page cache PER node) "
+            "record the true curve into the same series"
+        ),
+    }
+    log(f"  mesh: {fps1:,.0f} -> {fps2:,.0f} files/s "
+        f"(scaling {scaling:.2f}x, efficiency "
+        f"{result['scaling_efficiency']:.2f})")
+    return result
 
 
 # --- config_autotune: static vs adaptive A/B (ISSUE 8) ---------------------
@@ -1392,6 +1544,7 @@ CONFIG_METRICS = {
     "config4": "device_clips_per_s",
     "config5": "device_mpairs_per_s",
     "config_warm": "warm_files_per_s",
+    "config_mesh": "mesh2_files_per_s",
 }
 
 
@@ -1440,7 +1593,8 @@ def main() -> None:
 
     configure_compilation_cache()
     which = os.environ.get(
-        "SD_E2E_CONFIGS", "compose,1,3,4,5,warm,decode,autotune").split(",")
+        "SD_E2E_CONFIGS",
+        "compose,1,3,4,5,warm,mesh,decode,autotune").split(",")
     n_files = int(os.environ.get("SD_E2E_FILES", "10000"))
     n_images = int(os.environ.get("SD_E2E_IMAGES", "256"))
     n_clips = int(os.environ.get("SD_E2E_CLIPS", "8"))
@@ -1489,8 +1643,18 @@ def main() -> None:
         if "5" in which:
             results["config5"] = probed(config_5, tmp, n_images, repeats)
         if "warm" in which:
+            # journal-bound: warm rates move ~0 device bytes — probes
+            # are context, never a blocked stamp (the stamp would make
+            # bench_compare excuse real warm-path regressions)
             results["config_warm"] = probed(
-                config_warm, tmp, n_files, max(1, repeats - 1))
+                config_warm, tmp, n_files, max(1, repeats - 1),
+                link_bound=False)
+        if "mesh" in which:
+            # host-bound by construction (in-process peers, CPU hash):
+            # same context-only probe treatment as the warm config
+            results["config_mesh"] = probed(
+                config_mesh, tmp, n_files, max(1, repeats - 1),
+                link_bound=False)
         if "decode" in which:
             results["decode_scaling"] = decode_scaling(tmp, n_images)
         if "autotune" in which:

@@ -417,7 +417,7 @@ class ApiServer:
             if peer not in peers:
                 peers.append(peer)
         from ..p2p.block import Range as BlockRange
-        from ..p2p.operations import request_file
+        from ..p2p.operations import FILE_POLICY, request_file
 
         # honor HTTP Range: fetch only the requested span over P2P
         from ..db.database import blob_u64
@@ -445,10 +445,16 @@ class ApiServer:
         ctype = mimetypes.guess_type(rel)[0] or "application/octet-stream"
         for peer in peers:
             sink = _StreamSink()
+            # single-shot policy: the breaker fast-fails a gone peer so
+            # the fallthrough tries the next one without a dial timeout
             fetch = asyncio.ensure_future(
-                request_file(
-                    p2p.p2p, peer.identity, lib.id,
-                    uuid.UUID(bytes=row["pub_id"]), sink, range=block_range,
+                FILE_POLICY.call(
+                    str(peer.identity),
+                    lambda peer=peer, sink=sink: request_file(
+                        p2p.p2p, peer.identity, lib.id,
+                        uuid.UUID(bytes=row["pub_id"]), sink,
+                        range=block_range,
+                    ),
                 )
             )
             try:

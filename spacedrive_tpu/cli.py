@@ -554,14 +554,17 @@ async def cmd_debug_bundle_peer(args: argparse.Namespace) -> int:
     receives the already-clean artifact. The peer must have the
     remoteRspc feature enabled."""
     from .p2p.identity import RemoteIdentity
-    from .p2p.rspc import RemoteRspcError, remote_exec
+    from .p2p.rspc import RSPC_POLICY, RemoteRspcError, remote_exec
 
     async with _mesh_node(args) as node:
         try:
-            bundle = await remote_exec(
-                node.p2p.p2p,
-                RemoteIdentity.from_str(args.peer),
-                "telemetry.debug_bundle",
+            bundle = await RSPC_POLICY.call(
+                args.peer,
+                lambda: remote_exec(
+                    node.p2p.p2p,
+                    RemoteIdentity.from_str(args.peer),
+                    "telemetry.debug_bundle",
+                ),
             )
         except RemoteRspcError as e:
             print(f"debug-bundle: peer refused: {e} (code {e.code})",

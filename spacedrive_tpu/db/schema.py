@@ -337,6 +337,17 @@ MIGRATIONS: list[list[str]] = [
         """,
         "CREATE INDEX idx_index_journal_cas ON index_journal(cas_id)",
     ],
+    # v3 -> v4: LWW-order lookup index. sync/ingest.py's
+    # is_operation_old and the delete re-apply path both filter by
+    # (model, record_id) with a timestamp comparison; without this
+    # index EVERY ingested op scans the whole op log for its record —
+    # O(ops²) ingest that the mesh work plane's result merging (ISSUE 9:
+    # thousands of cas/object ops converging through sync) turned from
+    # slow into prohibitive.
+    [
+        "CREATE INDEX idx_crdt_model_record_ts ON "
+        "crdt_operation(model, record_id, timestamp)",
+    ],
 ]
 
 # The version every migrated database reports via PRAGMA user_version.

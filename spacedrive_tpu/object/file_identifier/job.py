@@ -22,10 +22,9 @@ import time
 from typing import Any
 
 from ...db.database import blob_u64, escape_like, new_pub_id, now_iso
-from ...files.extensions import from_str as ext_from_str
 from ...files.isolated_path import full_path_from_db_row as _row_full_path
 from ...files.isolated_path import materialized_prefix
-from ...files.kind import ObjectKind
+from .link import kind_for_row as _kind_for_row
 from ...jobs import StatefulJob
 from ...jobs.job import JobContext, JobError, StepResult
 from ...jobs.manager import register_job
@@ -487,17 +486,3 @@ class FileIdentifierJob(StatefulJob):
         return dict(self.run_metadata)
 
 
-def _kind_for_row(row: dict) -> ObjectKind:
-    if row.get("is_dir"):
-        return ObjectKind.Folder
-    ext = row.get("extension") or ""
-    if not ext:
-        return ObjectKind.Unknown
-    poss = ext_from_str(ext)
-    if poss is None:
-        return ObjectKind.Unknown
-    if poss.known is not None:
-        return poss.known.kind
-    # conflicting extension: prefer the first conflict's kind (full
-    # magic-sniff happens in the media pipeline where bytes are read)
-    return poss.conflicts[0].kind

@@ -86,6 +86,11 @@ class P2PManager:
         # mesh-wide telemetry: freshest snapshot per peer w/ staleness
         # (telemetry/federation.py; read via GET /mesh, telemetry.mesh)
         self.federation = FederationCache()
+        # work-stealing shard plane: board (coordinating) + worker
+        # (stealing) — see p2p/work.py + location/indexer/mesh.py
+        from .work import WorkPlane
+
+        self.work = WorkPlane(node, self)
         self._beacon_addrs = beacon_addrs
         self._bind_host = bind_host
         self._unsubs: list[Any] = []
@@ -258,18 +263,28 @@ class P2PManager:
                 return p
         return None
 
-    def _is_library_member(self, remote_identity: Any) -> bool:
-        """True when the identity belongs to an instance of any loaded
+    def _is_library_member(self, remote_identity: Any,
+                           library_id: uuid.UUID | None = None) -> bool:
+        """True when the identity belongs to an instance of a loaded
         library — i.e. a peer the pairing flow admitted (instance rows
-        store ``RemoteIdentity.to_bytes()``). The instance table is
-        tiny, so the scan is cheap per request."""
+        store ``RemoteIdentity.to_bytes()``). With ``library_id`` the
+        check is scoped to THAT library: membership in library X must
+        not open library Y's surfaces (the WORK plane hands out work
+        and file metadata per library). The instance table is tiny, so
+        the scan is cheap per request."""
         if remote_identity is None:
             return False
         try:
             needle = remote_identity.to_bytes()
         except (AttributeError, ValueError):
             return False
-        for lib in self.node.libraries.libraries.values():
+        libs = self.node.libraries.libraries
+        if library_id is not None:
+            lib = libs.get(library_id)
+            scan = [lib] if lib is not None else []
+        else:
+            scan = list(libs.values())
+        for lib in scan:
             for row in lib.db.query("SELECT identity FROM instance"):
                 if row["identity"] == needle:
                     return True
@@ -433,6 +448,26 @@ class P2PManager:
                     {"error": "telemetry is served to library members only"}
                 )
                 await w.flush()
+        elif header.type == HeaderType.WORK:
+            # same trust bar as TELEMETRY but scoped to the NAMED
+            # library: shard payloads carry that library's paths and
+            # stat identities, and a claim hands out its work — strictly
+            # members of that specific library
+            if self._is_library_member(
+                getattr(stream, "remote_identity", None),
+                library_id=header.library_id,
+            ):
+                from .work import respond_work
+
+                with _span("p2p.work_serve"):
+                    await respond_work(stream, self.node, header)
+            else:
+                w = Writer(stream)
+                w.msgpack(
+                    {"error": "the work plane is served to library "
+                              "members only"}
+                )
+                await w.flush()
         elif header.type == HeaderType.RSPC:
             from .rspc import respond_rspc
 
@@ -460,6 +495,7 @@ class P2PManager:
                 if not task.cancelled() and (exc := task.exception()):
                     logger.warning("sync alert task died: %r", exc)
         self._alert_tasks.clear()
+        await self.work.stop()
         for actor in self.ingest_actors.values():
             await actor.stop()
         self.ingest_actors.clear()

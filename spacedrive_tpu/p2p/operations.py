@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..telemetry import trace as _trace
-from ..utils.resilience import ResiliencePolicy, RetryPolicy
+from ..utils.resilience import FAIL, PASS, ResiliencePolicy, RetryPolicy
 from .block import BlockSize, Range, SpaceblockRequest, SpaceblockRequests, Transfer
 from .identity import RemoteIdentity
 from .protocol import FileRequest, Header, HeaderType
@@ -37,6 +37,30 @@ SPACEDROP_POLICY = ResiliencePolicy(
                 attempt_timeout=15.0),
     failure_threshold=3,
     reset_timeout=15.0,
+)
+
+def _file_classify(exc: BaseException) -> str:
+    """A peer that ANSWERED — file not found, refusal — is healthy;
+    only transport failures may feed the breaker (otherwise three
+    honest not-founds would block files the peer DOES have)."""
+    if isinstance(exc, (FileNotFoundError, PermissionError, ValueError)):
+        return PASS
+    return FAIL  # single-shot policy: count it, never re-run the body
+
+
+# Remote-file streaming stays SINGLE-shot (a retry mid-transfer would
+# duplicate bytes already written into the caller's sink) and UNBOUNDED
+# in duration (a 10 GB pull over a slow link is legitimate; the old
+# direct call had no deadline either) — the policy contributes only the
+# per-peer breaker, so an explorer browse against a gone peer
+# fast-fails once instead of paying a dial timeout per row.
+FILE_POLICY = ResiliencePolicy(
+    "p2p_file",
+    RetryPolicy(max_attempts=1, base_delay=0.05, max_delay=0.1,
+                attempt_timeout=None),
+    failure_threshold=3,
+    reset_timeout=15.0,
+    classify=_file_classify,
 )
 
 

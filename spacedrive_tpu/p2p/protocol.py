@@ -29,6 +29,7 @@ class HeaderType(enum.IntEnum):
     RSPC = 6
     PAIRING = 7  # library join request (ref: the reference's pairing flow)
     TELEMETRY = 8  # pull the peer's compact telemetry snapshot (federation)
+    WORK = 9  # work-stealing shard exchange for a library (p2p/work.py)
 
 
 @dataclass
@@ -58,7 +59,8 @@ class Header:
     async def write(self, stream: Any) -> None:
         w = Writer(stream)
         w.u8(int(self.type))
-        if self.type in (HeaderType.SYNC, HeaderType.SYNC_REQUEST):
+        if self.type in (HeaderType.SYNC, HeaderType.SYNC_REQUEST,
+                         HeaderType.WORK):
             assert self.library_id is not None
             w.uuid(self.library_id)
             w.msgpack(self.trace or {})
@@ -79,7 +81,7 @@ class Header:
     async def read(cls, stream: Any) -> "Header":
         r = Reader(stream)
         t = HeaderType(await r.u8())
-        if t in (HeaderType.SYNC, HeaderType.SYNC_REQUEST):
+        if t in (HeaderType.SYNC, HeaderType.SYNC_REQUEST, HeaderType.WORK):
             lib_id = await r.uuid()
             return cls(t, library_id=lib_id, trace=(await r.msgpack()) or None)
         if t == HeaderType.SPACEDROP:

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any
 
 from ..api.router import RspcError
+from ..utils.resilience import PASS, RETRY, ResiliencePolicy, RetryPolicy
 from .identity import RemoteIdentity
 from .protocol import Header, HeaderType
 from .wire import Reader, Writer
@@ -22,6 +23,26 @@ class RemoteRspcError(Exception):
     def __init__(self, code: int, message: str):
         super().__init__(message)
         self.code = code
+
+
+def _classify(exc: BaseException) -> str:
+    """A peer that ANSWERED (refusal, bad procedure) must neither retry
+    nor feed the breaker; only transport failures count."""
+    if isinstance(exc, (RemoteRspcError, PermissionError, ValueError)):
+        return PASS
+    return RETRY
+
+
+#: policy for remote-rspc call sites (queries are idempotent by the
+#: responder's own restriction, so a bounded retry is safe)
+RSPC_POLICY = ResiliencePolicy(
+    "p2p_rspc",
+    RetryPolicy(max_attempts=2, base_delay=0.05, max_delay=0.5,
+                attempt_timeout=30.0),
+    failure_threshold=3,
+    reset_timeout=15.0,
+    classify=_classify,
+)
 
 
 async def remote_exec(

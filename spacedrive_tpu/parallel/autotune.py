@@ -649,6 +649,27 @@ def snapshot() -> dict[str, Any]:
     return CONTROLLER.snapshot()
 
 
+def observed_files_per_s(workload: str = "identify") -> float | None:
+    """Telemetry-derived throughput for a workload — the same registry
+    series the controller ticks on, folded to one number. Used by the
+    mesh work plane: a claiming peer self-reports this rate so the
+    coordinator can size its lease (p2p/work.py), before the worker has
+    any shard-measured rate of its own. None until the workload has
+    processed anything here."""
+    from ..telemetry import metrics as _tm
+
+    if workload != "identify":
+        return None
+    files = _tm.IDENTIFIER_FILES.value()
+    secs = (
+        _tm.IDENTIFIER_STAGE_SECONDS.stats(stage="hash")["sum"]
+        + _tm.IDENTIFIER_STAGE_SECONDS.stats(stage="db")["sum"]
+    )
+    if not files or secs <= 0:
+        return None
+    return files / secs
+
+
 def reset() -> None:
     """Test/bench isolation: static knobs, cleared streaks/baselines."""
     CONTROLLER.reset()

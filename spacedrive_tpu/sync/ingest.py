@@ -303,6 +303,12 @@ class IngestActor:
                 batch_applied = batch_rejected = 0
                 with _span("sync.ingest"):
                     for i, op in enumerate(ops):
+                        if i % 64 == 63:
+                            # yield: a 1000-op batch is seconds of
+                            # synchronous SQLite work — freezing the
+                            # event loop that the API, the work-stealing
+                            # plane, and the loop-lag monitor all share
+                            await asyncio.sleep(0)
                         ok = receive_crdt_operation(self.sync, op)
                         if ok:
                             self.applied += 1

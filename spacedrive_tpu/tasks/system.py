@@ -279,6 +279,11 @@ class TaskSystem:
         for donor in donors:
             handle = donor.steal_from()
             if handle is not None:
+                # the local mirror of the mesh plane's
+                # sd_work_steals_total: how often workers rebalance —
+                # persistent zero under load means queues never skew
+                # (or dispatch_many is doing the leveling alone)
+                _tm.TASK_STEALS.inc()
                 logger.debug("worker %d stole %r from %d", thief_index, handle.task, donor.index)
                 return handle
         return None

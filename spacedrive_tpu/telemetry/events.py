@@ -106,6 +106,7 @@ LOOP_EVENTS = ring("loop")         # event-loop-lag samples over threshold
 FAULT_EVENTS = ring("faults")      # injected-fault activations (utils/faults)
 RESILIENCE_EVENTS = ring("resilience")  # retries, breaker transitions, demotions
 AUTOTUNE_EVENTS = ring("autotune")  # closed-loop tuning decisions (w/ trace_id)
+WORK_EVENTS = ring("work")         # mesh work-stealing: publishes, leases, steals, expiries
 
 
 def record_error(source: str, exc: BaseException | None,

@@ -35,6 +35,11 @@ TASK_BATCH_OCCUPANCY = REGISTRY.histogram(
     "fraction of workers busy when a task starts executing",
     buckets=RATIO_BUCKETS,
 )
+TASK_STEALS = REGISTRY.counter(
+    "sd_task_steals_total",
+    "tasks stolen between local task-system workers (the in-process "
+    "mirror of the mesh plane's sd_work_steals_total)",
+)
 TASKS_DISPATCHED = REGISTRY.counter(
     "sd_tasks_dispatched_total", "tasks handed to the task system",
 )
@@ -258,6 +263,31 @@ FED_PEERS = REGISTRY.gauge(
     "sd_federation_peers",
     "peers currently tracked by the federation cache, by freshness",
     labels=("state",),  # fresh | stale
+)
+
+# --- mesh work-stealing (p2p/work.py + location/indexer/mesh.py) ------------
+
+WORK_SHARDS = REGISTRY.counter(
+    "sd_work_shards_total",
+    "distributed index work shards by outcome: published (added to a "
+    "session), completed_local / completed_remote (first completion, by "
+    "executor side), duplicate (a re-stolen or raced shard completed "
+    "again — idempotent merge absorbed it), expired (lease deadline "
+    "passed; shard returned to the steal pool), refused (claim denied "
+    "by health verdict or breaker)",
+    labels=("result",),
+)
+WORK_STEALS = REGISTRY.counter(
+    "sd_work_steals_total",
+    "shards leased to remote peers (one increment per shard per grant), "
+    "labeled by the claiming peer's short-hash",
+    labels=("peer",),
+)
+WORK_LEASE_SECONDS = REGISTRY.histogram(
+    "sd_work_lease_seconds",
+    "lease durations granted to shard claims (sized from the peer's "
+    "observed throughput and its /mesh health verdict)",
+    buckets=(1, 5, 10, 30, 60, 120, 300),
 )
 
 # --- resilience + fault plane (utils/resilience.py + utils/faults.py) -------

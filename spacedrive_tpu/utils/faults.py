@@ -74,6 +74,14 @@ FAULT_POINTS: dict[str, tuple[str, tuple[str, ...]]] = {
         "vanishes mid-exchange",
         ("vanish",),
     ),
+    "p2p.steal": (
+        "work-stealing shard plane (p2p/work.py): `vanish` at arg "
+        "'lease' kills the claiming worker after the lease is granted "
+        "(peer dies mid-lease; the shard must expire and be re-stolen); "
+        "`race` at arg 'claim' double-leases an already-leased shard "
+        "(claim race; the twice-executed shard must merge idempotently)",
+        ("vanish", "race"),
+    ),
     "relay.http": (
         "cloud relay HTTP surface (cloud/relay middleware)",
         ("500", "timeout", "truncate"),

@@ -1,0 +1,509 @@
+"""Mesh-parallel indexing: work-stealing shard dispatch across library
+peers — the ISSUE 9 surface, end to end.
+
+The two-node tests build two REAL ``Node``s sharing one library over
+the in-process duplex transport (``p2p/loopback.py``, the
+test_mesh_observability pattern — runs without ``cryptography``) and
+drive a distributed index of a shared location through the real WORK
+wire plane: announce → steal/claim → lease → execute → complete →
+HLC/LWW merge. The acceptance bar is BIT-IDENTITY of the observable
+result: the distributed pass must leave the same path→cas_id map, the
+same object grouping, and the same journal vouches as a single-node
+pass over the same corpus — including under injected mid-lease peer
+death and claim races (``p2p.steal`` fault point).
+"""
+
+import asyncio
+import os
+import random
+import uuid
+
+import pytest
+
+from spacedrive_tpu import telemetry
+from spacedrive_tpu.telemetry import counter_value
+from spacedrive_tpu.utils import faults
+
+
+# --- corpus + content-map helpers ------------------------------------------
+
+
+def build_corpus(root: str, n: int = 48, seed: int = 7) -> None:
+    """Mixed small files + an empty one + a >100 KiB sampled-message
+    file, so shards cross the cas_id size classes."""
+    rng = random.Random(seed)
+    os.makedirs(root, exist_ok=True)
+    for i in range(n):
+        size = rng.randrange(1, 4096)
+        with open(os.path.join(root, f"f{i:04d}.bin"), "wb") as f:
+            f.write(i.to_bytes(4, "little") + rng.randbytes(size))
+    open(os.path.join(root, "empty.bin"), "wb").close()
+    with open(os.path.join(root, "large.bin"), "wb") as f:
+        f.write(rng.randbytes(150 * 1024))
+
+
+def content_map(lib, loc_id: int) -> dict[str, str | None]:
+    """rel key → cas_id for every file row of a location."""
+    return {
+        f"{r['materialized_path']}{r['name']}.{r['extension'] or ''}":
+            r["cas_id"]
+        for r in lib.db.query(
+            "SELECT * FROM file_path WHERE location_id = ? AND is_dir = 0",
+            (loc_id,),
+        )
+    }
+
+
+def object_grouping(lib, loc_id: int) -> dict[str, frozenset]:
+    """cas_id → the set of file keys linked to ONE object for it (the
+    dedupe topology, pub_id-free so random vs deterministic object ids
+    compare equal)."""
+    groups: dict[str, set] = {}
+    for r in lib.db.query(
+        "SELECT fp.*, o.pub_id AS opub FROM file_path fp "
+        "JOIN object o ON o.id = fp.object_id WHERE fp.location_id = ? "
+        "AND fp.is_dir = 0",
+        (loc_id,),
+    ):
+        key = f"{r['materialized_path']}{r['name']}.{r['extension'] or ''}"
+        groups.setdefault(r["cas_id"], set()).add(key)
+    return {cas: frozenset(v) for cas, v in groups.items()}
+
+
+def journal_map(lib, loc_id: int) -> dict[tuple, tuple]:
+    """journal key → (cas_id, chunk digests) — the vouches a warm pass
+    would trust. date_vouched and identity are excluded (wall-clock and
+    stat-sourced, not pass-dependent)."""
+    from spacedrive_tpu.location.indexer.journal import IndexJournal, key_of
+
+    journal = IndexJournal(lib.db)
+    out = {}
+    for row in lib.db.query(
+        "SELECT * FROM index_journal WHERE location_id = ?", (loc_id,)
+    ):
+        entry = journal._entry_of(row)
+        assert entry is not None, "corrupt journal row"
+        digests = tuple(entry.chunks.digests) if entry.chunks else None
+        out[key_of(row)] = (entry.cas_id, digests)
+    return out
+
+
+async def single_node_reference(tmp_path, corpus: str):
+    """A plain one-node pass over the corpus: the oracle every
+    distributed pass must match. Returns (content, grouping, journal)."""
+    from spacedrive_tpu.jobs.manager import JobBuilder
+    from spacedrive_tpu.location.indexer.job import IndexerJob
+    from spacedrive_tpu.location.locations import LocationCreateArgs
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.object.file_identifier.job import FileIdentifierJob
+
+    node = Node(os.path.join(tmp_path, "solo"), use_device=False,
+                with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        lib = await node.create_library("solo")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+        for job_cls, init in (
+            (IndexerJob, {"location_id": loc["id"]}),
+            (FileIdentifierJob, {"location_id": loc["id"], "backend": "cpu"}),
+        ):
+            await JobBuilder(job_cls(init)).spawn(node.jobs, lib)
+            await node.jobs.wait_idle()
+        return (
+            content_map(lib, loc["id"]),
+            object_grouping(lib, loc["id"]),
+            journal_map(lib, loc["id"]),
+        )
+    finally:
+        await node.shutdown()
+
+
+async def distributed_pass(tmp_path, corpus: str, *, lease_max_s=10.0,
+                           shard_files=8):
+    """Two-node distributed pass; returns (a, b, lib_a, lib_b, loc,
+    stats). Caller shuts the nodes down."""
+    from spacedrive_tpu.location.indexer.mesh import distribute_location_index
+    from spacedrive_tpu.location.locations import LocationCreateArgs
+    from spacedrive_tpu.p2p.loopback import make_mesh_pair
+
+    a, b, lib_a, lib_b, _tasks = await make_mesh_pair(tmp_path)
+    loc = LocationCreateArgs(path=corpus).create(lib_a)
+    stats = await distribute_location_index(
+        a, lib_a, loc["id"], shard_files=shard_files,
+        lease_max_s=lease_max_s, deadline_s=120.0,
+    )
+    return a, b, lib_a, lib_b, loc, stats
+
+
+async def settle_replica(lib, loc_id: int, expect_files: int,
+                         timeout_s: float = 15.0) -> None:
+    """Wait until a replica holds every file row with a cas (its own
+    executions plus ingested peer ops)."""
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while asyncio.get_running_loop().time() < deadline:
+        rows = lib.db.query(
+            "SELECT COUNT(*) AS n FROM file_path WHERE location_id = ? "
+            "AND is_dir = 0 AND cas_id IS NOT NULL",
+            (loc_id,),
+        )
+        if rows[0]["n"] >= expect_files:
+            return
+        actor = getattr(lib, "ingest", None)
+        if actor is not None:
+            actor.notify()
+        await asyncio.sleep(0.1)
+
+
+# --- board unit tests -------------------------------------------------------
+
+
+def _session(n_shards=4, files_per_shard=8, lease_max_s=60.0):
+    from spacedrive_tpu.p2p.work import WorkSession, WorkShard
+
+    s = WorkSession(id=uuid.uuid4().hex, library_id=uuid.uuid4(),
+                    location_pub="00" * 16, lease_max_s=lease_max_s)
+    for i in range(n_shards):
+        s.shards[f"s{i}"] = WorkShard(
+            id=f"s{i}",
+            entries=[{"pub_id": f"{i:02x}{j:02x}" * 8, "size": 100}
+                     for j in range(files_per_shard)],
+        )
+    return s
+
+
+def test_board_lease_expiry_and_resteal():
+    from spacedrive_tpu.p2p.work import AVAILABLE, DONE, LEASED, WorkBoard
+
+    telemetry.reset()
+    board = WorkBoard()
+    session = _session(n_shards=2, lease_max_s=60.0)
+    board.publish(session)
+    assert counter_value("sd_work_shards_total", result="published") == 2
+
+    got, grant, lease_s = board.claim(session.id, "peer-1", max_shards=2,
+                                      files_per_s=1000.0)
+    assert got is session and len(grant) == 2
+    assert lease_s >= 5.0  # LEASE_MIN_S floor
+    assert all(s.state == LEASED for s in grant)
+    # the steal was counted per-peer (hashed label)
+    from spacedrive_tpu.telemetry.peers import peer_label
+
+    assert counter_value("sd_work_steals_total",
+                         peer=peer_label("peer-1")) == 2
+
+    # nothing left to claim while the lease is live
+    _s, more, _l = board.claim(session.id, "peer-2", max_shards=2)
+    assert more == []
+
+    # force-expire: shards return to the pool and are re-stealable
+    for s in grant:
+        s.lease_deadline = 0.0
+    assert board.expire_leases(session.id) == 2
+    assert all(s.state == AVAILABLE for s in grant)
+    _s, again, _l = board.claim(session.id, "peer-2", max_shards=2)
+    assert len(again) == 2 and again[0].assignee == "peer-2"
+
+    # completion: first wins, the duplicate is counted and absorbed
+    assert board.complete(session.id, "s0", "peer-2") == "completed"
+    assert board.complete(session.id, "s0", "peer-1") == "duplicate"
+    assert counter_value("sd_work_shards_total", result="duplicate") == 1
+    assert board.complete(session.id, "s1", "peer-2") == "completed"
+    assert session.all_done()
+    assert session.shards["s0"].state == DONE
+    telemetry.reset()
+
+
+def test_board_health_gated_claims():
+    from spacedrive_tpu.p2p.work import LEASE_MIN_S, WorkBoard
+
+    telemetry.reset()
+    board = WorkBoard()
+    session = _session(n_shards=4)
+    board.publish(session)
+
+    # unhealthy: refused outright
+    _s, grant, _l = board.claim(session.id, "sick", max_shards=4,
+                                verdict="unhealthy")
+    assert grant == []
+    assert counter_value("sd_work_shards_total", result="refused") == 1
+
+    # degraded: one shard, minimum lease — it may prove itself slowly
+    _s, grant, lease_s = board.claim(session.id, "slow", max_shards=4,
+                                     verdict="degraded")
+    assert len(grant) == 1 and lease_s == LEASE_MIN_S
+
+    # healthy: full ask, lease sized by the reported throughput
+    _s, grant, lease_s = board.claim(session.id, "fast", max_shards=2,
+                                     files_per_s=2.0, verdict="healthy")
+    assert len(grant) == 2
+    # 16 files / 2 files-per-s * slack(4) = 32 s
+    assert lease_s == pytest.approx(32.0)
+    telemetry.reset()
+
+
+def test_board_library_scoping_and_grant_history():
+    """A claimer is scoped to the library its WORK header named, a
+    complete is only accepted from a peer the shard was granted to,
+    and retiring a session drops it (board memory is bounded)."""
+    from spacedrive_tpu.p2p.work import WorkBoard
+
+    board = WorkBoard()
+    session = _session(n_shards=2)
+    board.publish(session)
+
+    # wrong library: no session, no shards, no metadata leak
+    got, grant, _l = board.claim(None, "p", library_id=uuid.uuid4())
+    assert got is None and grant == []
+    got, grant, _l = board.claim(session.id, "p", library_id=uuid.uuid4())
+    assert got is None and grant == []
+
+    # right library resolves even without a session id
+    got, grant, _l = board.claim(None, "p", library_id=session.library_id,
+                                 max_shards=1)
+    assert got is session and len(grant) == 1
+
+    # a peer the shard was never granted to cannot complete it
+    shard_id = grant[0].id
+    assert board.complete(session.id, shard_id, "stranger") == "unknown"
+    # nor may a member complete it against the wrong library
+    assert board.complete(session.id, shard_id, "p",
+                          library_id=uuid.uuid4()) == "unknown"
+    assert board.complete(session.id, shard_id, "p",
+                          library_id=session.library_id) == "completed"
+
+    board.retire(session.id)
+    assert board.get(session.id) is None
+    got, grant, _l = board.claim(None, "p", library_id=session.library_id)
+    assert got is None
+
+
+def test_board_lease_clamped_by_session_override():
+    from spacedrive_tpu.p2p.work import WorkBoard
+
+    board = WorkBoard()
+    session = _session(n_shards=1, files_per_shard=1000, lease_max_s=2.0)
+    board.publish(session)
+    _s, grant, lease_s = board.claim(session.id, "p", files_per_s=1.0)
+    assert grant and lease_s == 2.0
+
+
+# --- wire format + membership gate -----------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_work_header_roundtrip():
+    from spacedrive_tpu.p2p.loopback import Pipe
+    from spacedrive_tpu.p2p.protocol import Header, HeaderType
+
+    pipe = Pipe()
+    lib_id = uuid.uuid4()
+    trace = {"trace_id": "ab" * 16, "span_id": "cd" * 8}
+    await Header(HeaderType.WORK, library_id=lib_id, trace=trace).write(pipe)
+    back = await Header.read(pipe)
+    assert back.type == HeaderType.WORK
+    assert back.library_id == lib_id
+    assert back.trace == trace
+
+
+@pytest.mark.asyncio
+async def test_work_membership_gate(tmp_path):
+    """A stranger (full handshake, not a library member) gets a refusal
+    body, never shards."""
+    from spacedrive_tpu.p2p.identity import Identity
+    from spacedrive_tpu.p2p.loopback import DuplexEnd, Pipe, make_mesh_pair
+    from spacedrive_tpu.p2p.protocol import Header, HeaderType
+    from spacedrive_tpu.p2p.wire import Reader, Writer
+
+    telemetry.reset()
+    a, b, lib_a, _lib_b, _tasks = await make_mesh_pair(tmp_path)
+    try:
+        stranger = Identity().to_remote_identity()
+        c2s, s2c = Pipe(), Pipe()
+        client = DuplexEnd(s2c, c2s, a.p2p.p2p.remote_identity)
+        server = DuplexEnd(c2s, s2c, stranger)
+        await Header(HeaderType.WORK, library_id=lib_a.id).write(client)
+        w = Writer(client)
+        w.msgpack({"op": "claim", "max_shards": 4})
+        await w.flush()
+        serve = asyncio.ensure_future(a.p2p._handle_stream(server))
+        refusal = await Reader(client).msgpack()
+        await serve
+        assert refusal.get("error") and "shards" not in refusal, refusal
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+    telemetry.reset()
+
+
+# --- the end-to-end distributed pass ----------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_distributed_index_matches_single_node(tmp_path):
+    """The acceptance loop: a 2-node distributed index of a shared
+    location converges — on BOTH replicas — to exactly the rows,
+    object grouping, and journal vouches of a single-node pass, and
+    the remote peer really stole work."""
+    corpus = os.path.join(tmp_path, "corpus")
+    build_corpus(corpus)
+    telemetry.reset()
+    ref_content, ref_groups, ref_journal = await single_node_reference(
+        tmp_path, corpus
+    )
+
+    telemetry.reset()
+    a, b, lib_a, lib_b, loc, stats = await distributed_pass(
+        tmp_path, corpus
+    )
+    try:
+        n_files = len(ref_content)
+        assert stats["shards"] >= 6
+        # the mesh actually scaled out: the peer stole and completed
+        # shards through the WORK plane
+        assert stats["remote_shards"] > 0, stats
+        assert b.p2p.work.worker.executed_shards > 0
+        assert counter_value("sd_work_shards_total",
+                             result="completed_remote") > 0
+        from spacedrive_tpu.telemetry.peers import peer_label
+
+        assert counter_value(
+            "sd_work_steals_total",
+            peer=peer_label(str(b.p2p.p2p.remote_identity)),
+        ) > 0
+
+        # coordinator replica: bit-identical observable state
+        assert content_map(lib_a, loc["id"]) == ref_content
+        assert object_grouping(lib_a, loc["id"]) == ref_groups
+        assert journal_map(lib_a, loc["id"]) == ref_journal
+
+        # peer replica converges to the same rows through sync
+        await settle_replica(
+            lib_b, loc["id"],
+            sum(1 for v in ref_content.values() if v is not None),
+        )
+        b_loc = lib_b.db.find_one(
+            "location", pub_id=bytes.fromhex(loc["pub_id"].hex())
+        )
+        assert b_loc is not None
+        b_content = content_map(lib_b, b_loc["id"])
+        assert {k: v for k, v in b_content.items() if v is not None} == \
+            {k: v for k, v in ref_content.items() if v is not None}
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+    telemetry.reset()
+
+
+@pytest.mark.asyncio
+async def test_peer_death_mid_lease_converges(tmp_path):
+    """Chaos: the stealing peer dies after its first lease (p2p.steal
+    vanish). The lease expires, the coordinator re-pools and re-executes
+    the abandoned shards, and the final state is STILL bit-identical to
+    the single-node pass."""
+    corpus = os.path.join(tmp_path, "corpus")
+    build_corpus(corpus, n=32, seed=11)
+    telemetry.reset()
+    ref_content, ref_groups, ref_journal = await single_node_reference(
+        tmp_path, corpus
+    )
+
+    telemetry.reset()
+    plan = faults.FaultPlan.parse("p2p.steal:vanish:arg=lease,times=1")
+    with faults.active(plan):
+        a, b, lib_a, _lib_b, loc, stats = await distributed_pass(
+            tmp_path, corpus, lease_max_s=0.5,
+        )
+    try:
+        assert plan.activations().get("p2p.steal", 0) >= 1
+        # the abandoned lease expired and its shards were re-stolen
+        assert counter_value("sd_work_shards_total", result="expired") >= 1
+        assert content_map(lib_a, loc["id"]) == ref_content
+        assert object_grouping(lib_a, loc["id"]) == ref_groups
+        assert journal_map(lib_a, loc["id"]) == ref_journal
+        # every shard still completed exactly once on the board
+        assert stats["local_shards"] + stats["remote_shards"] == \
+            stats["shards"]
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+    telemetry.reset()
+
+
+@pytest.mark.asyncio
+async def test_claim_race_double_execution_converges(tmp_path):
+    """Chaos: every peer claim also double-leases an in-flight shard
+    (p2p.steal race) — shards get executed twice by different nodes.
+    Deterministic object pub_ids + LWW make both executions emit the
+    same rows, so the duplicate completion is absorbed and the result
+    matches the single-node pass exactly."""
+    corpus = os.path.join(tmp_path, "corpus")
+    build_corpus(corpus, n=32, seed=13)
+    telemetry.reset()
+    ref_content, ref_groups, ref_journal = await single_node_reference(
+        tmp_path, corpus
+    )
+
+    telemetry.reset()
+    plan = faults.FaultPlan.parse("p2p.steal:race:arg=claim,times=")
+    with faults.active(plan):
+        a, b, lib_a, _lib_b, loc, _stats = await distributed_pass(
+            tmp_path, corpus,
+        )
+    try:
+        assert plan.activations().get("p2p.steal", 0) >= 1
+        assert content_map(lib_a, loc["id"]) == ref_content
+        assert object_grouping(lib_a, loc["id"]) == ref_groups
+        assert journal_map(lib_a, loc["id"]) == ref_journal
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+    telemetry.reset()
+
+
+# --- degraded modes ---------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_distribute_without_p2p_degrades_to_local(tmp_path):
+    """No P2P runtime at all: the same entry point runs every shard
+    locally and still matches the single-node oracle (the shard path IS
+    the identify path)."""
+    from spacedrive_tpu.jobs.manager import JobBuilder
+    from spacedrive_tpu.location.indexer.job import IndexerJob
+    from spacedrive_tpu.location.indexer.mesh import distribute_location_index
+    from spacedrive_tpu.location.locations import LocationCreateArgs
+    from spacedrive_tpu.node import Node
+
+    corpus = os.path.join(tmp_path, "corpus")
+    build_corpus(corpus, n=16, seed=17)
+    telemetry.reset()
+    ref_content, ref_groups, ref_journal = await single_node_reference(
+        tmp_path, corpus
+    )
+
+    node = Node(os.path.join(tmp_path, "lone"), use_device=False,
+                with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        lib = await node.create_library("lone")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+        stats = await distribute_location_index(node, lib, loc["id"])
+        assert stats["remote_shards"] == 0
+        assert content_map(lib, loc["id"]) == ref_content
+        assert object_grouping(lib, loc["id"]) == ref_groups
+        assert journal_map(lib, loc["id"]) == ref_journal
+    finally:
+        await node.shutdown()
+    telemetry.reset()
+
+
+def test_deterministic_object_pub_ids():
+    from spacedrive_tpu.object.file_identifier.link import object_pub_for
+
+    lib = uuid.uuid4()
+    cas = "aa" * 16
+    assert object_pub_for(lib, cas) == object_pub_for(lib, cas)
+    assert object_pub_for(lib, cas) != object_pub_for(lib, "bb" * 16)
+    assert object_pub_for(uuid.uuid4(), cas) != object_pub_for(lib, cas)

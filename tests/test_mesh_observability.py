@@ -370,6 +370,44 @@ def test_bench_compare_gates_regressions():
     assert any("link-bound" in s for s in res["skipped"])
 
 
+def test_bench_compare_e2e_link_context_and_mesh_series():
+    """The ISSUE-9 satellite semantics: a journal-/host-bound config
+    (config_warm, config_mesh) is never `blocked` — its headline rates
+    still gate under congestion; only its cold-leg rates are excused —
+    and the mesh scaling series is comparable."""
+    from tools.bench_compare import compare_e2e
+
+    warm = {
+        "warm_files_per_s": 300.0, "cold_files_per_s": 100.0,
+        "warm_speedup_vs_cold": 10.0, "journal_hit_rate": 0.99,
+    }
+    old = {"config_warm": dict(warm),
+           "config_mesh": {"mesh1_files_per_s": 300.0,
+                           "mesh2_files_per_s": 450.0,
+                           "scaling_efficiency": 0.75}}
+    # a REAL warm regression under congestion must still gate
+    bad = {"config_warm": dict(warm, warm_files_per_s=100.0,
+                               link_context="congested-link"),
+           "config_mesh": dict(old["config_mesh"])}
+    res = compare_e2e(old, bad, 0.15)
+    names = [r["name"] for r in res["regressions"]]
+    assert "config_warm.warm_files_per_s" in names
+    # ...while the cold-leg rates are excused as weather
+    assert any("cold-leg" in s for s in res["skipped"])
+    assert not any(r["name"].endswith("cold_files_per_s")
+                   for r in res["regressions"])
+
+    # mesh scaling regressions are first-class comparable series
+    slow_mesh = {"config_warm": dict(warm),
+                 "config_mesh": {"mesh1_files_per_s": 300.0,
+                                 "mesh2_files_per_s": 200.0,
+                                 "scaling_efficiency": 0.33}}
+    res = compare_e2e(old, slow_mesh, 0.15)
+    names = [r["name"] for r in res["regressions"]]
+    assert "config_mesh.mesh2_files_per_s" in names
+    assert "config_mesh.scaling_efficiency" in names
+
+
 def test_bench_compare_cli_on_repo_history(tmp_path):
     """The real r01→r02 regression is caught; r04→r05 passes."""
     import subprocess
@@ -445,126 +483,14 @@ async def test_telemetry_header_roundtrip():
 # --- the two-node end-to-end loop ------------------------------------------
 
 
-class _Pipe:
-    def __init__(self):
-        self._buf = bytearray()
-        self._event = asyncio.Event()
-
-    async def write(self, data: bytes) -> None:
-        self._buf += data
-        self._event.set()
-
-    async def read_exact(self, n: int) -> bytes:
-        while len(self._buf) < n:
-            self._event.clear()
-            await self._event.wait()
-        out = bytes(self._buf[:n])
-        del self._buf[:n]
-        return out
-
-
-class _DuplexEnd:
-    def __init__(self, rd: _Pipe, wr: _Pipe, remote_identity):
-        self._rd, self._wr = rd, wr
-        self.remote_identity = remote_identity
-
-    async def write(self, data: bytes) -> None:
-        await self._wr.write(data)
-
-    async def read_exact(self, n: int) -> bytes:
-        return await self._rd.read_exact(n)
-
-    async def close(self) -> None:
-        pass
-
-
-def _fake_transport(src_mgr, dst_mgr, server_tasks: set):
-    """A ``new_stream`` replacement: in-process duplex whose server end
-    is dispatched through the destination manager's REAL stream handler
-    (the full Header protocol, minus socket encryption)."""
-
-    async def new_stream(identity, timeout: float = 10.0):
-        assert identity == dst_mgr.p2p.remote_identity
-        c2s, s2c = _Pipe(), _Pipe()
-        client = _DuplexEnd(s2c, c2s, dst_mgr.p2p.remote_identity)
-        server = _DuplexEnd(c2s, s2c, src_mgr.p2p.remote_identity)
-        task = asyncio.ensure_future(dst_mgr._handle_stream(server))
-        server_tasks.add(task)
-        task.add_done_callback(server_tasks.discard)
-        return client
-
-    return new_stream
-
-
-async def _make_mesh_pair(tmp_path):
-    """Two Nodes sharing one library, P2PManagers linked in-process."""
-    from spacedrive_tpu.node import Node
-    from spacedrive_tpu.p2p.manager import P2PManager
-
-    nodes = []
-    for name in ("alpha", "beta"):
-        n = Node(os.path.join(tmp_path, name), use_device=False,
-                 with_labeler=False)
-        n.config.config.p2p.enabled = False
-        n.config.config.name = name
-        await n.start()
-        nodes.append(n)
-    a, b = nodes
-
-    lib_a = await a.create_library("shared")
-    # share the library id with beta (the pairing outcome, by file move)
-    b.libraries.libraries.clear()
-    lib_b_local = b.libraries.create("shared")
-    old = lib_b_local.id
-    for suffix in (".sdlibrary", ".db"):
-        shutil.move(
-            os.path.join(b.libraries.dir, f"{old}{suffix}"),
-            os.path.join(b.libraries.dir, f"{lib_a.id}{suffix}"),
-        )
-    for s in ("-wal", "-shm"):
-        p = os.path.join(b.libraries.dir, f"{old}.db{s}")
-        if os.path.exists(p):
-            shutil.move(p, os.path.join(b.libraries.dir, f"{lib_a.id}.db{s}"))
-    lib_b_local.close()
-    b.libraries.libraries.clear()
-    lib_b = b.libraries._load(lib_a.id)
-    await b._init_library(lib_b)
-    for src, dst, src_node in ((lib_a, lib_b, a), (lib_b, lib_a, b)):
-        inst = src.db.find_one("instance", pub_id=src.instance_uuid.bytes)
-        dst.db.insert(
-            "instance",
-            pub_id=inst["pub_id"],
-            # what the pairing flow stores: the owning node's
-            # RemoteIdentity bytes — the TELEMETRY responder's
-            # library-membership gate keys off this
-            identity=src_node.config.config.identity
-            .to_remote_identity().to_bytes(),
-            node_id=inst["node_id"], node_name=inst["node_name"],
-            node_platform=inst["node_platform"], last_seen=inst["last_seen"],
-            date_created=inst["date_created"],
-        )
-
-    a.p2p = P2PManager(a)
-    b.p2p = P2PManager(b)
-    server_tasks: set = set()
-    a.p2p.p2p.new_stream = _fake_transport(a.p2p, b.p2p, server_tasks)
-    b.p2p.p2p.new_stream = _fake_transport(b.p2p, a.p2p, server_tasks)
-    a.p2p.register_library(lib_a)
-    b.p2p.register_library(lib_b)
-    # mutual "discovery" with library/instance metadata (what mdns
-    # beacons would have advertised)
-    for me, other, other_lib in ((a, b, lib_b), (b, a, lib_a)):
-        me.p2p.p2p.discovered(
-            "test",
-            other.p2p.p2p.remote_identity,
-            {("127.0.0.1", 1)},
-            {
-                "name": other.config.config.name,
-                "libraries": str(other_lib.id),
-                "instances": str(other_lib.sync.instance),
-            },
-        )
-    return a, b, lib_a, lib_b, server_tasks
+# the in-process duplex + two-node pair now live in the production
+# harness module (p2p/loopback.py) so the mesh-parallel index tests and
+# bench_e2e's config_mesh drive the SAME transport as this suite
+from spacedrive_tpu.p2p.loopback import (  # noqa: E402
+    DuplexEnd as _DuplexEnd,
+    Pipe as _Pipe,
+    make_mesh_pair as _make_mesh_pair,
+)
 
 
 @pytest.mark.asyncio

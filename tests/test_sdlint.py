@@ -1066,6 +1066,79 @@ def test_sd013_silent_outside_scope_and_in_autotune_itself(tmp_path):
     ) == []
 
 
+# --- SD014 p2p-unguarded-request -------------------------------------------
+
+
+SD014_SOURCE = """
+    from spacedrive_tpu.p2p.operations import ping, request_telemetry
+    from spacedrive_tpu.p2p.rspc import remote_exec
+
+    async def raw_pull(p2p, peer):
+        # unguarded: every dead peer costs a dial timeout here
+        snap = await request_telemetry(p2p, peer.identity)
+        rtt = await ping(p2p, peer.identity)
+        return snap, rtt
+
+    async def raw_exec(p2p, peer):
+        return await remote_exec(p2p, peer, "telemetry.debug_bundle")
+"""
+
+
+def test_sd014_flags_unguarded_p2p_requests(tmp_path):
+    findings = run_on(tmp_path, SD014_SOURCE, ["SD014"])
+    assert len(findings) == 3
+    assert rules_of(findings) == ["SD014"]
+    assert all("ResiliencePolicy" in f.message for f in findings)
+
+
+def test_sd014_silent_on_policy_wrapped_calls(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        from spacedrive_tpu.p2p.operations import request_telemetry
+        from spacedrive_tpu.p2p.rspc import remote_exec
+
+        async def guarded(policy, p2p, peers):
+            out = []
+            for peer in peers:
+                out.append(await policy.call(
+                    str(peer.identity),
+                    lambda peer=peer: request_telemetry(p2p, peer.identity),
+                ))
+            return out
+
+        async def guarded_exec(policy, p2p, peer):
+            return await policy.call(
+                str(peer),
+                lambda: remote_exec(p2p, peer, "telemetry.mesh"),
+            )
+
+        def unrelated(call, ping):
+            # names that merely LOOK like the wire ops but are locals
+            return call(ping)
+        """,
+        ["SD014"],
+    )
+    assert findings == []
+
+
+def test_sd014_exempts_defining_modules(tmp_path):
+    # the module that defines a request helper may dial directly — the
+    # client half itself is the implementation, not an adoption gap
+    assert run_scoped(
+        tmp_path,
+        "spacedrive_tpu/p2p/work.py",
+        """
+        async def announce_loop(p2p, peer, lib_id):
+            return await request_work(p2p, peer, lib_id, {"op": "status"})
+
+        async def request_work(p2p, peer, lib_id, body):
+            return {}
+        """,
+        ["SD014"],
+    ) == []
+
+
 # --- the gate (same entry point as `make lint` / CI) -----------------------
 
 

@@ -24,9 +24,14 @@ Comparison rules:
 BENCH_E2E leg: when ``BENCH_E2E_prev.json`` and ``BENCH_E2E.json`` both
 exist (bench_e2e.py archives the replaced artifact), the per-config
 rate series (``config1.device_files_per_s``, …,
-``config_warm.warm_files_per_s`` + the warm journal hit rate) gate with
-the same threshold; a config carrying ``blocked: congested-link`` on
+``config_warm.warm_files_per_s``, ``config_mesh.mesh2_files_per_s`` +
+the warm journal hit rate and mesh scaling_efficiency) gate with the
+same threshold; a config carrying ``blocked: congested-link`` on
 either side is excused — its rates measured the tunnel, not the code.
+Journal-/host-bound configs (config_warm, config_mesh) are never
+stamped blocked: under congestion they carry ``link_context`` and only
+their link-sensitive cold-leg rates are excused — their headline rates
+move ~0 device bytes and always gate.
 
 BENCH_AUTOTUNE leg: when ``BENCH_AUTOTUNE.json`` exists (``make
 bench-autotune``), the adaptive series gates ABSOLUTELY rather than
@@ -109,9 +114,18 @@ def compare(old: dict[str, Any], new: dict[str, Any],
             "skipped": skipped}
 
 
-_E2E_CONFIGS = ("config1", "config3", "config4", "config5", "config_warm")
+_E2E_CONFIGS = ("config1", "config3", "config4", "config5", "config_warm",
+                "config_mesh")
 # higher-is-better ratio series gated alongside the rates
-_E2E_RATIOS = ("journal_hit_rate", "warm_speedup_vs_cold")
+_E2E_RATIOS = ("journal_hit_rate", "warm_speedup_vs_cold", "scaling",
+               "scaling_efficiency")
+# rates that lean on a link-bound COLD leg: excused (only these) when a
+# non-link-bound config ran under congestion (``link_context`` stamp —
+# bench_e2e.probed(link_bound=False)). The headline warm/mesh rates move
+# ~0 device bytes and always gate; stamping the whole config ``blocked``
+# here is exactly the bug that made bench-check excuse real warm-path
+# regressions.
+_LINK_SENSITIVE_KEYS = ("cold_files_per_s", "warm_speedup_vs_cold")
 
 
 def e2e_series(doc: dict[str, Any]) -> dict[str, float]:
@@ -139,8 +153,8 @@ def compare_e2e(old: dict[str, Any], new: dict[str, Any],
     regressions: list[dict[str, Any]] = []
     skipped: list[str] = []
     for name in sorted(old_s):
+        cfg, _, key = name.partition(".")
         if name not in new_s:
-            cfg = name.split(".")[0]
             reason = (
                 "blocked (congested link) in one run"
                 if (old.get(cfg) or {}).get("blocked")
@@ -148,6 +162,14 @@ def compare_e2e(old: dict[str, Any], new: dict[str, Any],
                 else "absent in newer run"
             )
             skipped.append(f"{name}: {reason}")
+            continue
+        if key in _LINK_SENSITIVE_KEYS and (
+            (old.get(cfg) or {}).get("link_context")
+            or (new.get(cfg) or {}).get("link_context")
+        ):
+            skipped.append(
+                f"{name}: cold-leg rate with congested-link context"
+            )
             continue
         ov, nv = old_s[name], new_s[name]
         if ov <= 0:

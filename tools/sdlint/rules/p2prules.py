@@ -1,0 +1,103 @@
+"""P2P resilience-adoption rules.
+
+SD014  P2P request call sites that bypass ResiliencePolicy
+
+Every peer-facing exchange in this codebase is supposed to ride a
+``ResiliencePolicy`` (``utils/resilience.py``): bounded jittered
+retries and a per-peer circuit breaker, so a dead or flapping peer
+costs one fast ``BreakerOpen`` instead of a fresh dial + timeout per
+call. The sync/telemetry/work planes adopted this (PR 6/9); SD014
+keeps NEW call sites honest by flagging any direct call to a P2P
+request helper that is not lexically inside a ``*.call(...)``
+invocation (the policy's execution seam — ``POLICY.call(target,
+lambda: request_x(...))``).
+
+Scope: everywhere except the modules that *define* the request
+helpers (``p2p/operations.py``, ``p2p/sync.py``, ``p2p/rspc.py``,
+``p2p/work.py``) — a definition module's own wire plumbing (the
+client half itself, retry-wrapped re-dial helpers) is the one place
+a bare call is the implementation rather than an adoption gap.
+
+What counts as "inside a policy call": any enclosing AST ancestor
+that is a ``Call`` whose callee attribute is named ``call`` — which
+matches the idiom used at every adopted site (the request rides a
+lambda argument of ``SYNC_POLICY.call`` / ``WORK_POLICY.call`` /
+...). Indirection the AST cannot see (a named coroutine passed to a
+policy elsewhere) should be restructured to the lambda idiom or
+baselined with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, call_name, rule
+
+#: client halves of the P2P wire operations (one name per exchange)
+REQUEST_TAILS = {
+    "ping",
+    "request_telemetry",
+    "request_ops_from_peer",
+    "alert_new_ops",
+    "request_file",
+    "request_work",
+    "remote_exec",
+}
+
+#: modules that define/own the request helpers — exempt
+DEFINING_FRAGMENTS = (
+    "p2p/operations.py",
+    "p2p/sync.py",
+    "p2p/rspc.py",
+    "p2p/work.py",
+)
+
+
+def _inside_policy_call(ctx: FileContext, node: ast.AST) -> bool:
+    """True when an ancestor is a ``X.call(...)`` invocation and the
+    node sits inside its arguments (the resilience execution seam)."""
+    parents = ctx.parents
+    cur = node
+    while cur is not None:
+        parent = parents.get(cur)
+        if (
+            isinstance(parent, ast.Call)
+            and isinstance(parent.func, ast.Attribute)
+            and parent.func.attr == "call"
+            and cur is not parent.func
+        ):
+            return True
+        cur = parent
+    return False
+
+
+@rule(
+    "SD014",
+    "p2p-unguarded-request",
+    "P2P request call sites that bypass utils.resilience.ResiliencePolicy "
+    "— a dead peer costs a full dial + timeout per call instead of one "
+    "fast BreakerOpen; wrap as POLICY.call(target, lambda: request_x(...))",
+)
+def check_unguarded_p2p_request(ctx: FileContext) -> Iterator[Finding]:
+    if any(frag in ctx.path for frag in DEFINING_FRAGMENTS):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name is None:
+            continue
+        tail = name.rsplit(".", 1)[-1]
+        if tail not in REQUEST_TAILS:
+            continue
+        if _inside_policy_call(ctx, node):
+            continue
+        yield ctx.finding(
+            "SD014",
+            node,
+            f"`{tail}` dials a peer without a ResiliencePolicy: wrap it "
+            f"as `POLICY.call(str(peer), lambda: {tail}(...))` so "
+            "retries stay bounded/jittered and a dead peer trips a "
+            "per-peer breaker instead of a timeout per call",
+        )
