@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check \
-	bench-warm bench-autotune bench-mesh chaos
+	bench-warm bench-autotune bench-mesh bench-serve chaos
 
 lint:
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
@@ -62,6 +62,15 @@ bench-autotune:
 bench-mesh:
 	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=mesh SD_E2E_FILES=800 \
 		SD_E2E_REPEATS=2 SD_BENCH_WAIT=0 $(PY) bench_e2e.py
+
+# serving-capacity bench: N simulated HTTP/rspc clients vs one node,
+# clean and with the DB throttled through the db.slow fault point,
+# recording unloaded/capacity/4x-overload latency + goodput + shed
+# stats into BENCH_SERVE.json; `make bench-check` re-derives the
+# graceful-degradation bars from the recorded rates
+# (docs/robustness.md "Serving under overload").
+bench-serve:
+	env JAX_PLATFORMS=cpu $(PY) bench_serve.py > /dev/null
 
 # perf trajectory gate: diff the two most recent BENCH_r*.json rounds
 # AND (when BENCH_E2E_prev.json exists) the previous → current

@@ -70,4 +70,14 @@ def invalidate_query(
         key=key,
         arg=arg,
     )
+    # the serve layer's read-your-writes hook: the same call that tells
+    # the frontend to refetch drops the server-side cached results, so
+    # a mutation is never answered by its own pre-image
+    from ..serve import runtime_for
+
+    serve = runtime_for(node)
+    if serve is not None:
+        serve.invalidate_query(
+            key, library.id if library is not None else None, source="local"
+        )
     node.event_bus.emit((CoreEventKind.INVALIDATE_OPERATION, op))

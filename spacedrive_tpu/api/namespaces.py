@@ -1011,11 +1011,11 @@ def _tags(r: Router) -> None:
 def _collection_ns(r: Router, ns: str, table: str, link_table: str, link_col: str) -> None:
     """spaces and albums share the same CRUD shape."""
 
-    @r.query(f"{ns}.list", library=True)
+    @r.query(f"{ns}.list", library=True, priority="interactive")
     def list_all(node, library):
         return normalise(table, library.db.find(table))
 
-    @r.query(f"{ns}.getObjects", library=True)
+    @r.query(f"{ns}.getObjects", library=True, priority="interactive")
     def get_objects(node, library, arg):
         rows = library.db.query(
             f"SELECT o.* FROM object o JOIN {link_table} l ON l.object_id = o.id "
@@ -1024,7 +1024,7 @@ def _collection_ns(r: Router, ns: str, table: str, link_table: str, link_col: st
         )
         return normalise("object", rows)
 
-    @r.mutation(f"{ns}.create", library=True)
+    @r.mutation(f"{ns}.create", library=True, priority="interactive")
     def create(node, library, arg):
         cols = dict(
             pub_id=new_pub_id(),
@@ -1038,14 +1038,14 @@ def _collection_ns(r: Router, ns: str, table: str, link_table: str, link_col: st
         invalidate_query(node, f"{ns}.list", library)
         return rid
 
-    @r.mutation(f"{ns}.delete", library=True)
+    @r.mutation(f"{ns}.delete", library=True, priority="interactive")
     def delete(node, library, arg):
         library.db.delete(link_table, **{link_col: int(arg)})
         library.db.delete(table, id=int(arg))
         invalidate_query(node, f"{ns}.list", library)
         return None
 
-    @r.mutation(f"{ns}.addObjects", library=True)
+    @r.mutation(f"{ns}.addObjects", library=True, priority="interactive")
     def add_objects(node, library, arg):
         for oid in arg["object_ids"]:
             if arg.get("remove"):
@@ -1614,7 +1614,7 @@ def _telemetry(r: Router) -> None:
         # the Prometheus text, for copy/paste diagnostics in the UI
         return {"text": telemetry.render()}
 
-    @r.query("telemetry.trace_export")
+    @r.query("telemetry.trace_export", priority="background")
     def trace_export(node, arg=None):
         # Chrome-trace JSON (Perfetto-loadable); arg {trace_id?} filters
         trace_id = (arg or {}).get("trace_id") if isinstance(arg, dict) else None
@@ -1625,7 +1625,7 @@ def _telemetry(r: Router) -> None:
         # the flight recorder's rings, most-recent-last
         return telemetry.events.all_events()
 
-    @r.query("telemetry.debug_bundle")
+    @r.query("telemetry.debug_bundle", priority="background")
     def debug_bundle(node):
         # the redacted support artifact (see telemetry.bundle)
         return telemetry.debug_bundle(node)
@@ -1637,16 +1637,35 @@ def _telemetry(r: Router) -> None:
 
         return _health.evaluate(node)
 
-    @r.query("telemetry.mesh")
+    @r.query("telemetry.mesh", priority="interactive")
     async def mesh(node, arg=None):
         # mesh-wide view: local snapshot + federated peer snapshots
-        # with staleness marking; arg {refresh?: bool, force?: bool}
-        from ..telemetry.federation import mesh_status
+        # with staleness marking; arg {refresh?: bool, force?: bool}.
+        # Single-flighted through the serve cache — N dashboards cost
+        # one refresh round per TTL window (same path as GET /mesh).
+        # Explicitly INTERACTIVE, not the namespace's control class: a
+        # federation refresh dials peers — a control-class (unsheddable)
+        # refresh loop would be an ungovernable overload hole, and the
+        # identical read over GET /mesh already queues/sheds
+        from ..telemetry.federation import mesh_status_cached
 
         opts = arg if isinstance(arg, dict) else {}
-        if node.p2p is not None and opts.get("refresh", True):
-            await node.p2p.refresh_federation(force=bool(opts.get("force")))
-        return mesh_status(node)
+        return await mesh_status_cached(
+            node,
+            refresh=bool(opts.get("refresh", True)),
+            force=bool(opts.get("force")),
+        )
+
+    @r.query("telemetry.serve")
+    def serve_status(node):
+        # admission gate + read-cache state (the overload posture):
+        # mode, per-class inflight/shed, cache occupancy
+        from ..serve import runtime_for
+
+        serve = runtime_for(node)
+        if serve is None:
+            return {"enabled": False}
+        return {"enabled": True, **serve.snapshot()}
 
 
 def _invalidation(r: Router) -> None:

@@ -53,6 +53,10 @@ class Procedure:
     kind: str  # query | mutation | subscription
     fn: Callable[..., Any]
     library_scoped: bool = False
+    # admission-gate priority class; None resolves through the
+    # serve.policy.NAMESPACE_CLASSES map (sdlint SD015 requires every
+    # registration to be covered one way or the other)
+    priority: str | None = None
 
 
 class Router:
@@ -63,23 +67,28 @@ class Router:
 
     # --- registration (decorators) ---
 
-    def _register(self, key: str, kind: str, library: bool):
+    def _register(self, key: str, kind: str, library: bool,
+                  priority: str | None = None):
         def deco(fn):
             if key in self.procedures:
                 raise ValueError(f"duplicate procedure {key}")
-            self.procedures[key] = Procedure(key, kind, fn, library)
+            self.procedures[key] = Procedure(key, kind, fn, library,
+                                             priority=priority)
             return fn
 
         return deco
 
-    def query(self, key: str, *, library: bool = False):
-        return self._register(key, "query", library)
+    def query(self, key: str, *, library: bool = False,
+              priority: str | None = None):
+        return self._register(key, "query", library, priority)
 
-    def mutation(self, key: str, *, library: bool = False):
-        return self._register(key, "mutation", library)
+    def mutation(self, key: str, *, library: bool = False,
+                 priority: str | None = None):
+        return self._register(key, "mutation", library, priority)
 
-    def subscription(self, key: str, *, library: bool = False):
-        return self._register(key, "subscription", library)
+    def subscription(self, key: str, *, library: bool = False,
+                     priority: str | None = None):
+        return self._register(key, "subscription", library, priority)
 
     def merge(self, other: "Router") -> "Router":
         for key, proc in other.procedures.items():
@@ -97,13 +106,80 @@ class Router:
         arg: Any = None,
         library_id: str | uuid.UUID | None = None,
     ) -> Any:
-        """Run a query/mutation. Library-scoped procedures resolve
-        `library_id` first (ref:api/utils/library.rs LibraryArgs)."""
+        """Run a query/mutation through the serve layer: admission-gate
+        the call under the procedure's priority class, and serve
+        allowlisted queries from the read cache (single-flight, tag-
+        invalidated, stale-while-revalidate in brownout). Without a
+        serve runtime (``SD_SERVE_GATE=0`` or a bare node) this is
+        exactly the pre-serve direct path."""
         proc = self.procedures.get(key)
         if proc is None:
             raise RspcError.not_found(f"procedure {key!r}")
         if proc.kind == "subscription":
             raise RspcError.bad_request(f"{key} is a subscription; use subscribe()")
+        from ..serve import Shed, class_for_key, runtime_for
+
+        serve = runtime_for(node)
+        if serve is None:
+            return await self._exec_direct(node, proc, key, arg, library_id)
+        klass = class_for_key(key, proc.priority)
+        try:
+            return await self._exec_gated(
+                node, serve, proc, key, arg, library_id, klass
+            )
+        except Shed as e:
+            err = RspcError(429, f"SHED: {e.reason}")
+            err.retry_after_s = e.retry_after_s
+            raise err from None
+
+    async def _exec_gated(
+        self, node: Any, serve: Any, proc: Procedure, key: str,
+        arg: Any, library_id: Any, klass: str,
+    ) -> Any:
+        """Admission × cache composition: the gate wraps the cache
+        LOADER, not the lookup — a fresh hit costs no SQLite work and
+        must not consume (or be shed for) an admission slot, and a
+        100-waiter stampede on one key coalesces onto ONE admitted
+        load instead of 100 slot requests."""
+        from ..serve import CACHEABLE_QUERIES, query_cache_key
+
+        if (
+            proc.kind != "query"
+            or key not in CACHEABLE_QUERIES
+            or not proc.library_scoped
+            or library_id is None
+        ):
+            async with serve.gate.admit(klass, key=key):
+                return await self._exec_direct(
+                    node, proc, key, arg, library_id
+                )
+
+        async def load() -> Any:
+            async with serve.gate.admit(klass, key=key):
+                # cache loaders run OFF the event loop: an allowlisted
+                # query is a pure SQLite read, and a slow/contended disk
+                # under it must stall this request's thread, not the
+                # loop every other class is served from (it also makes
+                # the in-flight budget real — sync handlers never yield,
+                # so on-loop they can't overlap enough to be counted)
+                return await self._exec_direct(node, proc, key, arg,
+                                               library_id, off_loop=True)
+
+        from ..serve import canonical_library_id
+
+        lib_key = canonical_library_id(library_id)
+        result = await serve.queries.get(
+            query_cache_key(key, library_id, arg),
+            load,
+            tags=(("lib", lib_key), ("q", key, lib_key)),
+            stale_ok=serve.gate.in_brownout(),
+        )
+        return result.value
+
+    async def _exec_direct(
+        self, node: Any, proc: Procedure, key: str, arg: Any,
+        library_id: Any, off_loop: bool = False,
+    ) -> Any:
         args = [node]
         if proc.library_scoped:
             lib = self._resolve_library(node, library_id)
@@ -111,7 +187,12 @@ class Router:
         if _wants_arg(proc.fn, proc.library_scoped):
             args.append(arg)
         try:
-            result = proc.fn(*args)
+            import asyncio
+
+            if off_loop and not inspect.iscoroutinefunction(proc.fn):
+                result = await asyncio.to_thread(proc.fn, *args)
+            else:
+                result = proc.fn(*args)
             if inspect.isawaitable(result):
                 result = await result
         except (KeyError, TypeError, ValueError) as e:

@@ -18,6 +18,7 @@ import json
 import logging
 import mimetypes
 import os
+import re
 import uuid
 from typing import Any
 
@@ -25,11 +26,17 @@ from aiohttp import WSMsgType, web
 
 from .. import telemetry
 from ..files.isolated_path import full_path_from_db_row
+from ..serve import BACKGROUND, CONTROL, INTERACTIVE, Shed, runtime_for
 from .router import Router, RspcError
 
 logger = logging.getLogger(__name__)
 
 CHUNK = 256 * 1024
+
+#: sentinel class for routes whose admission happens per-procedure
+#: inside Router.exec (the rspc transports) — the route-level
+#: middleware must not double-admit them
+RSPC_DEFERRED = "rspc"
 
 # Host values a browser can only produce for a genuinely-local page.
 # Anything else on this localhost-bound server means DNS rebinding: a
@@ -60,25 +67,50 @@ class ApiServer:
         self.router = router
         self._allowed_hosts = set(LOCAL_HOSTNAMES)
         self._allow_any_host = False
-        self.app = web.Application(middlewares=[self._host_guard])
+        self._route_classes: dict[tuple[str, str], str] = {}
+        self.app = web.Application(
+            middlewares=[self._host_guard, self._admission]
+        )
+        # every route declares its admission priority class through the
+        # _gated seam (sdlint SD015 `ungated-handler` enforces this for
+        # new routes); rspc transports defer to per-procedure classes
         self.app.add_routes(
             [
-                web.get("/", self._index),
-                web.get("/metrics", self._metrics),
-                web.get("/trace", self._trace),
-                web.get("/health", self._health),
-                web.get("/mesh", self._mesh),
-                web.get("/static/{path:.*}", self._static),
-                web.get("/rspc/client.js", self._client_js),
-                web.get("/rspc/manifest", self._manifest),
-                web.post("/rspc/{key}", self._rspc_http),
-                web.get("/rspc/ws", self._rspc_ws),
-                web.get("/spacedrive/thumbnail/{ns}/{shard}/{name}", self._thumbnail),
-                web.get(
-                    "/spacedrive/file/{library_id}/{location_id}/{path:.*}",
-                    self._file,
+                self._gated(web.get("/", self._index), INTERACTIVE),
+                self._gated(web.get("/metrics", self._metrics), CONTROL),
+                self._gated(web.get("/trace", self._trace), BACKGROUND),
+                self._gated(web.get("/health", self._health), CONTROL),
+                self._gated(web.get("/mesh", self._mesh), INTERACTIVE),
+                self._gated(
+                    web.get("/static/{path:.*}", self._static), INTERACTIVE
                 ),
-                web.get("/spacedrive/local", self._local_file),
+                self._gated(
+                    web.get("/rspc/client.js", self._client_js), INTERACTIVE
+                ),
+                self._gated(
+                    web.get("/rspc/manifest", self._manifest), INTERACTIVE
+                ),
+                self._gated(
+                    web.post("/rspc/{key}", self._rspc_http), RSPC_DEFERRED
+                ),
+                self._gated(web.get("/rspc/ws", self._rspc_ws), RSPC_DEFERRED),
+                self._gated(
+                    web.get(
+                        "/spacedrive/thumbnail/{ns}/{shard}/{name}",
+                        self._thumbnail,
+                    ),
+                    INTERACTIVE,
+                ),
+                self._gated(
+                    web.get(
+                        "/spacedrive/file/{library_id}/{location_id}/{path:.*}",
+                        self._file,
+                    ),
+                    INTERACTIVE,
+                ),
+                self._gated(
+                    web.get("/spacedrive/local", self._local_file), INTERACTIVE
+                ),
             ]
         )
         self._runner: web.AppRunner | None = None
@@ -97,7 +129,10 @@ class ApiServer:
         else:
             # explicit non-local binds stay reachable by their own name
             self._allowed_hosts.add(host)
-        self._runner = web.AppRunner(self.app)
+        # no access log: formatting a log line per request is measurable
+        # loop work at explorer-burst rates, and the telemetry layer
+        # already counts every request with labels a logger can't match
+        self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
         await site.start()
@@ -120,6 +155,41 @@ class ApiServer:
                 and _hostname_of(host) not in self._allowed_hosts:
             raise web.HTTPForbidden(text="bad host")
         return await handler(request)
+
+    def _gated(self, route: web.RouteDef, klass: str) -> web.RouteDef:
+        """Declare a route's admission priority class (the serve-layer
+        seam; sdlint SD015). Returns the route unchanged — the class
+        lands in the table the admission middleware resolves against,
+        keyed by the CANONICAL path (aiohttp strips the regex from
+        ``{name:regex}`` params, so the table must too — otherwise
+        pattern routes like ``/static/{path:.*}`` silently run
+        ungated)."""
+        canonical = re.sub(r"\{([^}:]+):[^}]*\}", r"{\1}", route.path)
+        self._route_classes[(route.method, canonical)] = klass
+        return route
+
+    @web.middleware
+    async def _admission(
+        self, request: web.Request, handler
+    ) -> web.StreamResponse:
+        """Admission-gate every routed request under its declared
+        priority class. Shed → 429/``SHED`` + Retry-After, fast. The
+        rspc transports pass through — Router.exec admits them under
+        the procedure's own class. No serve runtime = the ungated
+        pre-serve path, byte-identical."""
+        serve = runtime_for(self.node)
+        if serve is None:
+            return await handler(request)
+        resource = getattr(request.match_info.route, "resource", None)
+        canonical = resource.canonical if resource is not None else None
+        klass = self._route_classes.get((request.method, canonical or ""))
+        if klass is None or klass == RSPC_DEFERRED:
+            return await handler(request)
+        try:
+            async with serve.gate.admit(klass, key=canonical or request.path):
+                return await handler(request)
+        except Shed as e:
+            return _shed_response(e)
 
     async def _metrics(self, _request: web.Request) -> web.Response:
         """Prometheus scrape endpoint over the process registry."""
@@ -157,15 +227,19 @@ class ApiServer:
         cache's per-peer view (freshness-marked). Pull-through — the
         request refreshes peers whose snapshot aged past the cache's
         refresh interval; `?refresh=0` reads the cache as-is,
-        `?force=1` re-pulls everyone."""
-        from ..telemetry.federation import mesh_status
+        `?force=1` re-pulls everyone. N concurrent dashboard polls
+        collapse onto one refresh + one snapshot computation through
+        the serve cache's single-flight (federation.mesh_status_cached)."""
+        from ..telemetry.federation import mesh_status_cached
 
-        p2p = self.node.p2p
-        if p2p is not None and request.query.get("refresh") != "0":
-            await p2p.refresh_federation(
-                force=request.query.get("force") == "1"
-            )
-        return web.json_response(mesh_status(self.node), dumps=_dumps)
+        return web.json_response(
+            await mesh_status_cached(
+                self.node,
+                refresh=request.query.get("refresh") != "0",
+                force=request.query.get("force") == "1",
+            ),
+            dumps=_dumps,
+        )
 
     async def _index(self, _request: web.Request) -> web.FileResponse:
         """The explorer web UI (role parity: ref:interface/ + apps/web)."""
@@ -218,13 +292,56 @@ class ApiServer:
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
         try:
+            serve = runtime_for(self.node)
+            lib_id = body.get("library_id")
+            if serve is not None and lib_id is not None:
+                from ..serve import (
+                    CACHEABLE_QUERIES,
+                    canonical_library_id,
+                    query_cache_key,
+                )
+
+                if key in CACHEABLE_QUERIES:
+                    # byte-level response cache: a hot explorer query is
+                    # served as pre-encoded bytes — under a stampede the
+                    # loop pays one dict lookup + send per request
+                    # instead of re-serializing 50 rows each time. Rides
+                    # the same tags (and therefore the same local+sync
+                    # invalidation) as the router's object cache.
+                    arg = body.get("arg")
+
+                    async def load_bytes() -> bytes:
+                        result = await self.router.exec(
+                            self.node, key, arg, lib_id
+                        )
+                        return _dumps({"result": result}).encode()
+
+                    lib_key = canonical_library_id(lib_id)
+                    res = await serve.queries.get(
+                        ("http",) + query_cache_key(key, lib_id, arg),
+                        load_bytes,
+                        tags=(("lib", lib_key), ("q", key, lib_key)),
+                        stale_ok=serve.gate.in_brownout(),
+                    )
+                    return web.Response(
+                        body=res.value,
+                        content_type="application/json",
+                        headers={"X-SD-Cache": res.state},
+                    )
             result = await self.router.exec(
                 self.node, key, body.get("arg"), body.get("library_id")
             )
             return web.json_response({"result": result}, dumps=_dumps)
         except RspcError as e:
+            headers = {}
+            retry_after = getattr(e, "retry_after_s", None)
+            if e.code == 429 and retry_after is not None:
+                # admission-gate shed: tell well-behaved clients when
+                # to come back instead of letting them hammer
+                headers["Retry-After"] = str(max(1, round(retry_after)))
             return web.json_response(
-                {"error": e.message, "code": e.code}, status=e.code
+                {"error": e.message, "code": e.code}, status=e.code,
+                headers=headers,
             )
         except Exception as e:  # surface like rspc's internal error
             logger.exception("procedure %s failed", key)
@@ -318,6 +435,34 @@ class ApiServer:
             [os.path.abspath(path), os.path.abspath(store.root)]
         ) != os.path.abspath(store.root):
             raise web.HTTPBadRequest(text="bad path")
+        serve = runtime_for(self.node)
+        if serve is not None:
+            # byte cache: thumbnails are content-addressed (the webp for
+            # a cas_id never changes), so a miss loads once and a hot
+            # explorer grid stops touching the disk. Absent files are
+            # NOT cached — a freshly generated thumbnail appears on the
+            # next request.
+            async def load() -> bytes:
+                def read() -> bytes:
+                    with open(path, "rb") as f:
+                        return f.read()
+
+                try:
+                    return await asyncio.to_thread(read)
+                except OSError:
+                    raise web.HTTPNotFound()
+
+            result = await serve.thumbs.get(
+                (ns, shard, name), load, weigh=len,
+            )
+            return web.Response(
+                body=result.value,
+                headers={
+                    "Content-Type": "image/webp",
+                    "Cache-Control": "max-age=86400",
+                    "X-SD-Cache": result.state,
+                },
+            )
         if not os.path.isfile(path):
             raise web.HTTPNotFound()
         return web.FileResponse(
@@ -516,6 +661,16 @@ class _StreamSink:
                 if task is not fetch:
                     task.cancel()
         return self._chunks.pop(0)
+
+
+def _shed_response(e: Shed) -> web.Response:
+    """The fast-fail shed answer: 429, machine-readable ``SHED`` body,
+    Retry-After so clients back off instead of retrying hot."""
+    return web.json_response(
+        {"error": "SHED", "class": e.klass, "reason": e.reason},
+        status=429,
+        headers={"Retry-After": str(max(1, round(e.retry_after_s)))},
+    )
 
 
 def _hostname_of(host: str) -> str:

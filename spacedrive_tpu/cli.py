@@ -350,6 +350,53 @@ async def cmd_mesh_status(args: argparse.Namespace) -> int:
         return 0
 
 
+async def cmd_serve_status(args: argparse.Namespace) -> int:
+    """Serve-layer posture: admission-gate mode, per-class
+    inflight/queued/shed counts, and read-cache occupancy. With --url,
+    reads a running node's rspc telemetry.serve; otherwise boots an
+    ephemeral node and reports its (idle) gate state."""
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/rspc/telemetry.serve"
+        req = urllib.request.Request(
+            url, data=b"{}", headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+
+        def post() -> str:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.read().decode()
+
+        try:
+            doc = await asyncio.to_thread(post)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"serve-status: cannot reach {url}: {e}", file=sys.stderr)
+            print("is a node running? start one with `sdx serve`",
+                  file=sys.stderr)
+            return 1
+        _write_or_print(
+            json.dumps(json.loads(doc).get("result"), indent=2), args.out
+        )
+        return 0
+
+    from .node import Node
+    from .serve import runtime_for
+
+    node = Node(args.data_dir, use_device=False, with_labeler=False)
+    try:
+        serve = runtime_for(node)
+        doc = (
+            {"enabled": False} if serve is None
+            else {"enabled": True, **serve.snapshot()}
+        )
+        _write_or_print(json.dumps(doc, indent=2, default=str), args.out)
+        return 0
+    finally:
+        await node.shutdown()
+
+
 def cmd_crypto(args: argparse.Namespace) -> int:
     from .crypto import FileHeader, decrypt_file, encrypt_file
 
@@ -783,6 +830,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="discovery settle time (ephemeral-node mode)")
     ms.add_argument("--out", help="write JSON here instead of stdout")
 
+    ss = sub.add_parser(
+        "serve-status",
+        help="serve-layer posture: admission-gate mode, per-class "
+             "inflight/shed counts, read-cache occupancy",
+    )
+    ss.add_argument("--url", default=None,
+                    help="read a running node's rspc telemetry.serve "
+                         "instead of booting an ephemeral node")
+    ss.add_argument("--out", help="write JSON here instead of stdout")
+
     dk = sub.add_parser(
         "desktop",
         help="managed desktop host: single instance, browser UI, "
@@ -842,6 +899,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_debug_bundle(args)
     if args.cmd == "mesh-status":
         return asyncio.run(cmd_mesh_status(args))
+    if args.cmd == "serve-status":
+        return asyncio.run(cmd_serve_status(args))
     if args.cmd == "desktop":
         from . import desktop
 

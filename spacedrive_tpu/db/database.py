@@ -103,11 +103,28 @@ class LibraryDb:
             with self._conn:
                 self._conn.executemany(sql, seq)
 
+    @staticmethod
+    def _maybe_slow() -> None:
+        """`db.slow` fault point: one `is None` check in production; an
+        armed `stall` spec sleeps delay_s per read — the deterministic
+        stand-in for a slow/contended disk that the serve layer's
+        overload chaos tests (and bench_serve.py's throttled arm) put
+        under the whole read surface."""
+        from ..utils import faults as _faults
+
+        spec = _faults.hit("db.slow")
+        if spec is not None:
+            import time
+
+            time.sleep(spec.delay_s)
+
     def query(self, sql: str, params: Sequence | dict = ()) -> list[dict[str, Any]]:
+        self._maybe_slow()
         with self._lock:
             return self._conn.execute(sql, params).fetchall()
 
     def query_one(self, sql: str, params: Sequence | dict = ()) -> dict[str, Any] | None:
+        self._maybe_slow()
         with self._lock:
             return self._conn.execute(sql, params).fetchone()
 

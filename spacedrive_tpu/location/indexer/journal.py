@@ -264,6 +264,90 @@ class IndexJournal:
             self._loc_count(location_id, "invalidated")
         return INVALIDATED, entry
 
+    #: keys per batched consult query — 3 bind params per key must stay
+    #: under SQLite's default 999-variable limit with headroom
+    CONSULT_CHUNK = 300
+
+    def consult_many(
+        self,
+        location_id: int,
+        items: list[tuple[Key, Identity | None]],
+        count_invalidated: bool = True,
+        count: bool = True,
+    ) -> dict[Key, tuple[str, JournalEntry | None]]:
+        """Batched :meth:`lookup`: one row-value ``IN`` query per
+        ~:data:`CONSULT_CHUNK` keys instead of one SELECT per file —
+        the per-entry-SQL floor of mesh shard execution (ROADMAP PR 9
+        follow-up). Verdict semantics and counter discipline are
+        IDENTICAL to per-key lookup (parity-tested in
+        tests/test_serve.py), including the corrupt-row drop."""
+        out: dict[Key, tuple[str, JournalEntry | None]] = {}
+        if not items:
+            return out
+        if not enabled():
+            for key, _ident in items:
+                if count:
+                    _tm.INDEX_JOURNAL_OPS.inc(result="bypassed")
+                    self._loc_count(location_id, "bypassed")
+                out[key] = (BYPASSED, None)
+            return out
+        rows_by_key: dict[Key, dict] = {}
+        try:
+            for start in range(0, len(items), self.CONSULT_CHUNK):
+                chunk = items[start:start + self.CONSULT_CHUNK]
+                placeholders = ",".join("(?,?,?)" for _ in chunk)
+                params: list[Any] = [location_id]
+                for (mat, name, ext), _ident in chunk:
+                    params.extend((mat, name, ext))
+                for row in self.db.query(
+                    "SELECT * FROM index_journal WHERE location_id = ? "
+                    "AND (materialized_path, name, extension) IN "
+                    f"(VALUES {placeholders})",
+                    params,
+                ):
+                    rows_by_key[(
+                        row["materialized_path"], row["name"],
+                        row["extension"],
+                    )] = row
+        except sqlite3.Error:
+            for key, _ident in items:
+                if count:
+                    _tm.INDEX_JOURNAL_OPS.inc(result="bypassed")
+                    self._loc_count(location_id, "bypassed")
+                out[key] = (BYPASSED, None)
+            return out
+        for key, identity in items:
+            row = rows_by_key.get(key)
+            if row is None:
+                if count:
+                    _tm.INDEX_JOURNAL_OPS.inc(result="miss")
+                    self._loc_count(location_id, "misses")
+                out[key] = (MISS, None)
+                continue
+            entry = self._entry_of(row)
+            if entry is None:
+                self._delete_key(location_id, key)
+                if count:
+                    _tm.INDEX_JOURNAL_OPS.inc(result="bypassed")
+                    self._loc_count(location_id, "bypassed")
+                out[key] = (BYPASSED, None)
+                continue
+            if (
+                not entry.stale
+                and identity is not None
+                and entry.identity == identity
+            ):
+                if count:
+                    _tm.INDEX_JOURNAL_OPS.inc(result="hit")
+                    self._loc_count(location_id, "hits")
+                out[key] = (HIT, entry)
+                continue
+            if count_invalidated and count:
+                _tm.INDEX_JOURNAL_OPS.inc(result="invalidated")
+                self._loc_count(location_id, "invalidated")
+            out[key] = (INVALIDATED, entry)
+        return out
+
     def _entry_of(self, row: dict) -> JournalEntry | None:
         payload = _decode_payload(row.get("payload"))
         if payload is None:

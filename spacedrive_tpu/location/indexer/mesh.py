@@ -131,12 +131,25 @@ def _execute_shard_sync(library: Any, location: dict, entries: list[dict],
     messages: list[bytes] = []
     msg_results: list[dict] = []  # result dicts awaiting a cas
     to_record: list[tuple] = []   # journal vouches, written post-commit
+    # stat pass first, then ONE batched journal consult for the whole
+    # shard — the per-file SELECT was the GIL-bound floor ROADMAP PR 9
+    # called out (128-entry shard = 128 round-trips into SQLite)
+    stats: list[tuple[dict, "_journal.Identity | None"]] = []
     for e in entries:
+        row = {"materialized_path": e["mat"], "name": e["name"],
+               "extension": e["ext"], "is_dir": False}
+        full = full_path_from_db_row(loc_path, row)
+        stats.append((e, _journal.stat_identity(full)))
+    consults = journal.consult_many(loc_id, [
+        ((e["mat"], e["name"], e["ext"]), ident)
+        for e, ident in stats
+        if ident is not None and ident.size > 0
+    ])
+    for e, ident in stats:
         key = (e["mat"], e["name"], e["ext"])
         row = {"materialized_path": e["mat"], "name": e["name"],
                "extension": e["ext"], "is_dir": False}
         full = full_path_from_db_row(loc_path, row)
-        ident = _journal.stat_identity(full)
         result = {
             "pub_id": e["pub_id"], "ext": e["ext"], "cas_id": None,
             "identity": (
@@ -152,7 +165,7 @@ def _execute_shard_sync(library: Any, location: dict, entries: list[dict],
             result["cas_id"] = ""
             to_record.append((key, ident, "", None, None))
             continue
-        verdict, entry = journal.lookup(loc_id, key, ident)
+        verdict, entry = consults.get(key, (_journal.MISS, None))
         if verdict == _journal.HIT and entry.cas_id:
             result["cas_id"] = entry.cas_id
             result["chunks"] = (

@@ -83,6 +83,12 @@ class Node:
                 self.config.update(image_labeler_version=version)
         self.p2p: Any = None  # P2PManager, attached by start() when enabled
         self.http: Any = None  # ApiServer handle from start_api()
+        # the serve layer (admission gate + read-path caches): absent
+        # entirely under SD_SERVE_GATE=0, and every consumer treats a
+        # missing runtime as "take the ungated pre-serve path"
+        from ..serve import ServeRuntime, enabled as _serve_enabled
+
+        self.serve: Any = ServeRuntime() if _serve_enabled() else None
         from ..api.namespaces import mount
 
         self.router = mount()  # ref:lib.rs Node::new returns (node, router)

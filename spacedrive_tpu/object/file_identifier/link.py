@@ -54,8 +54,40 @@ def object_pub_for(library_id: Any, cas_id: str) -> bytes:
     return uuid.uuid5(OBJECT_NS, f"{library_id}:{cas_id}").bytes
 
 
+#: pub_ids per IN query — one 16-byte blob bind each; stays well under
+#: SQLite's default 999-variable limit
+_LINK_CHUNK = 400
+
+
+def _rows_by_pub(
+    db: Any, table: str, columns: str, pubs: list[bytes], batched: bool,
+) -> dict[bytes, dict]:
+    """``{pub_id: row}`` for the pubs that exist. ``batched`` fetches
+    with chunked ``IN`` queries (one per ~400 pubs); the per-file path
+    issues one ``find_one`` per pub — kept as the parity oracle
+    (tests/test_serve.py proves both modes produce identical links)."""
+    out: dict[bytes, dict] = {}
+    if not batched:
+        for pub in pubs:
+            row = db.find_one(table, pub_id=pub)
+            if row is not None:
+                out[bytes(row["pub_id"])] = row
+        return out
+    for start in range(0, len(pubs), _LINK_CHUNK):
+        chunk = pubs[start:start + _LINK_CHUNK]
+        placeholders = ",".join("?" for _ in chunk)
+        for row in db.query(
+            f"SELECT {columns} FROM {table} "
+            f"WHERE pub_id IN ({placeholders})",
+            chunk,
+        ):
+            out[bytes(row["pub_id"])] = row
+    return out
+
+
 def apply_cas_results(
     library: Any, results: list[dict], *, emit_ops: bool = True,
+    batched: bool = True,
 ) -> tuple[int, int]:
     """Apply shard results (``{"pub_id": hex, "cas_id": str, "ext":
     str}`` per file) to this replica: create deterministic objects,
@@ -85,6 +117,10 @@ def apply_cas_results(
     to_link: list[tuple[bytes, str, bytes]] = []  # (fp pub, cas, obj pub)
     new_objects: dict[bytes, int] = {}  # obj pub -> kind
     created = linked = 0
+    # normalize first, then ONE batched fetch per table (a 128-file
+    # shard used to cost 256 point SELECTs here — the other half of the
+    # per-entry-SQL floor batched alongside journal.consult_many)
+    usable: list[tuple[dict, bytes, str, bytes]] = []
     for res in results:
         cas = res.get("cas_id")
         if not cas or not isinstance(cas, str):
@@ -93,12 +129,21 @@ def apply_cas_results(
             fp_pub = bytes.fromhex(str(res["pub_id"]))
         except (KeyError, ValueError):
             continue
-        row = library.db.find_one("file_path", pub_id=fp_pub)
+        usable.append((res, fp_pub, cas, object_pub_for(lib_id, cas)))
+    fp_rows = _rows_by_pub(
+        library.db, "file_path", "pub_id, cas_id, object_id",
+        [fp for _res, fp, _cas, _obj in usable], batched,
+    )
+    obj_rows = _rows_by_pub(
+        library.db, "object", "pub_id",
+        sorted({obj for _res, _fp, _cas, obj in usable}), batched,
+    )
+    for res, fp_pub, cas, obj_pub in usable:
+        row = fp_rows.get(fp_pub)
         if row is not None and row.get("cas_id") == cas \
                 and row.get("object_id") is not None:
             continue  # already converged (duplicate completion)
-        obj_pub = object_pub_for(lib_id, cas)
-        obj_row = library.db.find_one("object", pub_id=obj_pub)
+        obj_row = obj_rows.get(obj_pub)
         if obj_row is None and obj_pub not in new_objects:
             kind = kind_for_row(
                 {"extension": res.get("ext"), "is_dir": False}
@@ -124,16 +169,27 @@ def apply_cas_results(
         return 0, 0
 
     def writes(conn):
-        obj_ids: dict[bytes, int] = {}
         for obj_pub, kind in new_objects.items():
             conn.execute(
                 "INSERT OR IGNORE INTO object (pub_id, kind, date_created) "
                 "VALUES (?,?,?)",
                 (obj_pub, kind, date_created),
             )
+        obj_ids: dict[bytes, int | None] = {}
+        if batched:
+            needed = sorted({obj_pub for _fp, _cas, obj_pub in to_link})
+            for start in range(0, len(needed), _LINK_CHUNK):
+                chunk = needed[start:start + _LINK_CHUNK]
+                placeholders = ",".join("?" for _ in chunk)
+                for r in conn.execute(
+                    "SELECT id, pub_id FROM object "
+                    f"WHERE pub_id IN ({placeholders})",
+                    chunk,
+                ).fetchall():
+                    obj_ids[bytes(r["pub_id"])] = r["id"]
         for fp_pub, cas, obj_pub in to_link:
             obj_id = obj_ids.get(obj_pub)
-            if obj_id is None:
+            if obj_id is None and obj_pub not in obj_ids:
                 r = conn.execute(
                     "SELECT id FROM object WHERE pub_id = ?", (obj_pub,)
                 ).fetchone()

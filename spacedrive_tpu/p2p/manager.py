@@ -11,6 +11,7 @@ from `NodeConfig.p2p` (port, discovery mode), advertises node metadata
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import platform
 import uuid
@@ -195,7 +196,18 @@ class P2PManager:
                     logger.debug("sync pull from %s failed: %s", peer.identity, e)
             return [], False
 
-        actor = IngestActor(lib.sync, request_ops)
+        def on_applied(lib_id=lib.id):
+            # sync-applied ops dirty this library's cached reads: the
+            # remote mutation plane can't name query keys, so the whole
+            # library tag drops (serve cache read-your-writes, remote
+            # half — the local half lives in api.invalidate)
+            from ..serve import runtime_for
+
+            serve = runtime_for(self.node)
+            if serve is not None:
+                serve.invalidate_library(lib_id, source="sync")
+
+        actor = IngestActor(lib.sync, request_ops, on_applied=on_applied)
         self.ingest_actors[lib.id] = actor
         lib.ingest = actor
 
@@ -380,6 +392,18 @@ class P2PManager:
 
     # --- inbound dispatch (ref:manager.rs stream handler) --------------
 
+    def _serve_admit(self, key: str):
+        """Sync-class admission for inbound P2P serving legs: counted on
+        the gate (so operators see replication traffic riding the same
+        budgets the read path does) but never queued or shed — the sync
+        class is protected by policy. No-op without a serve runtime."""
+        from ..serve import SYNC as _SYNC_CLASS, runtime_for
+
+        serve = runtime_for(self.node)
+        if serve is None:
+            return contextlib.nullcontext()
+        return serve.gate.admit(_SYNC_CLASS, key=key)
+
     async def _handle_stream(self, stream: Any) -> None:
         header = await Header.read(stream)
         P2P_EVENTS.emit(
@@ -422,8 +446,9 @@ class P2PManager:
                 return
             lib = self.node.libraries.get(header.library_id)
             if lib is not None:
-                with _span("p2p.sync_serve"):
-                    await respond_sync_request(stream, lib.sync)
+                async with self._serve_admit("p2p.sync_serve"):
+                    with _span("p2p.sync_serve"):
+                        await respond_sync_request(stream, lib.sync)
         elif header.type == HeaderType.FILE:
             if self.node.is_feature_enabled(BackendFeature.FILES_OVER_P2P):
                 await respond_file(stream, header.file, self.node.libraries)
@@ -440,8 +465,9 @@ class P2PManager:
             if self._is_library_member(
                 getattr(stream, "remote_identity", None)
             ):
-                with _span("p2p.telemetry_serve"):
-                    await respond_telemetry(stream, self.node)
+                async with self._serve_admit("p2p.telemetry_serve"):
+                    with _span("p2p.telemetry_serve"):
+                        await respond_telemetry(stream, self.node)
             else:
                 w = Writer(stream)
                 w.msgpack(
@@ -459,8 +485,9 @@ class P2PManager:
             ):
                 from .work import respond_work
 
-                with _span("p2p.work_serve"):
-                    await respond_work(stream, self.node, header)
+                async with self._serve_admit("p2p.work_serve"):
+                    with _span("p2p.work_serve"):
+                        await respond_work(stream, self.node, header)
             else:
                 w = Writer(stream)
                 w.msgpack(

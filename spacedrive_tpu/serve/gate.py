@@ -1,0 +1,294 @@
+"""Admission gate — per-priority-class budgets with queue-then-shed.
+
+The overload failure mode this closes: every read (explorer listing,
+thumbnail fetch, search, /mesh poll) used to go straight at per-library
+SQLite on the shared event loop, so a traffic burst or a slow disk
+queued unbounded work, the loop-lag monitor went red, and the node
+stopped answering *everything* — including the health probe that would
+have told a balancer to route around it, and the sync legs that keep
+replicas converging.
+
+The gate puts a budget in front of each priority class
+(:mod:`spacedrive_tpu.serve.policy`): control and sync always admit
+(counted, never blocked); interactive and background requests run up to
+their in-flight budget, park in a bounded FIFO with a deadline when the
+budget is full, and **shed fast-fail** (:class:`Shed` → HTTP 429 +
+``Retry-After``) beyond that. Every shed lands on the ``serve`` flight
+ring with the active trace id and bumps ``sd_gate_requests_total``.
+
+Brownout: when the event-loop-lag gauge (the existing health signal)
+crosses the degraded threshold, or sheds/queue-saturation happened
+within the hold window, :meth:`AdmissionGate.in_brownout` reports True
+— background requests shed immediately, queue deadlines shrink, and the
+read cache serves stale entries instead of shedding
+(:mod:`spacedrive_tpu.serve.cache`). Gate state rides
+``telemetry.health`` → federation snapshots → ``GET /mesh``.
+
+``SD_SERVE_GATE=0``: :meth:`admit` yields immediately with zero
+bookkeeping — the ungated path, golden-tested identical to pre-serve
+behavior.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import contextlib
+import time
+from typing import Any, AsyncIterator
+
+from ..telemetry import metrics as _tm
+from ..telemetry.events import SERVE_EVENTS
+from ..telemetry.snapshot import gauge_value
+from . import policy as _policy
+from .policy import BACKGROUND, CLASSES, ServePolicy
+
+NORMAL = "normal"
+BROWNOUT = "brownout"
+
+
+class Shed(Exception):
+    """Admission refused — answer 429/``SHED`` with Retry-After and move
+    on; the caller must NOT fall back to doing the work anyway."""
+
+    def __init__(self, klass: str, retry_after_s: float, reason: str):
+        super().__init__(f"shed {klass} request: {reason}")
+        self.klass = klass
+        self.retry_after_s = retry_after_s
+        self.reason = reason
+
+
+class _Waiter:
+    __slots__ = ("future", "enqueued_at")
+
+    def __init__(self, future: "asyncio.Future[None]") -> None:
+        self.future = future
+        self.enqueued_at = time.monotonic()
+
+
+class AdmissionGate:
+    """Per-class admission control over one node's serve surface."""
+
+    def __init__(self, policy: ServePolicy | None = None):
+        self._policy = policy
+        self.inflight: dict[str, int] = {c: 0 for c in CLASSES}
+        self._queues: dict[str, collections.deque[_Waiter]] = {
+            c: collections.deque() for c in CLASSES
+        }
+        self.admitted: dict[str, int] = {c: 0 for c in CLASSES}
+        self.shed: dict[str, int] = {c: 0 for c in CLASSES}
+        self._mode = NORMAL
+        self._brownout_until = 0.0
+
+    @property
+    def policy(self) -> ServePolicy:
+        return self._policy if self._policy is not None else _policy.policy()
+
+    # --- mode -----------------------------------------------------------
+
+    def in_brownout(self) -> bool:
+        return self._refresh_mode() == BROWNOUT
+
+    def _refresh_mode(self) -> str:
+        pol = self.policy
+        now = time.monotonic()
+        lag = gauge_value("sd_event_loop_lag_seconds")
+        saturated = False
+        for klass, budget in pol.budgets.items():
+            if not budget.sheddable:
+                continue
+            if (
+                self.inflight.get(klass, 0) >= budget.max_inflight
+                and len(self._queues[klass]) >= max(1, budget.max_queue // 2)
+            ):
+                saturated = True
+                break
+        if lag >= pol.brownout_loop_lag_s or saturated:
+            self._brownout_until = now + pol.brownout_hold_s
+        mode = BROWNOUT if now < self._brownout_until else NORMAL
+        if mode != self._mode:
+            self._mode = mode
+            _tm.GATE_MODE.set(1.0 if mode == BROWNOUT else 0.0)
+            SERVE_EVENTS.emit(
+                "mode", mode=mode, loop_lag_s=round(lag, 4),
+                saturated=saturated,
+            )
+        return mode
+
+    def _note_shed(self) -> None:
+        """A shed is itself overload evidence: extend the brownout hold
+        so the cache keeps serving stale instead of thrashing."""
+        self._brownout_until = time.monotonic() + self.policy.brownout_hold_s
+
+    # --- admission ------------------------------------------------------
+
+    @contextlib.asynccontextmanager
+    async def admit(self, klass: str, key: str = "") -> AsyncIterator[None]:
+        """Hold one slot of ``klass``'s budget for the block. Raises
+        :class:`Shed` instead of entering when the class is saturated
+        past its queue. No-op when the serve layer is disabled."""
+        if not _policy.enabled():
+            yield
+            return
+        pol = self.policy
+        budget = pol.budgets.get(klass)
+        if budget is None or klass not in self.inflight:
+            # a mistyped priority= (class_for_key returns it verbatim)
+            # degrades to background gating — never a KeyError 500
+            klass = BACKGROUND
+            budget = pol.budgets[BACKGROUND]
+        mode = self._refresh_mode()
+        if budget.sheddable and self.inflight[klass] >= budget.max_inflight:
+            await self._queue_for_slot(klass, budget, mode, key)
+        else:
+            self.inflight[klass] += 1
+        self.admitted[klass] += 1
+        # bounded-IfExp labels: the class vocabulary is fixed (CLASSES),
+        # spelled out so sdlint SD007 can prove it at the call site
+        _tm.GATE_REQUESTS.inc(
+            klass="control" if klass == "control"
+            else "sync" if klass == "sync"
+            else "background" if klass == "background"
+            else "interactive",
+            outcome="admitted")
+        _tm.GATE_INFLIGHT.set(
+            self.inflight[klass],
+            klass="control" if klass == "control"
+            else "sync" if klass == "sync"
+            else "background" if klass == "background"
+            else "interactive")
+        from ..utils.resilience import deadline_scope
+
+        try:
+            if budget.sheddable and pol.request_deadline_s:
+                with deadline_scope(pol.request_deadline_s):
+                    yield
+            else:
+                yield
+        finally:
+            self.inflight[klass] -= 1
+            self._grant_next(klass, budget)
+            _tm.GATE_INFLIGHT.set(
+                self.inflight[klass],
+                klass="control" if klass == "control"
+                else "sync" if klass == "sync"
+                else "background" if klass == "background"
+                else "interactive")
+
+    async def _queue_for_slot(
+        self, klass: str, budget: Any, mode: str, key: str
+    ) -> None:
+        """Park until a slot frees or the queue deadline passes. On
+        success the releasing request has already transferred its slot
+        (inflight stays reserved for us)."""
+        queue = self._queues[klass]
+        deadline = budget.queue_deadline_s
+        if mode == BROWNOUT:
+            # saturated (the event-loop-lag / in-flight signals said so):
+            # stop queueing and fast-fail — parking more work behind a
+            # full budget only converts future sheds into slow sheds,
+            # and the admitted stream must keep its latency bound
+            self._shed(klass, key, "brownout fast-fail")
+        if len(queue) >= budget.max_queue or deadline <= 0:
+            self._shed(klass, key, "queue full")
+        waiter = _Waiter(asyncio.get_running_loop().create_future())
+        queue.append(waiter)
+        _tm.GATE_REQUESTS.inc(
+            klass="control" if klass == "control"
+            else "sync" if klass == "sync"
+            else "background" if klass == "background"
+            else "interactive",
+            outcome="queued")
+        try:
+            await asyncio.wait_for(
+                asyncio.shield(waiter.future), timeout=deadline
+            )
+        except asyncio.CancelledError:
+            # the REQUEST died while parked (client disconnect, task
+            # teardown): the slot must not die with it
+            if waiter.future.done() and not waiter.future.cancelled():
+                # granted in the same tick we were cancelled — the
+                # releasing request already reserved inflight for us;
+                # hand the slot straight to the next waiter
+                self.inflight[klass] -= 1
+                self._grant_next(klass, budget)
+            else:
+                waiter.future.cancel()
+                with contextlib.suppress(ValueError):
+                    queue.remove(waiter)
+            raise
+        except asyncio.TimeoutError:
+            if waiter.future.done():
+                # the slot was granted in the same tick the timer fired:
+                # it is ours — proceed admitted
+                pass
+            else:
+                waiter.future.cancel()
+                with contextlib.suppress(ValueError):
+                    queue.remove(waiter)
+                self._shed(
+                    klass, key,
+                    f"queue deadline {deadline:.2f}s exceeded",
+                    queue_wait_s=time.monotonic() - waiter.enqueued_at,
+                )
+        _tm.GATE_QUEUE_SECONDS.observe(
+            time.monotonic() - waiter.enqueued_at,
+            klass="control" if klass == "control"
+            else "sync" if klass == "sync"
+            else "background" if klass == "background"
+            else "interactive",
+        )
+
+    def _grant_next(self, klass: str, budget: Any) -> None:
+        """Slot handoff on release: wake the oldest live waiter and
+        reserve the slot for it (so a burst can never overshoot the
+        budget between release and wakeup)."""
+        queue = self._queues[klass]
+        while queue and self.inflight[klass] < budget.max_inflight:
+            waiter = queue.popleft()
+            if waiter.future.done():
+                continue  # timed out / cancelled while queued
+            self.inflight[klass] += 1
+            waiter.future.set_result(None)
+            break
+
+    def _shed(self, klass: str, key: str, reason: str,
+              queue_wait_s: float = 0.0) -> None:
+        self.shed[klass] += 1
+        self._note_shed()
+        _tm.GATE_REQUESTS.inc(
+            klass="control" if klass == "control"
+            else "sync" if klass == "sync"
+            else "background" if klass == "background"
+            else "interactive",
+            outcome="shed")
+        SERVE_EVENTS.emit(
+            "shed",
+            klass=klass,
+            key=key,
+            reason=reason,
+            queue_wait_s=round(queue_wait_s, 4),
+        )
+        raise Shed(klass, self.policy.retry_after_s, reason)
+
+    # --- introspection --------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """Gate state for health / ``GET /mesh`` / ``sdx serve-status``."""
+        pol = self.policy
+        classes = {}
+        for klass in CLASSES:
+            budget = pol.budgets.get(klass)
+            classes[klass] = {
+                "inflight": self.inflight[klass],
+                "queued": len(self._queues[klass]),
+                "admitted_total": self.admitted[klass],
+                "shed_total": self.shed[klass],
+                "max_inflight": budget.max_inflight if budget else None,
+                "sheddable": budget.sheddable if budget else True,
+            }
+        return {
+            "enabled": _policy.enabled(),
+            "mode": self._refresh_mode() if _policy.enabled() else NORMAL,
+            "classes": classes,
+        }

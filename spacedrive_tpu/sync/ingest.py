@@ -80,122 +80,224 @@ def is_operation_old(sync: SyncManager, op: CRDTOperation) -> bool:
     return False
 
 
-def receive_crdt_operation(sync: SyncManager, op: CRDTOperation) -> bool:
-    """Merge clock, LWW-check, apply + store atomically; returns True if
-    the op was applied (ref:ingest.rs:120-166).
+# per-op ingest outcomes (the write-combined path finalizes them only
+# after the shared transaction committed)
+_APPLIED, _TOMBSTONE, _STALE, _GUARD = "applied", "tombstone", "stale", "guard"
 
-    A delta-guard trip (remote HLC unacceptably far in the future) now
-    rejects *that op* — counted on ``sd_hlc_delta_guard_total`` and
-    recorded on the sync flight ring — instead of poisoning the whole
-    batch: one peer with a broken clock must not stall replication from
-    everyone else. The watermark deliberately does NOT advance past a
-    guarded op (advancing to a far-future timestamp would skip that
-    peer's legitimate later ops)."""
+
+def _guard_op(sync: SyncManager, op: CRDTOperation,
+              skew: float) -> str | None:
+    """Delta-guard + fault-plane check, NO DB access — returns the
+    rejection reason when the op is refused, else None (proceed). A
+    guard trip rejects *that op* — counted and flight-recorded by
+    :func:`_finalize_op` — instead of poisoning the whole batch, and
+    the watermark deliberately does NOT advance past it."""
     from ..utils import faults as _faults
 
-    peer = peer_label(op.instance)
-    # observed skew: remote op's HLC time vs our wall clock (positive =
-    # remote ahead); sampled per op, cheap (one gauge set)
-    skew = op.timestamp.as_unix() - time.time()
-    _tm.HLC_CLOCK_SKEW.set(skew, peer=peer)
     if _faults.hit("sync.ingest") is not None:
         # "poison": this op reads as a clock-skew-burst casualty — it is
-        # rejected exactly like a real delta-guard trip (counted, on the
-        # ring, watermark NOT advanced) so the peer's later legitimate
-        # ops are re-pulled and convergence survives the injection
-        _tm.HLC_DELTA_GUARD.inc()
-        SYNC_EVENTS.emit(
-            "delta_guard",
-            peer=peer,
-            skew_seconds=round(skew, 3),
-            error="injected poisoned op",
-        )
-        return False
+        # rejected exactly like a real delta-guard trip so the peer's
+        # later legitimate ops are re-pulled and convergence survives
+        return "injected poisoned op"
     try:
         sync.clock.update(op.timestamp)
     except ClockDriftError as e:
+        return str(e)[:200]
+    return None
+
+
+def _receive_into(sync: SyncManager, op: CRDTOperation, conn) -> str:
+    """LWW-check + apply + store on the CALLER's transaction — the
+    write-combined core. No watermark/metric side effects here: a
+    rolled-back transaction must not leave the in-memory view claiming
+    ops it never stored (:func:`_finalize_op` runs post-commit)."""
+    if is_operation_old(sync, op):
+        return _STALE
+    iid = _ensure_instance_conn(sync, op.instance, conn)
+    apply_op(conn, op)
+    if op.data.kind == DELETE:
+        # Determinism under delete/update races: the row must be
+        # a pure function of the op SET, not arrival order. A
+        # delete may arrive after updates that are HLC-newer
+        # than it (which is_operation_old can't reject — kinds
+        # differ); re-applying the stored newer ops rebuilds
+        # exactly the state the other arrival order produces.
+        # (The reference resurrects-by-upsert and genuinely
+        # diverges here; found by tests/test_sync_properties.)
+        # "Newer" means the full LWW order (timestamp, instance
+        # pub_id) — a same-timestamp op from a higher instance id
+        # also supersedes this delete.
+        newer = conn.execute(
+            "SELECT co.data FROM crdt_operation co "
+            "JOIN instance i ON i.id = co.instance_id "
+            "WHERE co.model = ? AND co.record_id = ? "
+            "AND " + _LWW_NEWER_SQL +
+            " ORDER BY co.timestamp ASC, i.pub_id ASC",
+            (op.model, _record_id_blob(op.record_id),
+             int(op.timestamp), int(op.timestamp),
+             op.instance.bytes),
+        ).fetchall()
+        for row in newer:
+            raw = row["data"] if isinstance(row, dict) else row[0]
+            apply_op(conn, CRDTOperation.unpack(raw))
+    conn.execute(
+        "INSERT OR REPLACE INTO crdt_operation "
+        "(id, timestamp, model, record_id, kind, data, instance_id) "
+        "VALUES (?, ?, ?, ?, ?, ?, ?)",
+        (
+            op.id.bytes,
+            int(op.timestamp),
+            op.model,
+            _record_id_blob(op.record_id),
+            op.kind(),
+            op.pack(),
+            iid,
+        ),
+    )
+    return _TOMBSTONE if op.data.kind == DELETE else _APPLIED
+
+
+def _finalize_op(sync: SyncManager, op: CRDTOperation, outcome: str,
+                 skew: float, guard_error: str | None = None) -> None:
+    """Post-commit bookkeeping for one op: outcome counters, delta-guard
+    flight-ring events, and the watermark (which advances even for
+    rejected-old ops — they're *seen* — but never past a guard trip)."""
+    peer = peer_label(op.instance)
+    if outcome == _GUARD:
         _tm.HLC_DELTA_GUARD.inc()
         SYNC_EVENTS.emit(
             "delta_guard",
             peer=peer,
             skew_seconds=round(skew, 3),
-            error=str(e)[:200],
+            error=guard_error or "delta guard",
         )
-        return False
-
-    applied = False
-    if not is_operation_old(sync, op):
-        iid = _ensure_instance(sync, op.instance)
-        with sync.db.transaction() as conn:
-            apply_op(conn, op)
-            if op.data.kind == DELETE:
-                # Determinism under delete/update races: the row must be
-                # a pure function of the op SET, not arrival order. A
-                # delete may arrive after updates that are HLC-newer
-                # than it (which is_operation_old can't reject — kinds
-                # differ); re-applying the stored newer ops rebuilds
-                # exactly the state the other arrival order produces.
-                # (The reference resurrects-by-upsert and genuinely
-                # diverges here; found by tests/test_sync_properties.)
-                # "Newer" means the full LWW order (timestamp, instance
-                # pub_id) — a same-timestamp op from a higher instance id
-                # also supersedes this delete.
-                newer = conn.execute(
-                    "SELECT co.data FROM crdt_operation co "
-                    "JOIN instance i ON i.id = co.instance_id "
-                    "WHERE co.model = ? AND co.record_id = ? "
-                    "AND " + _LWW_NEWER_SQL +
-                    " ORDER BY co.timestamp ASC, i.pub_id ASC",
-                    (op.model, _record_id_blob(op.record_id),
-                     int(op.timestamp), int(op.timestamp),
-                     op.instance.bytes),
-                ).fetchall()
-                for row in newer:
-                    raw = row["data"] if isinstance(row, dict) else row[0]
-                    apply_op(conn, CRDTOperation.unpack(raw))
-            conn.execute(
-                "INSERT OR REPLACE INTO crdt_operation "
-                "(id, timestamp, model, record_id, kind, data, instance_id) "
-                "VALUES (?, ?, ?, ?, ?, ?, ?)",
-                (
-                    op.id.bytes,
-                    int(op.timestamp),
-                    op.model,
-                    _record_id_blob(op.record_id),
-                    op.kind(),
-                    op.pack(),
-                    iid,
-                ),
-            )
-        applied = True
-        _tm.SYNC_OPS.inc(
-            result="tombstone" if op.data.kind == DELETE else "applied"
-        )
-    else:
-        _tm.SYNC_OPS.inc(result="stale")
-
-    # watermark advances even for rejected-old ops: they're *seen*
+        return
+    _tm.SYNC_OPS.inc(
+        result="tombstone" if outcome == _TOMBSTONE
+        else "applied" if outcome == _APPLIED else "stale"
+    )
     current = sync.timestamps.get(op.instance, NTP64(0))
     if op.timestamp > current:
         sync.timestamps[op.instance] = op.timestamp
         if op.instance != sync.instance:
             _tm.SYNC_WATERMARK.set(op.timestamp.as_unix(), peer=peer)
-    return applied
 
 
-def _ensure_instance(sync: SyncManager, instance: uuid.UUID) -> int:
-    row = sync.db.find_one("instance", pub_id=instance.bytes)
+def receive_crdt_operation(sync: SyncManager, op: CRDTOperation) -> bool:
+    """Merge clock, LWW-check, apply + store atomically; returns True if
+    the op was applied (ref:ingest.rs:120-166). One op = one
+    transaction — the write-combined batch path is
+    :func:`ingest_batch`."""
+    peer = peer_label(op.instance)
+    # observed skew: remote op's HLC time vs our wall clock (positive =
+    # remote ahead); sampled per op, cheap (one gauge set)
+    skew = op.timestamp.as_unix() - time.time()
+    _tm.HLC_CLOCK_SKEW.set(skew, peer=peer)
+    guard_error = _guard_op(sync, op, skew)
+    if guard_error is not None:
+        outcome = _GUARD
+    else:
+        with sync.db.transaction() as conn:
+            outcome = _receive_into(sync, op, conn)
+    _finalize_op(sync, op, outcome, skew, guard_error)
+    return outcome in (_APPLIED, _TOMBSTONE)
+
+
+def ingest_txn_quantum() -> int:
+    """Ops coalesced per SQLite transaction by the ingest actor. 1 (the
+    historical op-per-transaction behavior) when write combining is off
+    (``SD_SYNC_WRITE_COMBINE=0``) or the serve layer is disabled
+    (``SD_SERVE_GATE=0`` reproduces pre-serve behavior exactly); else
+    the serve policy's ``sync_txn_ops`` seam (PR 8 controller-tunable)."""
+    import os
+
+    from ..serve import enabled as _serve_enabled
+    from ..serve import policy as _serve_policy
+
+    if not _serve_enabled() or os.environ.get(
+        "SD_SYNC_WRITE_COMBINE", "1"
+    ) == "0":
+        return 1
+    return max(1, int(_serve_policy().sync_txn_ops))
+
+
+def ingest_batch(
+    sync: SyncManager, ops: list[CRDTOperation], txn_ops: int | None = None,
+) -> list[bool]:
+    """Write-combined ingest: apply+store ``ops`` in chunks of
+    ``txn_ops`` per SQLite transaction instead of one transaction per
+    op, so replication keeps converging while interactive reads hammer
+    the same file. Per-op outcomes (applied/True, rejected/False) come
+    back in order; watermarks/metrics are finalized strictly AFTER each
+    chunk's commit, so a rolled-back chunk never advances the in-memory
+    view past ops that were not stored.
+
+    Failure isolation: a chunk whose shared transaction raises is
+    rolled back and retried op-per-transaction (the pre-combining
+    path), so one malformed op costs its own rejection, never its
+    neighbors'. ``sd_sync_txn_combined_total`` counts the per-op
+    transactions avoided."""
+    quantum = ingest_txn_quantum() if txn_ops is None else max(1, txn_ops)
+    results: list[bool] = []
+    for start in range(0, len(ops), quantum):
+        chunk = ops[start:start + quantum]
+        if quantum == 1 or len(chunk) == 1:
+            for op in chunk:
+                results.append(receive_crdt_operation(sync, op))
+            continue
+        metas: list[tuple[CRDTOperation, str, float, str | None]] = []
+        try:
+            with sync.db.transaction() as conn:
+                for op in chunk:
+                    peer = peer_label(op.instance)
+                    skew = op.timestamp.as_unix() - time.time()
+                    _tm.HLC_CLOCK_SKEW.set(skew, peer=peer)
+                    guard_error = _guard_op(sync, op, skew)
+                    if guard_error is not None:
+                        outcome = _GUARD
+                    else:
+                        outcome = _receive_into(sync, op, conn)
+                    metas.append((op, outcome, skew, guard_error))
+        except Exception:
+            logger.exception(
+                "write-combined ingest chunk failed; retrying per-op"
+            )
+            for op in chunk:
+                try:
+                    results.append(receive_crdt_operation(sync, op))
+                except Exception:
+                    logger.exception("op %s rejected after chunk rollback",
+                                     op.id)
+                    results.append(False)
+            continue
+        for op, outcome, skew, guard_error in metas:
+            _finalize_op(sync, op, outcome, skew, guard_error)
+            results.append(outcome in (_APPLIED, _TOMBSTONE))
+        _tm.SYNC_TXN_COMBINED.inc(len(chunk) - 1)
+    return results
+
+
+def _ensure_instance_conn(sync: SyncManager, instance: uuid.UUID,
+                          conn) -> int:
+    """Resolve (or placeholder-create) the op's originating instance row
+    on the CALLER's open transaction — opening a nested implicit
+    transaction from inside a write-combined chunk would commit the
+    outer one mid-flight. The pairing flow fills in identity/metadata
+    for placeholder rows later."""
+    row = conn.execute(
+        "SELECT id FROM instance WHERE pub_id = ?", (instance.bytes,)
+    ).fetchone()
     if row is not None:
-        return row["id"]
-    # unseen originator: record a placeholder instance row (the library
-    # pairing flow fills in identity/metadata later)
+        return row["id"] if isinstance(row, dict) else row[0]
     from ..db.database import now_iso
 
     now = now_iso()
-    return sync.db.insert(
-        "instance", pub_id=instance.bytes, identity=b"", node_id=b"",
-        node_name="", node_platform=0, last_seen=now, date_created=now,
+    cur = conn.execute(
+        "INSERT INTO instance (pub_id, identity, node_id, node_name, "
+        "node_platform, last_seen, date_created) VALUES (?,?,?,?,?,?,?)",
+        (instance.bytes, b"", b"", "", 0, now, now),
     )
+    return cur.lastrowid
 
 
 class IngestActor:
@@ -207,10 +309,15 @@ class IngestActor:
         request_ops: RequestOps,
         ops_per_request: int = OPS_PER_REQUEST,
         poll_interval: float | None = 30.0,
+        on_applied: Callable[[], Any] | None = None,
     ):
         self.sync = sync
         self.request_ops = request_ops
         self.ops_per_request = ops_per_request
+        # fired after any batch that APPLIED at least one op — the serve
+        # layer's sync-side cache invalidation hook (p2p.manager wires
+        # it to drop the library's cached reads)
+        self.on_applied = on_applied
         # anti-entropy: tick even without a notification so a lost alert
         # (peer discovered late, dropped datagram) only delays, never
         # strands, convergence; None disables (tests with loopback queues)
@@ -301,38 +408,49 @@ class IngestActor:
             if ops:
                 _tm.SYNC_INGEST_BACKLOG.set(len(ops))
                 batch_applied = batch_rejected = 0
+                quantum = ingest_txn_quantum()
                 with _span("sync.ingest"):
-                    for i, op in enumerate(ops):
-                        if i % 64 == 63:
-                            # yield: a 1000-op batch is seconds of
-                            # synchronous SQLite work — freezing the
-                            # event loop that the API, the work-stealing
-                            # plane, and the loop-lag monitor all share
+                    # write-combined: `quantum` ops share one SQLite
+                    # transaction (ingest_batch), and the loop yields
+                    # between windows — a 1000-op batch is seconds of
+                    # synchronous SQLite work that must not freeze the
+                    # event loop the API, the work-stealing plane, and
+                    # the loop-lag monitor all share
+                    window = max(64, quantum)
+                    for start in range(0, len(ops), window):
+                        if start:
                             await asyncio.sleep(0)
-                        ok = receive_crdt_operation(self.sync, op)
-                        if ok:
-                            self.applied += 1
-                            batch_applied += 1
-                        else:
-                            self.rejected += 1
-                            batch_rejected += 1
-                        # flight-record accept↔reject TRANSITIONS (not
-                        # per-op emits): the ring captures when a stream
-                        # of applies turns into rejects and vice versa
-                        if ok != self._last_op_accepted:
-                            self._last_op_accepted = ok
+                        chunk = ops[start:start + window]
+                        outcomes = ingest_batch(
+                            self.sync, chunk, txn_ops=quantum
+                        )
+                        for i, (op, ok) in enumerate(
+                            zip(chunk, outcomes), start=start
+                        ):
                             if ok:
-                                SYNC_EVENTS.emit(
-                                    "accept_resume",
-                                    peer=peer_label(op.instance),
-                                    batch_index=i,
-                                )
+                                self.applied += 1
+                                batch_applied += 1
                             else:
-                                SYNC_EVENTS.emit(
-                                    "reject_start",
-                                    peer=peer_label(op.instance),
-                                    batch_index=i,
-                                )
+                                self.rejected += 1
+                                batch_rejected += 1
+                            # flight-record accept↔reject TRANSITIONS
+                            # (not per-op emits): the ring captures when
+                            # a stream of applies turns into rejects and
+                            # vice versa
+                            if ok != self._last_op_accepted:
+                                self._last_op_accepted = ok
+                                if ok:
+                                    SYNC_EVENTS.emit(
+                                        "accept_resume",
+                                        peer=peer_label(op.instance),
+                                        batch_index=i,
+                                    )
+                                else:
+                                    SYNC_EVENTS.emit(
+                                        "reject_start",
+                                        peer=peer_label(op.instance),
+                                        batch_index=i,
+                                    )
                 _tm.SYNC_INGEST_BACKLOG.set(0)
                 SYNC_EVENTS.emit(
                     "ingest_batch",
@@ -341,6 +459,11 @@ class IngestActor:
                     has_more=bool(has_more),
                 )
                 self.sync.observe_replication_lag()
+                if batch_applied and self.on_applied is not None:
+                    try:
+                        self.on_applied()
+                    except Exception:  # noqa: BLE001 - invalidation is best-effort
+                        logger.exception("ingest on_applied hook failed")
             if ops and self.sync.event_bus is not None:
                 self.sync.event_bus.emit(("SyncMessage", "Ingested"))
             if not has_more:

@@ -107,6 +107,7 @@ FAULT_EVENTS = ring("faults")      # injected-fault activations (utils/faults)
 RESILIENCE_EVENTS = ring("resilience")  # retries, breaker transitions, demotions
 AUTOTUNE_EVENTS = ring("autotune")  # closed-loop tuning decisions (w/ trace_id)
 WORK_EVENTS = ring("work")         # mesh work-stealing: publishes, leases, steals, expiries
+SERVE_EVENTS = ring("serve")       # admission gate: sheds (w/ trace_id), mode transitions
 
 
 def record_error(source: str, exc: BaseException | None,

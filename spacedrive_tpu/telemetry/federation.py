@@ -90,7 +90,25 @@ def _ring_digests() -> dict[str, Any]:
 def local_snapshot(node: Any = None) -> dict[str, Any]:
     """The compact, versioned self-snapshot a node serves to the mesh
     (P2P TELEMETRY responder, relay push, and the ``local`` half of
-    ``GET /mesh``)."""
+    ``GET /mesh``). With a serve runtime the computation rides a short
+    TTL cache: it walks every metric family, refreshes per-peer lag
+    gauges, and runs the journal's ``location_stats()`` — dashboard
+    polls and TELEMETRY responders inside one window cost ONE
+    computation instead of N (treat the returned dict as read-only)."""
+    if node is not None:
+        from ..serve import runtime_for
+
+        serve = runtime_for(node)
+        if serve is not None:
+            return serve.meta.get_sync(
+                ("local_snapshot",),
+                lambda: _local_snapshot(node),
+                ttl_s=serve.policy.snapshot_ttl_s,
+            )
+    return _local_snapshot(node)
+
+
+def _local_snapshot(node: Any = None) -> dict[str, Any]:
     from . import health as _health
 
     snap: dict[str, Any] = {
@@ -297,3 +315,32 @@ def mesh_status(node: Any) -> dict[str, Any]:
         "local": local_snapshot(node),
         "mesh": cache.mesh() if cache is not None else {"peers": {}},
     }
+
+
+async def mesh_status_cached(
+    node: Any, *, refresh: bool = True, force: bool = False,
+) -> dict[str, Any]:
+    """``GET /mesh`` / rspc ``telemetry.mesh`` read path: the federation
+    refresh + snapshot computation behind the serve cache's
+    single-flight, so N concurrent dashboards cost one refresh round
+    and one ``mesh_status`` walk per TTL window. ``force`` coalesces
+    concurrent callers but never serves a stored view; without a serve
+    runtime this is exactly the pre-serve direct path."""
+    from ..serve import runtime_for
+
+    async def load() -> dict[str, Any]:
+        p2p = getattr(node, "p2p", None)
+        if p2p is not None and refresh:
+            await p2p.refresh_federation(force=force)
+        return mesh_status(node)
+
+    serve = runtime_for(node)
+    if serve is None:
+        return await load()
+    result = await serve.meta.get(
+        ("mesh", bool(refresh), bool(force)),
+        load,
+        ttl_s=0.0 if force else serve.policy.mesh_ttl_s,
+        stale_ok=serve.gate.in_brownout(),
+    )
+    return result.value

@@ -256,6 +256,41 @@ def _sync(node: Any = None) -> dict[str, Any]:
     return _verdict(HEALTHY, **signals)
 
 
+def _serve(node: Any = None) -> dict[str, Any]:
+    """Serve layer: the admission gate's overload posture. Brownout or
+    active interactive shedding is degraded — the node still answers,
+    but it is refusing work and serving stale cache entries. A shed in
+    the control or sync class is UNHEALTHY: those classes must never
+    shed (the gate's own contract), so a nonzero count is a serve-layer
+    bug an operator must see."""
+    from ..serve import runtime_for
+
+    serve = runtime_for(node) if node is not None else None
+    if serve is None:
+        return _verdict(UNKNOWN, "serve gate disabled or absent")
+    snap = serve.gate.snapshot()
+    classes = snap["classes"]
+    protected_shed = sum(
+        c["shed_total"] for k, c in classes.items()
+        if not c.get("sheddable", True)
+    )
+    signals = {
+        "mode": snap["mode"],
+        "classes": classes,
+        "caches": serve.snapshot()["caches"],
+    }
+    if protected_shed:
+        return _verdict(
+            UNHEALTHY,
+            f"{protected_shed} control/sync request(s) shed — protected "
+            "classes must never shed",
+            **signals,
+        )
+    if snap["mode"] == "brownout":
+        return _verdict(DEGRADED, "read path in brownout", **signals)
+    return _verdict(HEALTHY, **signals)
+
+
 def evaluate(node: Any = None) -> dict[str, Any]:
     """The full health rollup: per-subsystem verdicts plus the overall
     status (worst subsystem; ``unknown`` counts as healthy)."""
@@ -266,6 +301,7 @@ def evaluate(node: Any = None) -> dict[str, Any]:
         "p2p": _p2p(),
         "sync": _sync(node),
         "resilience": _resilience(),
+        "serve": _serve(node),
     }
     overall = HEALTHY
     for v in subsystems.values():

@@ -347,6 +347,56 @@ AUTOTUNE_DEPTH_EXTRA = REGISTRY.gauge(
     labels=("workload",),
 )
 
+# --- serve layer: admission gate + read cache (spacedrive_tpu/serve/) -------
+
+GATE_REQUESTS = REGISTRY.counter(
+    "sd_gate_requests_total",
+    "admission-gate outcomes per priority class: admitted (ran), "
+    "queued (parked for a slot before running), shed (fast-failed "
+    "429/SHED)",
+    labels=("klass", "outcome"),  # control|sync|interactive|background
+)
+GATE_INFLIGHT = REGISTRY.gauge(
+    "sd_gate_inflight",
+    "requests currently holding an admission slot, per priority class",
+    labels=("klass",),
+)
+GATE_QUEUE_SECONDS = REGISTRY.histogram(
+    "sd_gate_queue_seconds",
+    "time a request spent parked waiting for an admission slot",
+    labels=("klass",),
+)
+GATE_MODE = REGISTRY.gauge(
+    "sd_gate_mode",
+    "serve-gate mode: 0 = normal, 1 = brownout (degraded serving — "
+    "stale cache answers allowed, background sheds immediately)",
+)
+SERVE_CACHE_OPS = REGISTRY.counter(
+    "sd_serve_cache_ops_total",
+    "read-path cache outcomes per region: hit, miss (loaded), stale "
+    "(brownout stale-while-revalidate answer), coalesced (rode another "
+    "caller's in-flight load), bypass",
+    labels=("cache", "result"),  # query|thumb|meta
+)
+SERVE_CACHE_ENTRIES = REGISTRY.gauge(
+    "sd_serve_cache_entries",
+    "live entries per cache region",
+    labels=("cache",),
+)
+SERVE_CACHE_INVALIDATIONS = REGISTRY.counter(
+    "sd_serve_cache_invalidations_total",
+    "cache entries dropped by the invalidation plane, by trigger: "
+    "local (mutation via invalidate_query) or sync (remote ops applied "
+    "by the ingest actor)",
+    labels=("source",),  # local | sync
+)
+SYNC_TXN_COMBINED = REGISTRY.counter(
+    "sd_sync_txn_combined_total",
+    "per-op SQLite transactions avoided by write-combined sync ingest "
+    "(ops coalesced into a shared transaction, minus the one "
+    "transaction that carried them)",
+)
+
 # --- event loop health (telemetry/events.py LoopLagMonitor) -----------------
 
 EVENT_LOOP_LAG = REGISTRY.gauge(

@@ -86,6 +86,12 @@ FAULT_POINTS: dict[str, tuple[str, tuple[str, ...]]] = {
         "cloud relay HTTP surface (cloud/relay middleware)",
         ("500", "timeout", "truncate"),
     ),
+    "db.slow": (
+        "library SQLite read path (db/database.LibraryDb.query/"
+        "query_one) — `stall` sleeps delay_s per read, simulating a "
+        "slow/contended disk under the whole serve surface",
+        ("stall",),
+    ),
     "sync.ingest": (
         "remote op ingest (sync/ingest.receive_crdt_operation)",
         ("poison",),

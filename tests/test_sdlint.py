@@ -1139,6 +1139,137 @@ def test_sd014_exempts_defining_modules(tmp_path):
     ) == []
 
 
+# --- SD015 ungated-handler --------------------------------------------------
+
+
+def run_tree(tmp_path, files, rules=None):
+    """Multi-file fixture tree (SD015 is a project rule: it reads the
+    NAMESPACE_CLASSES coverage map out of serve/policy.py)."""
+    for relpath, source in files.items():
+        f = tmp_path / relpath
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(source))
+    findings, errors = analyze_paths([tmp_path], rules)
+    assert not errors, errors
+    return findings
+
+
+SD015_POLICY = """
+    NAMESPACE_CLASSES: dict[str, str] = {
+        "files": "interactive",
+        "telemetry": "control",
+    }
+"""
+
+
+def test_sd015_flags_bare_route_and_uncovered_namespace(tmp_path):
+    findings = run_tree(
+        tmp_path,
+        {
+            "spacedrive_tpu/serve/policy.py": SD015_POLICY,
+            "spacedrive_tpu/api/mod.py": """
+                from aiohttp import web
+
+                def routes(self):
+                    return [
+                        web.get("/bare", self._bare),
+                        self._gated(web.get("/ok", self._ok), "control"),
+                    ]
+
+                def mount(r):
+                    @r.query("newthing.list", library=True)
+                    def list_things(node, library):
+                        return []
+
+                    @r.query("files.get", library=True)
+                    def covered(node, library):
+                        return []
+            """,
+        },
+        ["SD015"],
+    )
+    assert len(findings) == 2
+    assert rules_of(findings) == ["SD015"]
+    messages = sorted(f.message for f in findings)
+    assert "web.get" in messages[0] or "_gated" in messages[0]
+    assert any("newthing" in m for m in messages)
+
+
+def test_sd015_nonliteral_key_requires_priority(tmp_path):
+    findings = run_tree(
+        tmp_path,
+        {
+            "spacedrive_tpu/serve/policy.py": SD015_POLICY,
+            "spacedrive_tpu/api/mod.py": """
+                def mount(r, ns):
+                    @r.query(f"{ns}.list", library=True)
+                    def list_all(node, library):
+                        return []
+
+                    @r.mutation(f"{ns}.create", library=True,
+                                priority="interactive")
+                    def create(node, library, arg):
+                        return None
+            """,
+        },
+        ["SD015"],
+    )
+    assert len(findings) == 1
+    assert "non-literal" in findings[0].message
+
+
+def test_sd015_silent_on_clean_api_module(tmp_path):
+    findings = run_tree(
+        tmp_path,
+        {
+            "spacedrive_tpu/serve/policy.py": SD015_POLICY,
+            "spacedrive_tpu/api/mod.py": """
+                from aiohttp import web
+
+                def routes(self):
+                    return [
+                        self._gated(web.get("/x", self._x), "interactive"),
+                        self._gated(web.post("/y", self._y), "background"),
+                    ]
+
+                def mount(r):
+                    @r.query("telemetry.snapshot")
+                    def snapshot(node):
+                        return {}
+
+                    @r.subscription("files.changes", library=True)
+                    def changes(node, library):
+                        return None
+
+                def unrelated(db, sql):
+                    # same attr names OUTSIDE decorator position: not
+                    # registrations (the db.query(...) shape)
+                    return db.query(sql)
+            """,
+        },
+        ["SD015"],
+    )
+    assert findings == []
+
+
+def test_sd015_out_of_scope_modules_ignored(tmp_path):
+    # route defs outside spacedrive_tpu/api/ (e.g. a test harness) are
+    # not this rule's business
+    findings = run_tree(
+        tmp_path,
+        {
+            "spacedrive_tpu/desktop_helper.py": """
+                from aiohttp import web
+
+                def routes(h):
+                    return [web.get("/internal", h)]
+            """,
+        },
+        ["SD015"],
+    )
+    assert findings == []
+
+
 # --- the gate (same entry point as `make lint` / CI) -----------------------
 
 

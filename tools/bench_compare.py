@@ -191,6 +191,67 @@ def compare_e2e(old: dict[str, Any], new: dict[str, Any],
 AUTOTUNE_THROTTLED_MIN = 1.3
 AUTOTUNE_CLEAN_MIN = 0.95
 
+# bench_serve.py's graceful-degradation bars (mirrored there; this gate
+# re-derives every figure from the recorded arm rates)
+SERVE_P99_RATIO_MAX = 5.0
+SERVE_GOODPUT_MIN = 0.7
+SERVE_SHED_P99_MAX_S = 1.0
+
+
+def check_serve(doc: dict[str, Any]) -> dict[str, Any]:
+    """Gate a BENCH_SERVE document (same result shape as compare()).
+    Lower-is-better bars (p99 ratio, shed p99) record delta as the
+    margin below the bar; higher-is-better (goodput) as margin above."""
+    checked: list[dict[str, Any]] = []
+    regressions: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    for leg_name in ("clean", "throttled"):
+        leg = doc.get(leg_name)
+        if not isinstance(leg, dict):
+            skipped.append(f"serve.{leg_name}: leg missing")
+            continue
+        unloaded = (leg.get("unloaded") or {}).get("admitted_p99_ms")
+        over = (leg.get("overload") or {}).get("admitted_p99_ms")
+        cap = (leg.get("capacity") or {}).get("admitted_rps")
+        good = (leg.get("overload") or {}).get("admitted_rps")
+        bars = [
+            # (name, value, bar, higher_is_better)
+            ("p99_ratio",
+             (over / unloaded) if unloaded and over is not None else None,
+             SERVE_P99_RATIO_MAX, False),
+            ("goodput_ratio",
+             (good / cap) if cap and good is not None else None,
+             SERVE_GOODPUT_MIN, True),
+            ("shed_p99_s", leg.get("shed_p99_s"),
+             SERVE_SHED_P99_MAX_S, False),
+        ]
+        for name, value, bar, higher in bars:
+            full = f"serve.{leg_name}.{name}"
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                skipped.append(f"{full}: not recorded")
+                continue
+            margin = (value - bar) if higher else (bar - value)
+            rec = {"name": full, "old": bar, "new": round(float(value), 3),
+                   "delta_pct": round(margin * 100, 2)}
+            checked.append(rec)
+            if margin < 0:
+                regressions.append(rec)
+        protected = (leg.get("overload") or {})
+        answered = protected.get("health_answered")
+        total = protected.get("health_total")
+        bad = (
+            protected.get("control_shed", 0) or protected.get("sync_shed", 0)
+            or (total is not None and answered != total)
+        )
+        rec = {"name": f"serve.{leg_name}.protected_classes",
+               "old": 0, "new": 1 if bad else 0,
+               "delta_pct": -100.0 if bad else 0.0}
+        checked.append(rec)
+        if bad:
+            regressions.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "skipped": skipped}
+
 
 def check_autotune(doc: dict[str, Any]) -> dict[str, Any]:
     """Gate a BENCH_AUTOTUNE document (same result shape as compare():
@@ -304,6 +365,19 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
             result = check_autotune(at_doc)
             render("BENCH_AUTOTUNE.json (absolute adaptive-vs-static bars)",
+                   result)
+            total_regressions += len(result["regressions"])
+        sv_path = os.path.join(args.dir, "BENCH_SERVE.json")
+        if os.path.exists(sv_path):
+            try:
+                with open(sv_path) as f:
+                    sv_doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"bench-compare: cannot read BENCH_SERVE JSON: {e}",
+                      file=sys.stderr)
+                return 2
+            result = check_serve(sv_doc)
+            render("BENCH_SERVE.json (absolute graceful-degradation bars)",
                    result)
             total_regressions += len(result["regressions"])
 

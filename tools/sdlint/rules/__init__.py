@@ -8,3 +8,4 @@ from . import resiliencerules  # noqa: F401  SD011
 from . import journalrules  # noqa: F401  SD012
 from . import autotunerules  # noqa: F401  SD013
 from . import p2prules  # noqa: F401  SD014
+from . import serverules  # noqa: F401  SD015

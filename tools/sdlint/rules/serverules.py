@@ -1,0 +1,145 @@
+"""Serve-layer rules.
+
+SD015  ungated-handler
+
+The overload contract (docs/robustness.md "Serving under overload") only
+holds if EVERY request path declares an admission priority class — one
+forgotten route serves ungated traffic that the budgets can neither
+count nor shed, and the node is back to pre-serve collapse behavior on
+exactly that endpoint.
+
+Two seams exist, both enforced here (project rule — the rspc half reads
+the coverage map out of ``serve/policy.py``):
+
+- **aiohttp routes** (scope ``spacedrive_tpu/api/``): every
+  ``web.get/post/…(...)`` route definition must be passed through the
+  ``_gated(route, CLASS)`` helper that registers its priority class for
+  the admission middleware. A bare route def is a finding.
+- **rspc registrations**: every ``@r.query/mutation/subscription("ns.key")``
+  decorator must either name a namespace covered by
+  ``serve.policy.NAMESPACE_CLASSES`` or carry an explicit
+  ``priority=`` keyword. Non-literal keys (f-strings) can't be resolved
+  statically, so they must always carry ``priority=``.
+
+Only decorator-position calls count as registrations — ``db.query(sql)``
+and other same-named method calls are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, ProjectContext, call_name, dotted_name, rule
+
+#: path fragments this rule governs (posix-style, as analyze_paths sees)
+SCOPED_FRAGMENTS = ("spacedrive_tpu/api/",)
+
+_ROUTE_CALLS = {
+    "web.get", "web.post", "web.put", "web.delete", "web.patch",
+    "web.head", "web.route", "web.static", "web.view",
+}
+_REGISTER_ATTRS = {"query", "mutation", "subscription"}
+
+
+def _in_scope(path: str) -> bool:
+    return any(frag in path for frag in SCOPED_FRAGMENTS)
+
+
+def _namespace_classes(project: ProjectContext) -> set[str] | None:
+    """Keys of the literal ``NAMESPACE_CLASSES = {...}`` dict (normally
+    in serve/policy.py). None when absent from the analyzed set — the
+    rspc half then requires explicit ``priority=`` everywhere, which is
+    what a fixture tree without a policy module should see."""
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "NAMESPACE_CLASSES"
+                for t in targets
+            ):
+                continue
+            if isinstance(node.value, ast.Dict):
+                return {
+                    k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                }
+    return None
+
+
+def _has_priority_kwarg(call: ast.Call) -> bool:
+    return any(kw.arg == "priority" for kw in call.keywords)
+
+
+@rule(
+    "SD015",
+    "ungated-handler",
+    "aiohttp route / rspc procedure registered without an admission "
+    "priority class — route aiohttp defs through the _gated(route, "
+    "CLASS) seam, and give rspc registrations a namespace covered by "
+    "serve.policy.NAMESPACE_CLASSES or an explicit priority= kwarg",
+    project=True,
+)
+def check_ungated_handler(project: ProjectContext) -> Iterator[Finding]:
+    classes = _namespace_classes(project)
+    for ctx in project.files:
+        if not _in_scope(ctx.path):
+            continue
+        # --- aiohttp half: every route def rides the _gated seam ------
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in _ROUTE_CALLS:
+                continue
+            parent = ctx.parents.get(node)
+            wrapper = dotted_name(parent.func) if isinstance(
+                parent, ast.Call) else None
+            if wrapper is not None and wrapper.split(".")[-1].endswith(
+                    "gated"):
+                continue
+            yield ctx.finding(
+                "SD015",
+                node,
+                f"`{call_name(node)}(...)` route is not passed through "
+                "the `_gated(route, CLASS)` seam — the admission "
+                "middleware cannot classify (or shed) it",
+            )
+        # --- rspc half: decorator-position registrations --------------
+        for fn in ctx.functions:
+            for deco in fn.node.decorator_list:
+                if not isinstance(deco, ast.Call):
+                    continue
+                if not (
+                    isinstance(deco.func, ast.Attribute)
+                    and deco.func.attr in _REGISTER_ATTRS
+                ):
+                    continue
+                if _has_priority_kwarg(deco):
+                    continue
+                key_arg = deco.args[0] if deco.args else None
+                if isinstance(key_arg, ast.Constant) and isinstance(
+                        key_arg.value, str):
+                    key = key_arg.value
+                    ns = key.split(".", 1)[0] if "." in key else key
+                    if classes is not None and ns in classes:
+                        continue
+                    yield ctx.finding(
+                        "SD015",
+                        deco,
+                        f"rspc registration {key!r}: namespace {ns!r} is "
+                        "not covered by serve.policy.NAMESPACE_CLASSES — "
+                        "add it there or pass an explicit priority=",
+                    )
+                else:
+                    yield ctx.finding(
+                        "SD015",
+                        deco,
+                        "rspc registration with a non-literal key cannot "
+                        "be classified statically — pass an explicit "
+                        "priority= kwarg",
+                    )
