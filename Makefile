@@ -6,6 +6,7 @@ PY ?= python
 .PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check \
 	bench-warm bench-autotune bench-mesh bench-serve chaos
 
+# set SDLINT_ANNOTATE=1 in CI for GitHub ::error annotations on the diff
 lint:
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
 
@@ -76,8 +77,9 @@ bench-serve:
 # AND (when BENCH_E2E_prev.json exists) the previous → current
 # BENCH_E2E per-config rates incl. the warm-pass metrics; fail on a
 # >15% regression in any comparable throughput series (link-bound e2e
-# rates are excused on blocked/congested runs)
-bench-check:
+# rates are excused on blocked/congested runs). Depends on `lint` so
+# perf gating and lint gating ride one CI target.
+bench-check: lint
 	$(PY) tools/bench_compare.py --dir .
 
 # observability smoke: boot a node, index, assert /metrics + /trace +

@@ -166,11 +166,15 @@ class DuplicateDetectorJob(StatefulJob):
             ctx.library.db.executemany(
                 "UPDATE object SET phash = ? WHERE id = ?", updates
             )
-        # journal writes ordered after the phash rows committed
-        for row, ph in hashed_pairs:
-            journal.record_phash(
-                row["location_id"], _journal.key_of(row), row["cas_id"], ph
-            )
+            # journal writes ordered after the phash rows committed —
+            # inside the `if updates:` guard so the commit provably
+            # dominates the vouch (hashed_pairs ⊆ updates, so this
+            # moves no work; sdlint SD017 checks the dominance)
+            for row, ph in hashed_pairs:
+                journal.record_phash(
+                    row["location_id"], _journal.key_of(row),
+                    row["cas_id"], ph
+                )
         self.run_metadata["hashed"] += len(ok)
         self.run_metadata["reused"] = self.run_metadata.get("reused", 0) + len(reused)
         self.run_metadata["skipped"] += skipped

@@ -79,8 +79,11 @@ class P2P:
     async def _on_stream(self, stream: EncryptedStream) -> None:
         peer = self.touch_peer(stream.remote_identity)
         peer.active_connections += 1
-        self.events.emit(("PeerConnected", stream.remote_identity))
         try:
+            # inside the try: a raising event subscriber must not leave
+            # active_connections inflated forever (sdlint SD016) — and
+            # the Connected/Disconnected pairing survives it
+            self.events.emit(("PeerConnected", stream.remote_identity))
             if self._handler is not None:
                 await self._handler(stream)
         finally:

@@ -521,8 +521,12 @@ class RelayServer:
         # from here the reservation is THIS handler's to release
         self.stats.pipes_opened += 1
         self.stats.pipes_active += 1
-        self._pipes.update((dial_w, writer))
         try:
+            # inside the try (sdlint SD016): any failure past this
+            # point — including registering the pipe pair — must run
+            # the finally, or pipes_active overcounts forever and the
+            # reservation never releases
+            self._pipes.update((dial_w, writer))
             write_frame(writer, {"ok": True})
             write_frame(dial_w, {"ok": True})
             await writer.drain()

@@ -130,6 +130,8 @@ class AdmissionGate:
         if not _policy.enabled():
             yield
             return
+        from ..utils.resilience import deadline_scope
+
         pol = self.policy
         budget = pol.budgets.get(klass)
         if budget is None or klass not in self.inflight:
@@ -138,28 +140,45 @@ class AdmissionGate:
             klass = BACKGROUND
             budget = pol.budgets[BACKGROUND]
         mode = self._refresh_mode()
+        queue_wait_s = None
         if budget.sheddable and self.inflight[klass] >= budget.max_inflight:
-            await self._queue_for_slot(klass, budget, mode, key)
+            queue_wait_s = await self._queue_for_slot(klass, budget, mode, key)
         else:
             self.inflight[klass] += 1
-        self.admitted[klass] += 1
-        # bounded-IfExp labels: the class vocabulary is fixed (CLASSES),
-        # spelled out so sdlint SD007 can prove it at the call site
-        _tm.GATE_REQUESTS.inc(
-            klass="control" if klass == "control"
-            else "sync" if klass == "sync"
-            else "background" if klass == "background"
-            else "interactive",
-            outcome="admitted")
-        _tm.GATE_INFLIGHT.set(
-            self.inflight[klass],
-            klass="control" if klass == "control"
-            else "sync" if klass == "sync"
-            else "background" if klass == "background"
-            else "interactive")
-        from ..utils.resilience import deadline_scope
-
+        # from here the slot is HELD (counted here or reserved for us by
+        # the releasing request's _grant_next) — every statement that can
+        # raise, the admission bookkeeping included, lives inside the
+        # try so the finally always gives the slot back; a metric-
+        # registry error between acquire and try used to permanently
+        # shrink the class budget (sdlint SD016)
         try:
+            self.admitted[klass] += 1
+            # bounded-IfExp labels: the class vocabulary is fixed
+            # (CLASSES), spelled out so sdlint SD007 can prove it at
+            # the call site
+            _tm.GATE_REQUESTS.inc(
+                klass="control" if klass == "control"
+                else "sync" if klass == "sync"
+                else "background" if klass == "background"
+                else "interactive",
+                outcome="admitted")
+            _tm.GATE_INFLIGHT.set(
+                self.inflight[klass],
+                klass="control" if klass == "control"
+                else "sync" if klass == "sync"
+                else "background" if klass == "background"
+                else "interactive")
+            if queue_wait_s is not None:
+                # observed HERE, with the slot protected by the finally
+                # — inside _queue_for_slot a failing observe would leak
+                # the just-granted slot
+                _tm.GATE_QUEUE_SECONDS.observe(
+                    queue_wait_s,
+                    klass="control" if klass == "control"
+                    else "sync" if klass == "sync"
+                    else "background" if klass == "background"
+                    else "interactive",
+                )
             if budget.sheddable and pol.request_deadline_s:
                 with deadline_scope(pol.request_deadline_s):
                     yield
@@ -177,10 +196,12 @@ class AdmissionGate:
 
     async def _queue_for_slot(
         self, klass: str, budget: Any, mode: str, key: str
-    ) -> None:
+    ) -> float:
         """Park until a slot frees or the queue deadline passes. On
         success the releasing request has already transferred its slot
-        (inflight stays reserved for us)."""
+        (inflight stays reserved for us); returns the queue wait in
+        seconds — recorded by the CALLER inside its try/finally, so a
+        failing metric write cannot leak the granted slot."""
         queue = self._queues[klass]
         deadline = budget.queue_deadline_s
         if mode == BROWNOUT:
@@ -193,13 +214,17 @@ class AdmissionGate:
             self._shed(klass, key, "queue full")
         waiter = _Waiter(asyncio.get_running_loop().create_future())
         queue.append(waiter)
-        _tm.GATE_REQUESTS.inc(
-            klass="control" if klass == "control"
-            else "sync" if klass == "sync"
-            else "background" if klass == "background"
-            else "interactive",
-            outcome="queued")
         try:
+            # the queued-outcome metric rides INSIDE the try: from the
+            # append on, an exception anywhere here must unregister the
+            # waiter (or pass a granted slot on) — an orphan waiter
+            # would absorb the next _grant_next and shrink the budget
+            _tm.GATE_REQUESTS.inc(
+                klass="control" if klass == "control"
+                else "sync" if klass == "sync"
+                else "background" if klass == "background"
+                else "interactive",
+                outcome="queued")
             await asyncio.wait_for(
                 asyncio.shield(waiter.future), timeout=deadline
             )
@@ -231,13 +256,19 @@ class AdmissionGate:
                     f"queue deadline {deadline:.2f}s exceeded",
                     queue_wait_s=time.monotonic() - waiter.enqueued_at,
                 )
-        _tm.GATE_QUEUE_SECONDS.observe(
-            time.monotonic() - waiter.enqueued_at,
-            klass="control" if klass == "control"
-            else "sync" if klass == "sync"
-            else "background" if klass == "background"
-            else "interactive",
-        )
+        except BaseException:
+            # anything else (a raising metric registry, a broken loop):
+            # same discipline as cancellation — never leave an orphan
+            # waiter behind for _grant_next to hand a slot to
+            if waiter.future.done() and not waiter.future.cancelled():
+                self.inflight[klass] -= 1
+                self._grant_next(klass, budget)
+            else:
+                waiter.future.cancel()
+                with contextlib.suppress(ValueError):
+                    queue.remove(waiter)
+            raise
+        return time.monotonic() - waiter.enqueued_at
 
     def _grant_next(self, klass: str, budget: Any) -> None:
         """Slot handoff on release: wake the oldest live waiter and

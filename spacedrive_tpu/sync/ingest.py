@@ -90,7 +90,7 @@ def _guard_op(sync: SyncManager, op: CRDTOperation,
     """Delta-guard + fault-plane check, NO DB access — returns the
     rejection reason when the op is refused, else None (proceed). A
     guard trip rejects *that op* — counted and flight-recorded by
-    :func:`_finalize_op` — instead of poisoning the whole batch, and
+    :func:`_finalize_guard` — instead of poisoning the whole batch, and
     the watermark deliberately does NOT advance past it."""
     from ..utils import faults as _faults
 
@@ -110,7 +110,7 @@ def _receive_into(sync: SyncManager, op: CRDTOperation, conn) -> str:
     """LWW-check + apply + store on the CALLER's transaction — the
     write-combined core. No watermark/metric side effects here: a
     rolled-back transaction must not leave the in-memory view claiming
-    ops it never stored (:func:`_finalize_op` runs post-commit)."""
+    ops it never stored (:func:`_finalize_committed` runs post-commit)."""
     if is_operation_old(sync, op):
         return _STALE
     iid = _ensure_instance_conn(sync, op.instance, conn)
@@ -157,21 +157,31 @@ def _receive_into(sync: SyncManager, op: CRDTOperation, conn) -> str:
     return _TOMBSTONE if op.data.kind == DELETE else _APPLIED
 
 
-def _finalize_op(sync: SyncManager, op: CRDTOperation, outcome: str,
-                 skew: float, guard_error: str | None = None) -> None:
-    """Post-commit bookkeeping for one op: outcome counters, delta-guard
-    flight-ring events, and the watermark (which advances even for
-    rejected-old ops — they're *seen* — but never past a guard trip)."""
+def _finalize_guard(op: CRDTOperation, skew: float,
+                    guard_error: str | None) -> None:
+    """Bookkeeping for a guard-rejected op: counted and flight-recorded,
+    and the watermark deliberately NOT advanced past it. Split from
+    :func:`_finalize_committed` because this path carries no commit to
+    vouch for — keeping them one function made every caller look like
+    it could vouch without a commit (sdlint SD017), and the guard
+    branch genuinely never may."""
+    _tm.HLC_DELTA_GUARD.inc()
+    SYNC_EVENTS.emit(
+        "delta_guard",
+        peer=peer_label(op.instance),
+        skew_seconds=round(skew, 3),
+        error=guard_error or "delta guard",
+    )
+
+
+def _finalize_committed(sync: SyncManager, op: CRDTOperation,
+                        outcome: str) -> None:
+    """Post-commit bookkeeping for one stored-or-stale op: outcome
+    counters and the watermark (which advances even for rejected-old
+    ops — they're *seen*). Callers MUST order this strictly after the
+    transaction that stored the op committed — sdlint SD017 checks the
+    dominance."""
     peer = peer_label(op.instance)
-    if outcome == _GUARD:
-        _tm.HLC_DELTA_GUARD.inc()
-        SYNC_EVENTS.emit(
-            "delta_guard",
-            peer=peer,
-            skew_seconds=round(skew, 3),
-            error=guard_error or "delta guard",
-        )
-        return
     _tm.SYNC_OPS.inc(
         result="tombstone" if outcome == _TOMBSTONE
         else "applied" if outcome == _APPLIED else "stale"
@@ -195,11 +205,11 @@ def receive_crdt_operation(sync: SyncManager, op: CRDTOperation) -> bool:
     _tm.HLC_CLOCK_SKEW.set(skew, peer=peer)
     guard_error = _guard_op(sync, op, skew)
     if guard_error is not None:
-        outcome = _GUARD
-    else:
-        with sync.db.transaction() as conn:
-            outcome = _receive_into(sync, op, conn)
-    _finalize_op(sync, op, outcome, skew, guard_error)
+        _finalize_guard(op, skew, guard_error)
+        return False
+    with sync.db.transaction() as conn:
+        outcome = _receive_into(sync, op, conn)
+    _finalize_committed(sync, op, outcome)
     return outcome in (_APPLIED, _TOMBSTONE)
 
 
@@ -271,7 +281,10 @@ def ingest_batch(
                     results.append(False)
             continue
         for op, outcome, skew, guard_error in metas:
-            _finalize_op(sync, op, outcome, skew, guard_error)
+            if outcome == _GUARD:
+                _finalize_guard(op, skew, guard_error)
+            else:
+                _finalize_committed(sync, op, outcome)
             results.append(outcome in (_APPLIED, _TOMBSTONE))
         _tm.SYNC_TXN_COMBINED.inc(len(chunk) - 1)
     return results

@@ -1065,3 +1065,71 @@ def test_relay_resource_accounting():
             await srv.shutdown()
 
     asyncio.run(run())
+
+
+def test_on_stream_connection_count_survives_raising_subscriber():
+    """Regression (sdlint SD016): `_on_stream` used to bump
+    `peer.active_connections` and emit PeerConnected BEFORE entering its
+    try/finally — a raising event subscriber left the count inflated
+    forever, so `Peer.is_connected` lied for the rest of the process."""
+
+    async def run():
+        p2p = P2P("test")
+        calls = []
+
+        def boom(event):
+            calls.append(event)
+            if event[0] == "PeerConnected":
+                raise RuntimeError("subscriber exploded")
+
+        p2p.events.on(boom)
+
+        class FakeStream:
+            remote_identity = "peer-a"
+
+        with pytest.raises(RuntimeError):
+            await p2p._on_stream(FakeStream())
+        peer = p2p.peers["peer-a"]
+        assert peer.active_connections == 0
+        assert not peer.is_connected
+        # the Connected/Disconnected pairing survived the failure
+        assert [e[0] for e in calls] == ["PeerConnected", "PeerDisconnected"]
+
+    asyncio.run(run())
+
+
+def test_relay_accept_failure_after_grant_releases_pipe_accounting():
+    """Regression (sdlint SD016): `_serve_accept` used to register the
+    pipe pair between bumping `pipes_active` and entering its
+    try/finally — a failure there overcounted active pipes forever and
+    never released the dial-time reservation."""
+
+    async def run():
+        from spacedrive_tpu.p2p.relay import RelayServer
+
+        srv = RelayServer()
+        srv._reserve("tgt")
+
+        class StubWriter:
+            def write(self, data):
+                pass
+
+            async def drain(self):
+                pass
+
+            def close(self):
+                pass
+
+        class BoomPipes(set):
+            def update(self, *args):
+                raise RuntimeError("pipe registry exploded")
+
+        srv._pipes = BoomPipes()
+        accepted = asyncio.get_running_loop().create_future()
+        srv._pending["c1"] = (None, StubWriter(), accepted, "tgt")
+        with pytest.raises(RuntimeError):
+            await srv._serve_accept(None, StubWriter(), {"conn": "c1"})
+        assert srv.stats.pipes_active == 0     # not overcounted
+        assert srv._reserved_total == 0        # reservation released
+
+    asyncio.run(run())

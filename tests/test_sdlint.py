@@ -10,6 +10,7 @@ tier-1 and CI cannot drift apart.
 """
 
 import json
+import os
 import subprocess
 import sys
 import textwrap
@@ -1316,3 +1317,669 @@ def test_cli_exit_codes_and_rule_listing(tmp_path):
     assert proc.returncode == 0
     for rid in ("SD001", "SD004", "SD008"):
         assert rid in proc.stdout
+
+
+# --- SD016 cancellation-unsafe async resource flow -------------------------
+
+
+def test_sd016_flags_pr10_admission_slot_leak_shape(tmp_path):
+    """Reconstruction of the PR 10 bug class: a slot counter taken,
+    then a cancellation point, then the release — CancelledError
+    delivered at the await leaks the slot forever."""
+    findings = run_on(
+        tmp_path,
+        """
+        class Gate:
+            async def admit(self):
+                self._inflight += 1
+                await self._work()   # cancelled here -> slot leaked
+                self._inflight -= 1
+        """,
+        ["SD016"],
+    )
+    assert len(findings) == 1
+    assert "CancelledError" in findings[0].message
+
+
+def test_sd016_flags_semaphore_released_on_happy_path_only(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        async def fetch(self):
+            await self._slots.acquire()
+            data = await self._pull()
+            self._slots.release()
+            return data
+        """,
+        ["SD016"],
+    )
+    assert len(findings) == 1
+
+
+def test_sd016_flags_bookkeeping_between_acquire_and_try(tmp_path):
+    """The exact serve/gate.py finding: statements that can raise
+    between the acquire and the try/finally leak on their exception
+    path even though a finally exists."""
+    findings = run_on(
+        tmp_path,
+        """
+        class Gate:
+            async def admit(self):
+                self._inflight += 1
+                self._metrics.inc()   # raises -> finally never entered
+                try:
+                    await self._work()
+                finally:
+                    self._inflight -= 1
+        """,
+        ["SD016"],
+    )
+    assert len(findings) == 1
+    assert "exception path" in findings[0].message
+
+
+def test_sd016_silent_on_finally_async_with_and_knob_nudges(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        class C:
+            async def ok_finally(self):
+                await self._slots.acquire()
+                try:
+                    return await self._pull()
+                finally:
+                    self._slots.release()
+
+            async def ok_async_with(self):
+                async with self._slots:
+                    await self._pull()
+
+            async def ok_knob(self):
+                # += / -= in SIBLING branches is tuning, not a resource
+                if self._hot():
+                    self._rung += 1
+                else:
+                    self._rung -= 1
+                await self._apply()
+
+            async def __aenter__(self):
+                await self._sem.acquire()  # cross-method protocol
+                return self
+        """,
+        ["SD016"],
+    )
+    assert findings == []
+
+
+def test_sd016_cancellation_sails_past_except_exception(tmp_path):
+    """`except Exception` does not catch CancelledError — a handler-
+    based release still leaks on the cancellation path."""
+    findings = run_on(
+        tmp_path,
+        """
+        async def f(self):
+            await self._sem.acquire()
+            try:
+                await self._work()
+            except Exception:
+                pass
+            self._sem.release()
+        """,
+        ["SD016"],
+    )
+    assert len(findings) == 1
+    assert "CancelledError" in findings[0].message
+
+
+# --- SD017 vouch-before-commit ---------------------------------------------
+
+
+def test_sd017_flags_pr7_pre_commit_journal_vouch(tmp_path):
+    """Reconstruction of the PR 7 invariant's bug shape: the journal
+    vouches BEFORE (or inside) the transaction that stores what it
+    vouches for."""
+    findings = run_on(
+        tmp_path,
+        """
+        def persist_before(db, journal, entry):
+            journal.record(entry.key, entry.cas)
+            with db.transaction() as conn:
+                conn.execute("INSERT INTO t VALUES (?)", (entry.cas,))
+
+        def persist_inside(db, journal, entry):
+            with db.transaction() as conn:
+                conn.execute("INSERT INTO t VALUES (?)", (entry.cas,))
+                journal.record(entry.key, entry.cas)
+        """,
+        ["SD017"],
+    )
+    assert len(findings) == 2
+    assert all(f.rule == "SD017" for f in findings)
+
+
+def test_sd017_silent_on_post_commit_vouch_and_facade(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def persist(db, journal, entry):
+            with db.transaction() as conn:
+                conn.execute("INSERT INTO t VALUES (?)", (entry.cas,))
+            journal.record(entry.key, entry.cas)
+
+        def facade(db, journal, rows):
+            db.executemany("UPDATE t SET x = ?", rows)
+            journal.record_phash(1, rows)
+
+        def via_write_ops(library, journal, ops, rows):
+            library.sync.write_ops(ops)
+            journal.record_many(1, rows)
+        """,
+        ["SD017"],
+    )
+    assert findings == []
+
+
+def test_sd017_interprocedural_carrier_through_helper(tmp_path):
+    """A helper that vouches makes its CALL SITES carry the obligation:
+    ordered after the commit is clean, a guard path that skips the
+    commit is a finding."""
+    clean = run_on(
+        tmp_path,
+        """
+        def _finalize(journal, entry):
+            journal.record_many(1, [entry])
+
+        def persist(db, journal, entry):
+            with db.transaction() as conn:
+                conn.execute("INSERT")
+            _finalize(journal, entry)
+        """,
+        ["SD017"],
+    )
+    assert clean == []
+    holed = run_on(
+        tmp_path,
+        """
+        def _finalize(journal, entry):
+            journal.record_many(1, [entry])
+
+        def persist(db, journal, entry, bad):
+            if not bad:
+                with db.transaction() as conn:
+                    conn.execute("INSERT")
+            _finalize(journal, entry)
+        """,
+        ["SD017"],
+    )
+    assert len(holed) == 1
+    assert "_finalize" in holed[0].message
+
+
+def test_sd017_watermark_advance_needs_commit(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def ingest(sync, op, _tm):
+            _tm.SYNC_WATERMARK.set(op.ts, peer="x")
+            with sync.db.transaction() as conn:
+                conn.execute("INSERT")
+        """,
+        ["SD017"],
+    )
+    assert len(findings) == 1
+    assert "SYNC_WATERMARK" in findings[0].message
+
+
+# --- SD018 frozen-dataclass mutation ---------------------------------------
+
+
+def test_sd018_flags_delta_guard_latent_bug_shape(tmp_path):
+    """Reconstruction of the delta-guard FrozenInstanceError: stashing
+    a rejection reason on the frozen op instead of returning it."""
+    findings = run_on(
+        tmp_path,
+        """
+        from dataclasses import dataclass
+
+        @dataclass(frozen=True)
+        class CRDTOperation:
+            ts: int
+
+        def guard(op: CRDTOperation, reason: str) -> bool:
+            if reason:
+                op.reject_reason = reason   # FrozenInstanceError
+                return False
+            return True
+
+        def from_factory(raw):
+            op = CRDTOperation.from_wire(raw)
+            op.ts += 1
+
+        def over_params(ops: list[CRDTOperation]):
+            for op in ops:
+                op.ts = 0
+        """,
+        ["SD018"],
+    )
+    assert len(findings) == 3
+    assert all("FrozenInstanceError" in f.message for f in findings)
+
+
+def test_sd018_silent_on_replace_unfrozen_and_untyped(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        from dataclasses import dataclass, replace
+
+        @dataclass(frozen=True)
+        class Op:
+            ts: int
+
+        @dataclass
+        class Mutable:
+            ts: int
+
+        def ok(op: Op, m: Mutable, anything):
+            m.ts = 1           # not frozen
+            anything.ts = 2    # untyped: unknown
+            return replace(op, ts=3)   # the sanctioned idiom
+        """,
+        ["SD018"],
+    )
+    assert findings == []
+
+
+# --- SD019 breaker-feed discipline -----------------------------------------
+
+
+def test_sd019_flags_policies_that_feed_negative_answers(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        PASS = "pass"
+        RETRY = "retry"
+
+        def no_pass(exc):
+            return RETRY
+
+        P1 = ResiliencePolicy("a")                       # no classify
+        P2 = ResiliencePolicy("b", classify=no_pass)     # cannot PASS
+        P3 = ResiliencePolicy("c", classify=lambda e: RETRY)
+        """,
+        ["SD019"],
+    )
+    assert len(findings) == 3
+
+
+def test_sd019_silent_on_pass_capable_classifiers(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        PASS = "pass"
+        RETRY = "retry"
+
+        def classify(exc):
+            if isinstance(exc, (PermissionError, ValueError)):
+                return PASS
+            return RETRY
+
+        P1 = ResiliencePolicy("a", classify=classify)
+        P2 = ResiliencePolicy("b", classify=lambda e: PASS if e else RETRY)
+        P3 = ResiliencePolicy("c", classify=some.dynamic.thing)  # unknowable
+        """,
+        ["SD019"],
+    )
+    assert findings == []
+
+
+# --- flow-sensitivity upgrades of the migrated rules -----------------------
+
+
+def test_sd008_branch_structured_close_is_clean_now(tmp_path):
+    """The old syntax-level rule demanded a `finally`; the CFG version
+    proves every path closes (no exception-capable statement runs while
+    the handle is open here)."""
+    findings = run_on(
+        tmp_path,
+        """
+        def read_mode(path, header_only):
+            fh = open(path)
+            if header_only:
+                fh.close()
+                return None
+            fh.close()
+            return path
+        """,
+        ["SD008"],
+    )
+    assert findings == []
+
+
+def test_sd008_early_return_leak_is_caught_now(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def read_mode(path, header_only):
+            fh = open(path)
+            if header_only:
+                return None   # leaks fh
+            fh.close()
+            return path
+        """,
+        ["SD008"],
+    )
+    assert len(findings) == 1
+    assert "early-return" in findings[0].message
+
+
+def test_sd002_await_after_early_release_is_clean(tmp_path):
+    """Flow-sensitivity cut: an await AFTER `.release()` inside the
+    with-region used to be unreachable to the syntax-level rule's
+    reasoning (it flagged any await lexically inside the body)."""
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio, threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def ok(self):
+                with self._lock:
+                    x = 1
+                await asyncio.sleep(0)
+                return x
+        """,
+        ["SD002"],
+    )
+    assert findings == []
+
+
+def test_sd002_await_in_branch_under_lock_is_caught(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio, threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad(self, flag):
+                with self._lock:
+                    if flag:
+                        await asyncio.sleep(0)
+        """,
+        ["SD002"],
+    )
+    assert len(findings) == 1
+
+
+def test_sd004_manual_acquire_release_protocol_orders(tmp_path):
+    """Blind-spot cut: explicit `.acquire()` / `.release()` pairs now
+    produce ordering edges, not just `with` blocks."""
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            _a.acquire()
+            try:
+                with _b:
+                    pass
+            finally:
+                _a.release()
+
+        def two():
+            with _b:
+                _a.acquire()
+                _a.release()
+        """,
+        ["SD004"],
+    )
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+
+
+# --- baseline pruning + CI annotations -------------------------------------
+
+
+def test_prune_baseline_removes_only_stale_entries(tmp_path):
+    fx = tmp_path / "fx.py"
+    fx.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    live_key = f"SD001:{fx}:time.sleep(1)"
+    stale_key = f"SD001:{fx}:time.sleep(99)"
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({
+        "version": 1,
+        "entries": [
+            {"key": live_key, "justification": "still grandfathered"},
+            {"key": stale_key, "justification": "edited away long ago"},
+        ],
+    }))
+    proc = _run_cli(str(fx), "--baseline", str(bl), "--prune-baseline")
+    assert proc.returncode == 0
+    assert stale_key in proc.stdout
+    kept = json.loads(bl.read_text())["entries"]
+    assert [e["key"] for e in kept] == [live_key]
+    # justifications survive the rewrite
+    assert kept[0]["justification"] == "still grandfathered"
+    # second run: nothing left to prune
+    proc = _run_cli(str(fx), "--baseline", str(bl), "--prune-baseline")
+    assert "no stale entries" in proc.stdout
+
+
+def test_annotate_emits_github_error_lines(tmp_path):
+    fx = tmp_path / "fx.py"
+    fx.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    proc = _run_cli(str(fx), "--no-baseline", "--annotate")
+    assert proc.returncode == 1
+    # annotations ride STDERR so --format=json stdout stays parseable
+    # (the Actions runner scans both streams for workflow commands)
+    line = next(
+        ln for ln in proc.stderr.splitlines() if ln.startswith("::error ")
+    )
+    assert f"file={fx}" in line
+    assert "line=3" in line
+    assert "title=sdlint SD001" in line
+
+    env_proc = subprocess.run(
+        [sys.executable, "-m", "tools.sdlint", str(fx), "--no-baseline",
+         "--format=json"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "SDLINT_ANNOTATE": "1"},
+    )
+    assert any(
+        ln.startswith("::error ") for ln in env_proc.stderr.splitlines()
+    )
+    json.loads(env_proc.stdout)  # the JSON document stays machine-stable
+
+
+def test_sd008_early_return_through_finally_still_leaks(tmp_path):
+    """Review-found soundness gap: a `return` routed through a
+    `finally` must not masquerade as fall-through into the close after
+    the try (the finally is built twice — normal + abrupt copies)."""
+    findings = run_on(
+        tmp_path,
+        """
+        def f(cond, path):
+            fh = open(path)
+            try:
+                if cond:
+                    return None   # leaks fh through the finally
+            finally:
+                log("x")
+            fh.close()
+            return path
+
+        def g(cond, path):
+            fh = open(path)
+            try:
+                if cond:
+                    return None
+            finally:
+                fh.close()        # close IN the finally: every path
+            return path
+        """,
+        ["SD008"],
+    )
+    assert len(findings) == 1
+    assert findings[0].line == 3  # f's open, not g's
+
+
+def test_sd004_module_level_lock_order_still_counts(tmp_path):
+    """Review-found regression guard: module-level (import-time) lock
+    acquisition must still produce ordering edges."""
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        with _b:
+            with _a:
+                pass
+        """,
+        ["SD004"],
+    )
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+
+
+def test_prune_baseline_is_scope_aware(tmp_path):
+    """A path- or rules-scoped prune run must not treat out-of-scope
+    entries as stale (their findings never had a chance to fire)."""
+    fx_dir = tmp_path / "pkg"
+    fx_dir.mkdir()
+    fx = fx_dir / "fx.py"
+    fx.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    other_key = f"SD001:{tmp_path}/elsewhere.py:time.sleep(2)"
+    sd3_key = f"SD003:{fx}:something"
+    live_key = f"SD001:{fx}:time.sleep(1)"
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({
+        "version": 1,
+        "entries": [
+            {"key": live_key, "justification": "still grandfathered"},
+            {"key": other_key, "justification": "file not analyzed here"},
+            {"key": sd3_key, "justification": "rule not run here"},
+        ],
+    }))
+    # scoped by path AND rules: neither out-of-scope entry may vanish
+    proc = _run_cli(str(fx), "--baseline", str(bl), "--rules", "SD001",
+                    "--prune-baseline")
+    assert proc.returncode == 0
+    assert "no stale entries" in proc.stdout
+    kept = {e["key"] for e in json.loads(bl.read_text())["entries"]}
+    assert kept == {live_key, other_key, sd3_key}
+
+
+def test_prune_baseline_project_rules_need_whole_package_scope(tmp_path):
+    """A PROJECT rule's verdict depends on files anywhere in the tree
+    (classify helpers, frozen-class defs, caller sets) — a subdir-scoped
+    prune must not treat its entries as stale, while a whole-package
+    run may."""
+    pkg = tmp_path / "pkg"
+    sub = pkg / "sub"
+    sub.mkdir(parents=True)
+    (pkg / "fx.py").write_text("x = 1\n")
+    (sub / "inner.py").write_text("y = 2\n")
+    sd19_key = "pkg/fx.py gone-stale"
+    bl = tmp_path / "bl.json"
+    entry = {"key": f"SD019:pkg/fx.py:P = ResiliencePolicy(",
+             "justification": "context lives outside any subdir"}
+    import copy
+    bl.write_text(json.dumps({"version": 1, "entries": [entry]}))
+    # subdir scope: SD019 ran, but the whole package was NOT analyzed —
+    # the entry survives even though no finding fired
+    proc = _run_cli(str(sub), "--baseline", str(bl), "--prune-baseline")
+    assert proc.returncode == 0, proc.stderr
+    assert "no stale entries" in proc.stdout
+    assert json.loads(bl.read_text())["entries"], "project entry pruned"
+    # whole-package scope (run from tmp_path so the root is `pkg`):
+    # now the entry is honestly stale and goes
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.sdlint", "pkg",
+         "--baseline", str(bl), "--prune-baseline"],
+        capture_output=True, text=True, timeout=180,
+        cwd=tmp_path, env={**os.environ, "PYTHONPATH": str(REPO)},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(bl.read_text())["entries"] == []
+
+
+def test_sd016_conditional_release_in_handler_still_leaks(tmp_path):
+    """Review-found blind spot: a release inside an except handler used
+    to be attributed to the handler HEADER, stopping the leak search
+    even when the release was conditional."""
+    findings = run_on(
+        tmp_path,
+        """
+        async def f(self):
+            await self._sem.acquire()
+            try:
+                await self._work()
+            except BaseException:
+                if self._rare():
+                    self._sem.release()
+                raise
+            self._sem.release()
+        """,
+        ["SD016"],
+    )
+    assert len(findings) == 1
+
+
+def test_sd016_unconditional_release_in_handler_is_clean(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        async def f(self):
+            await self._sem.acquire()
+            try:
+                await self._work()
+            except BaseException:
+                self._sem.release()
+                raise
+            self._sem.release()
+        """,
+        ["SD016"],
+    )
+    assert findings == []
+
+
+def test_sd017_carrier_caller_subsumes_callee_obligation(tmp_path):
+    """Review-found false positive: when a function with its own vouch
+    ALSO calls another carrier, the callee-derived obligation must climb
+    the call graph with it — not fire at the call site when every caller
+    is provably post-commit."""
+    findings = run_on(
+        tmp_path,
+        """
+        def a_vouch(journal, entry):
+            journal.record_many(1, [entry])
+
+        def b(sync, journal, entry, _tm):
+            _tm.SYNC_OPS.inc(result="applied")
+            a_vouch(journal, entry)
+
+        def top(sync, journal, entry, _tm):
+            with sync.db.transaction() as conn:
+                conn.execute("INSERT")
+            b(sync, journal, entry, _tm)
+        """,
+        ["SD017"],
+    )
+    assert findings == []

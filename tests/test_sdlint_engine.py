@@ -1,0 +1,508 @@
+"""Unit tests for the sdlint analysis engine itself — the CFG builder,
+dominator computation, suspension/exception edge placement, the forward
+dataflow solver, and call-graph summary composition.
+
+The rule fixtures in test_sdlint.py are end-to-end; these pin the
+engine's *semantics* so a rule regression can be localized: when a rule
+misfires, either the graph it reads is wrong (these tests) or its
+reading of the graph is (those tests).
+"""
+
+import ast
+import textwrap
+from pathlib import Path
+
+from tools.sdlint.cfg import (
+    EXC,
+    FINALLY,
+    HANDLER,
+    WITH_CLEANUP,
+    WITH_EXIT,
+    build_cfg,
+    solve_forward,
+)
+from tools.sdlint.core import FileContext, ProjectContext
+from tools.sdlint.summaries import CallGraph
+
+
+def cfg_of(src: str):
+    fn = ast.parse(textwrap.dedent(src)).body[0]
+    return build_cfg(fn)
+
+
+def node_by_line(cfg, line: int, kind: str = "stmt"):
+    for n in cfg.nodes:
+        if n.line == line and n.kind == kind:
+            return n
+    raise AssertionError(f"no {kind} node at line {line}")
+
+
+def succ_idxs(cfg, node, kind=None):
+    return {t for t, k in cfg.succs[node.idx] if kind is None or k == kind}
+
+
+# --- CFG construction -------------------------------------------------------
+
+
+def test_cfg_straight_line_and_exit():
+    cfg = cfg_of("""
+    def f(x):
+        a = x
+        b = a
+        return b
+    """)
+    a, b, ret = (node_by_line(cfg, ln) for ln in (3, 4, 5))
+    assert succ_idxs(cfg, a) == {b.idx}
+    assert ret.idx in succ_idxs(cfg, b)
+    assert cfg.exit in succ_idxs(cfg, ret)
+
+
+def test_cfg_if_joins_both_arms():
+    cfg = cfg_of("""
+    def f(x):
+        if x:
+            a = 1
+        else:
+            a = 2
+        after(a)
+    """)
+    test = node_by_line(cfg, 3)
+    then, other, after = (node_by_line(cfg, ln) for ln in (4, 6, 7))
+    assert succ_idxs(cfg, test, "normal") == {then.idx, other.idx}
+    assert succ_idxs(cfg, then) == {after.idx}
+    assert succ_idxs(cfg, other) == {after.idx}
+
+
+def test_cfg_loop_back_edge_break_and_continue():
+    cfg = cfg_of("""
+    def f(xs):
+        for x in xs:
+            if x:
+                break
+            continue
+        after()
+    """)
+    hdr = node_by_line(cfg, 3)
+    brk, cont, after = (node_by_line(cfg, ln) for ln in (5, 6, 7))
+    assert succ_idxs(cfg, brk) == {after.idx}       # break exits the loop
+    assert succ_idxs(cfg, cont) == {hdr.idx}        # continue re-enters
+    assert after.idx in succ_idxs(cfg, hdr)         # exhaustion falls out
+
+
+def test_cfg_while_true_has_no_fallthrough():
+    cfg = cfg_of("""
+    def f():
+        while True:
+            spin()
+        never()
+    """)
+    hdr = node_by_line(cfg, 3)
+    body = node_by_line(cfg, 4)
+    assert succ_idxs(cfg, hdr, "normal") == {body.idx}
+    # the statement after an infinite loop is unreachable
+    never = node_by_line(cfg, 5)
+    assert cfg.dominators()[never.idx] is None
+
+
+def test_cfg_try_finally_builds_normal_and_abrupt_copies():
+    """The finally body exists twice (the CPython strategy): the NORMAL
+    copy continues to the code after the try; the ABRUPT copy carries
+    exception/return continuations outward and to EXIT. One shared copy
+    used to let an early `return` masquerade as fall-through."""
+    cfg = cfg_of("""
+    def f():
+        try:
+            work()
+        finally:
+            cleanup()
+        after()
+    """)
+    work = node_by_line(cfg, 4)
+    fins = [n for n in cfg.nodes if n.kind == FINALLY]
+    assert len(fins) == 2
+    normal_fin, abrupt_fin = fins
+    copies = [n for n in cfg.nodes if n.line == 6 and n.kind == "stmt"]
+    assert len(copies) == 2
+    normal_body, abrupt_body = copies
+    # normal completion: body -> normal copy -> after (no raise edge)
+    assert normal_fin.idx in succ_idxs(cfg, work, "normal")
+    assert node_by_line(cfg, 7).idx in succ_idxs(cfg, normal_body)
+    assert cfg.raise_ not in succ_idxs(cfg, normal_body, EXC) or \
+        normal_body.can_raise  # only its own cleanup() call may raise
+    # exceptional exit: body -exc-> abrupt copy -> RAISE and EXIT
+    assert abrupt_fin.idx in succ_idxs(cfg, work, EXC)
+    assert cfg.raise_ in succ_idxs(cfg, abrupt_body, EXC)
+    assert cfg.exit in succ_idxs(cfg, abrupt_body, "normal")
+
+
+def test_cfg_return_through_finally_not_around_it():
+    cfg = cfg_of("""
+    def f():
+        try:
+            return 1
+        finally:
+            cleanup()
+        never()
+    """)
+    ret = node_by_line(cfg, 4)
+    fins = [n.idx for n in cfg.nodes if n.kind == FINALLY]
+    # the return must run the finally (abrupt copy) first — no direct
+    # exit edge, and it must NOT fall through to the code after
+    assert succ_idxs(cfg, ret) & set(fins)
+    assert cfg.exit not in succ_idxs(cfg, ret)
+    abrupt_body = [n for n in cfg.nodes
+                   if n.line == 6 and n.kind == "stmt"][1]
+    never = node_by_line(cfg, 7)
+    assert never.idx not in succ_idxs(cfg, abrupt_body)
+    assert cfg.exit in succ_idxs(cfg, abrupt_body)
+
+
+def test_cfg_handler_catches_and_continues():
+    cfg = cfg_of("""
+    def f():
+        try:
+            work()
+        except OSError:
+            handle()
+        after()
+    """)
+    work = node_by_line(cfg, 4)
+    handler = next(n for n in cfg.nodes if n.kind == HANDLER)
+    assert handler.idx in succ_idxs(cfg, work, EXC)
+    # OSError is a *possible* catch: propagation to RAISE remains
+    assert cfg.raise_ in succ_idxs(cfg, work, EXC)
+    # the handler body falls through to the statement after the try
+    assert node_by_line(cfg, 7).idx in succ_idxs(cfg, node_by_line(cfg, 6))
+
+
+def test_cfg_with_has_separate_commit_and_cleanup_exits():
+    cfg = cfg_of("""
+    def f(db):
+        with db.transaction() as conn:
+            conn.execute("INSERT")
+        after()
+    """)
+    body = node_by_line(cfg, 4)
+    wexit = next(n for n in cfg.nodes if n.kind == WITH_EXIT)
+    cleanup = next(n for n in cfg.nodes if n.kind == WITH_CLEANUP)
+    # normal body exit -> commit exit -> after
+    assert wexit.idx in succ_idxs(cfg, body, "normal")
+    assert node_by_line(cfg, 5).idx in succ_idxs(cfg, wexit)
+    # exceptional body exit -> cleanup (rollback), which propagates,
+    # and deliberately NOT through the commit exit
+    assert cleanup.idx in succ_idxs(cfg, body, EXC)
+    assert cfg.raise_ in succ_idxs(cfg, cleanup, EXC)
+    assert wexit.idx not in succ_idxs(cfg, body, EXC)
+
+
+def test_cfg_async_with_suspends():
+    cfg = cfg_of("""
+    async def f(self):
+        async with self._sem:
+            work()
+    """)
+    header = node_by_line(cfg, 3)
+    assert header.suspends
+
+
+# --- await / cancellation edges ---------------------------------------------
+
+
+def test_await_nodes_suspend_and_cancellation_skips_except_exception():
+    cfg = cfg_of("""
+    async def f(self):
+        try:
+            await self.work()
+        except Exception:
+            pass
+    """)
+    aw = node_by_line(cfg, 4)
+    assert aw.suspends
+    handler = next(n for n in cfg.nodes if n.kind == HANDLER)
+    # ordinary exceptions can land in the handler...
+    assert handler.idx in succ_idxs(cfg, aw, EXC)
+    # ...but CancelledError still escapes the function entirely
+    assert cfg.raise_ in succ_idxs(cfg, aw, EXC)
+
+
+def test_cancellation_stopped_by_baseexception_and_cancelled_handlers():
+    # `except BaseException` definitely catches EVERYTHING — no escape
+    cfg = cfg_of("""
+    async def f(self):
+        try:
+            await self.work()
+        except BaseException:
+            pass
+    """)
+    aw = node_by_line(cfg, 4)
+    assert cfg.raise_ not in succ_idxs(cfg, aw, EXC)
+    # `except CancelledError` stops the cancellation kind; ordinary
+    # exceptions from the awaited call still propagate to RAISE
+    cfg = cfg_of("""
+    async def f(self):
+        try:
+            await self.work()
+        except asyncio.CancelledError:
+            raise
+    """)
+    aw = node_by_line(cfg, 4)
+    handler = next(n for n in cfg.nodes if n.kind == HANDLER)
+    assert handler.idx in succ_idxs(cfg, aw, EXC)
+    assert cfg.raise_ in succ_idxs(cfg, aw, EXC)  # the non-cancel kinds
+
+
+def test_plain_assignment_has_no_exception_edge():
+    cfg = cfg_of("""
+    def f(x):
+        a = 1
+        b = g(a)
+    """)
+    assert succ_idxs(cfg, node_by_line(cfg, 3), EXC) == set()
+    assert cfg.raise_ in succ_idxs(cfg, node_by_line(cfg, 4), EXC)
+
+
+# --- dominators -------------------------------------------------------------
+
+
+def test_dominators_linear_and_branch():
+    cfg = cfg_of("""
+    def f(x):
+        a = 1
+        if x:
+            b = g()
+        c = 2
+    """)
+    a = node_by_line(cfg, 3)
+    test = node_by_line(cfg, 4)
+    b = node_by_line(cfg, 5)
+    c = node_by_line(cfg, 6)
+    doms_c = cfg.dominators()[c.idx]
+    # the straight-line prefix dominates the join; the branch arm not
+    assert a.idx in doms_c and test.idx in doms_c
+    assert b.idx not in doms_c
+    assert cfg.dominated_by(c.idx, {a.idx})
+    assert not cfg.dominated_by(c.idx, {b.idx})
+
+
+def test_dominators_with_exit_dominates_post_block_only():
+    cfg = cfg_of("""
+    def f(db, flag):
+        if flag:
+            with db.transaction() as conn:
+                conn.execute("X")
+        after()
+    """)
+    wexit = next(n for n in cfg.nodes if n.kind == WITH_EXIT)
+    after = node_by_line(cfg, 6)
+    assert not cfg.dominated_by(after.idx, {wexit.idx})
+
+
+def test_dominators_loop_header_dominates_body():
+    cfg = cfg_of("""
+    def f(xs):
+        for x in xs:
+            body(x)
+    """)
+    hdr = node_by_line(cfg, 3)
+    body = node_by_line(cfg, 4)
+    assert hdr.idx in cfg.dominators()[body.idx]
+
+
+# --- dataflow solver --------------------------------------------------------
+
+
+def test_solve_forward_reaches_fixpoint_through_loop():
+    cfg = cfg_of("""
+    def f(xs):
+        acquire()
+        for x in xs:
+            touch(x)
+        release()
+    """)
+
+    def transfer(node, state):
+        if node.ast is None:
+            return state
+        text = ast.dump(node.ast)
+        if "acquire" in text:
+            return state | {"lock"}
+        if "release" in text:
+            return state - {"lock"}
+        return state
+
+    in_states = solve_forward(cfg, frozenset(), transfer)
+    body = node_by_line(cfg, 4)
+    rel = node_by_line(cfg, 5)
+    assert "lock" in in_states[body.idx]
+    assert "lock" in in_states[rel.idx]
+    assert "lock" not in in_states[cfg.exit] or True  # exit in-state is post-release
+    assert in_states[cfg.exit] == frozenset()
+
+
+# --- call graph + summaries -------------------------------------------------
+
+
+def _project(tmp_path: Path, files: dict[str, str]) -> ProjectContext:
+    project = ProjectContext()
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        src = textwrap.dedent(src)
+        path.write_text(src)
+        posix = path.relative_to(tmp_path).as_posix()
+        project.files.append(
+            FileContext(posix, src, ast.parse(src, filename=posix))
+        )
+    return project
+
+
+def test_call_graph_resolves_self_module_and_imports(tmp_path):
+    project = _project(tmp_path, {
+        "pkg/a.py": """
+        from pkg.b import helper
+        from pkg import b as bee
+
+        def local():
+            pass
+
+        class C:
+            def m(self):
+                self.n()
+                local()
+                helper()
+                bee.other()
+
+            def n(self):
+                pass
+        """,
+        "pkg/b.py": """
+        def helper():
+            pass
+
+        def other():
+            pass
+        """,
+    })
+    graph = CallGraph.of(project)
+    actx = project.files[0]
+    minfo = next(i for i in actx.functions if i.qualname == "C.m")
+    resolved = {
+        (r[0].path, r[1].qualname)
+        for _call, r in graph.calls_in(actx, minfo) if r is not None
+    }
+    assert resolved == {
+        ("pkg/a.py", "C.n"),
+        ("pkg/a.py", "local"),
+        ("pkg/b.py", "helper"),
+        ("pkg/b.py", "other"),
+    }
+
+
+def test_call_graph_relative_imports(tmp_path):
+    project = _project(tmp_path, {
+        "pkg/sub/a.py": """
+        from ..core import boom
+
+        def go():
+            boom()
+        """,
+        "pkg/core.py": """
+        def boom():
+            pass
+        """,
+    })
+    graph = CallGraph.of(project)
+    actx = next(c for c in project.files if c.path.endswith("a.py"))
+    ginfo = next(i for i in actx.functions if i.qualname == "go")
+    [(call, resolved)] = list(graph.calls_in(actx, ginfo))
+    assert resolved is not None
+    assert resolved[0].path == "pkg/core.py"
+    assert resolved[1].qualname == "boom"
+
+
+def test_summaries_compose_transitively_and_survive_cycles(tmp_path):
+    project = _project(tmp_path, {
+        "m.py": """
+        def leaf():
+            mark()
+
+        def mid():
+            leaf()
+
+        def top():
+            mid()
+
+        def spin_a():
+            spin_b()
+
+        def spin_b():
+            spin_a()
+        """,
+    })
+    graph = CallGraph.of(project)
+    ctx = project.files[0]
+
+    def compute(fctx, info, summary_of):
+        import ast as _ast
+
+        from tools.sdlint.core import walk_shallow
+
+        for node in walk_shallow(info.node):
+            if isinstance(node, _ast.Call):
+                name = getattr(node.func, "id", None)
+                if name == "mark":
+                    return True
+                resolved = graph.resolve(fctx, node, node)
+                if resolved is not None and summary_of(*resolved):
+                    return True
+        return False
+
+    summary_of = graph.summarize(compute, default=False)
+    by_name = {i.qualname: i for i in ctx.functions}
+    assert summary_of(ctx, by_name["leaf"]) is True
+    assert summary_of(ctx, by_name["mid"]) is True      # one hop
+    assert summary_of(ctx, by_name["top"]) is True      # two hops
+    # a mutual-recursion cycle terminates with the default
+    assert summary_of(ctx, by_name["spin_a"]) is False
+
+
+def test_callers_of_reverse_edges(tmp_path):
+    project = _project(tmp_path, {
+        "m.py": """
+        def callee():
+            pass
+
+        def one():
+            callee()
+
+        def two():
+            callee()
+        """,
+    })
+    graph = CallGraph.of(project)
+    ctx = project.files[0]
+    callee = next(i for i in ctx.functions if i.qualname == "callee")
+    callers = {info.qualname for _c, info, _call in graph.callers_of(ctx, callee)}
+    assert callers == {"one", "two"}
+
+
+def test_cfg_module_body_and_class_body_build():
+    """SD004 replays module-level and class-body code (it runs at
+    import time): build_cfg accepts the Module node and class bodies
+    wire inline."""
+    import ast as _ast
+
+    tree = _ast.parse(textwrap.dedent("""
+    setup()
+
+    class C:
+        _x = make()
+
+        def method(self):
+            pass
+
+    teardown()
+    """))
+    cfg = build_cfg(tree)
+    lines = {n.line for n in cfg.nodes if n.kind == "stmt"}
+    assert {2, 4, 5, 7, 10} <= lines  # incl. the class-body assignment

@@ -267,6 +267,65 @@ async def test_gate_cancelled_waiter_does_not_leak_slot():
 
 
 @pytest.mark.asyncio
+async def test_gate_failing_bookkeeping_does_not_leak_slot(monkeypatch):
+    """Regression (sdlint SD016): the admission bookkeeping (admitted
+    counter, gate metrics, queue-wait observation) used to run between
+    taking the slot and entering the try/finally — a raising metric
+    registry permanently shrank the class budget by one slot per
+    failure."""
+    telemetry.reset()
+    from spacedrive_tpu.serve import gate as gate_mod
+
+    gate = AdmissionGate(_tight_policy())
+
+    class Boom:
+        def inc(self, *a, **k):
+            raise RuntimeError("metric registry exploded")
+
+    monkeypatch.setattr(gate_mod._tm, "GATE_REQUESTS", Boom())
+    for _ in range(3):  # repeated failures must not erode the budget
+        with pytest.raises(RuntimeError):
+            async with gate.admit("interactive"):
+                pass
+        assert gate.inflight["interactive"] == 0
+    monkeypatch.undo()
+    # the class still works at full budget afterwards
+    async with gate.admit("interactive"):
+        assert gate.inflight["interactive"] == 1
+    assert gate.inflight["interactive"] == 0
+
+    # QUEUED path: the queued-outcome metric raising must not leave an
+    # orphan waiter behind — _grant_next would hand it a slot nobody
+    # consumes, permanently shrinking the budget
+    class BoomQueued:
+        def inc(self, *a, **k):
+            if k.get("outcome") == "queued":
+                raise RuntimeError("metric registry exploded")
+
+    pol = _tight_policy()
+    pol.budgets["interactive"] = ClassBudget(
+        max_inflight=1, max_queue=4, queue_deadline_s=5.0)
+    gate = AdmissionGate(pol)
+    release = asyncio.Event()
+    entered = asyncio.Event()
+    holder = asyncio.ensure_future(
+        _hold(gate, "interactive", release, entered))
+    await entered.wait()
+    monkeypatch.setattr(gate_mod._tm, "GATE_REQUESTS", BoomQueued())
+    with pytest.raises(RuntimeError):
+        async with gate.admit("interactive"):
+            pass
+    assert len(gate._queues["interactive"]) == 0   # no orphan waiter
+    monkeypatch.undo()
+    release.set()
+    await holder
+    assert gate.inflight["interactive"] == 0       # budget intact
+    async with gate.admit("interactive"):
+        assert gate.inflight["interactive"] == 1
+    telemetry.reset()
+
+
+@pytest.mark.asyncio
 async def test_gate_unknown_class_degrades_to_background():
     telemetry.reset()
     gate = AdmissionGate(_tight_policy())

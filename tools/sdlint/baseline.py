@@ -66,6 +66,33 @@ class Baseline:
         stale = sorted(set(self.entries) - used)
         return unbaselined, suppressed, stale
 
+    def prune(self, path: Path | str, stale: list[str]) -> list[str]:
+        """Drop ``stale`` keys (entries whose finding no longer fires)
+        and rewrite the file. Returns the keys actually removed. Dead
+        entries are not harmless: a suppression keyed on a line that no
+        longer exists silently re-covers the SAME line if someone
+        re-introduces it — pruning keeps the baseline an honest list of
+        *current* debts."""
+        removed = [k for k in stale if k in self.entries]
+        for key in removed:
+            del self.entries[key]
+        path = Path(path)
+        path.write_text(
+            json.dumps(
+                {
+                    "version": 1,
+                    "entries": [
+                        {"key": key, "justification": self.entries[key]}
+                        for key in sorted(self.entries)
+                    ],
+                },
+                indent=2,
+            )
+            + "\n",
+            encoding="utf-8",
+        )
+        return removed
+
     def write(self, path: Path | str, findings: list[Finding]) -> None:
         """Merge ``findings`` into the baseline: existing entries (and
         their justifications) are always kept — a scoped run

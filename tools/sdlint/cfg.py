@@ -1,0 +1,641 @@
+"""Per-function control-flow graphs for flow-sensitive rules.
+
+The PR 2 rule framework saw one AST shape at a time, which is exactly
+as far as syntax-level linting goes: it can say "this `with` body
+contains an `await`" but not "this acquire is released on *every* path
+out of the function, including the CancelledError path out of an
+intervening `await`".  This module is the seam that upgrade rides on:
+
+- **statement-level CFG** per function: one node per simple statement
+  / compound-statement header, plus synthetic ENTRY / EXIT / RAISE
+  nodes.  RAISE is the "an exception escaped this function" sink —
+  resource-leak checks treat it as an exit like any other.
+- **exception edges** (kind ``EXC``) from every node that can
+  realistically raise (it contains a call, an ``await``, a ``raise``,
+  an ``assert``, or an import) to the innermost handlers / ``finally``
+  that could see the exception, falling through to RAISE.  Handler
+  matching is approximated by name: ``except Exception`` definitely
+  catches ordinary exceptions but NOT cancellation, ``except
+  BaseException`` / bare catch both, ``except OSError`` *possibly*
+  catches (edge added, propagation continues).
+- **suspension points as first-class nodes**: a node containing
+  ``await`` / ``async for`` / ``async with`` / ``yield`` is marked
+  ``suspends`` and its EXC edges are routed with cancellation
+  semantics — CancelledError sails straight past ``except Exception``.
+  This is what makes "held across a cancellation point" expressible.
+- **with-statements** get two synthetic nodes: ``WITH_EXIT`` on the
+  normal path (the commit point of ``with db.transaction():``) and
+  ``WITH_CLEANUP`` on the exceptional path (``__exit__`` as rollback)
+  — so commit-ordering rules see the two exits as the different events
+  they are, while lock rules release on both.
+- **dominators** (iterative set-intersection — functions are small)
+  so "X must be dominated by Y" is a one-call query, and a guided
+  **search** helper for "can a path escape A without passing B".
+
+Known approximations, chosen so false findings stay rare and cheap to
+baseline: the ``finally`` body is built TWICE (the CPython compilation
+strategy) — a NORMAL copy continuing to the code after the try and an
+ABRUPT copy whose exits propagate outward and to EXIT, carrying
+exception and return/break continuations — so an early ``return``
+cannot masquerade as fall-through; the abrupt copy still conflates the
+return continuation with re-raise (both are escapes, which is what the
+leak checks care about); ``break`` through a ``finally`` follows the
+cleanup chain rather than re-entering the loop; nested defs and
+lambdas are opaque single nodes (their bodies run elsewhere). Rules
+that stop a search at a statement (a ``release()``, a ``close()``)
+must match by the node's ``ast`` — a finally-resident statement exists
+as two CFG nodes sharing one AST.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterable
+
+NORMAL = "normal"
+EXC = "exc"
+
+ENTRY = "entry"
+EXIT = "exit"
+RAISE = "raise"
+STMT = "stmt"
+HANDLER = "handler"
+FINALLY = "finally"
+WITH_EXIT = "with_exit"
+WITH_CLEANUP = "with_cleanup"
+
+#: statement types that carry no runtime failure mode worth an edge
+_SAFE_SIMPLE = (ast.Pass, ast.Global, ast.Nonlocal, ast.Break, ast.Continue)
+
+
+class Node:
+    __slots__ = ("idx", "ast", "kind", "suspends", "can_raise", "line")
+
+    def __init__(self, idx: int, ast_node: ast.AST | None, kind: str):
+        self.idx = idx
+        self.ast = ast_node
+        self.kind = kind
+        self.suspends = False
+        self.can_raise = False
+        self.line = getattr(ast_node, "lineno", 0) if ast_node is not None else 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        tag = type(self.ast).__name__ if self.ast is not None else "-"
+        return f"<Node {self.idx} {self.kind} {tag} L{self.line}>"
+
+
+class CFG:
+    """One function's control-flow graph. Build via :func:`build_cfg`."""
+
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef):
+        self.fn = fn
+        self.nodes: list[Node] = []
+        self.succs: list[list[tuple[int, str]]] = []
+        self._preds: list[list[tuple[int, str]]] | None = None
+        self._doms: list[set[int] | None] | None = None
+        self.entry = self._new(None, ENTRY)
+        self.exit = self._new(None, EXIT)
+        self.raise_ = self._new(None, RAISE)
+        # first CFG node for each statement AST node (compound headers
+        # included) — how rules go from an AST site to its CFG position
+        self.by_ast: dict[ast.AST, int] = {}
+
+    # -- construction ------------------------------------------------------
+
+    def _new(self, ast_node: ast.AST | None, kind: str) -> int:
+        node = Node(len(self.nodes), ast_node, kind)
+        self.nodes.append(node)
+        self.succs.append([])
+        if ast_node is not None and kind in (STMT, HANDLER):
+            self.by_ast.setdefault(ast_node, node.idx)
+        return node.idx
+
+    def add_edge(self, a: int, b: int, kind: str = NORMAL) -> None:
+        if (b, kind) not in self.succs[a]:
+            self.succs[a].append((b, kind))
+            self._preds = None
+            self._doms = None
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def preds(self) -> list[list[tuple[int, str]]]:
+        if self._preds is None:
+            self._preds = [[] for _ in self.nodes]
+            for a, outs in enumerate(self.succs):
+                for b, kind in outs:
+                    self._preds[b].append((a, kind))
+        return self._preds
+
+    def node(self, idx: int) -> Node:
+        return self.nodes[idx]
+
+    def stmt_nodes(self) -> Iterable[Node]:
+        for n in self.nodes:
+            if n.ast is not None:
+                yield n
+
+    def dominators(self) -> list[set[int] | None]:
+        """``doms[n]`` = the set of nodes on EVERY path entry→n, or
+        None for nodes unreachable from entry (vacuously dominated —
+        checks on dead code stay silent rather than guessing)."""
+        if self._doms is not None:
+            return self._doms
+        preds = self.preds
+        # reachable set, quasi-topological order (BFS is fine: the
+        # iteration below runs to fixpoint regardless of order)
+        order: list[int] = []
+        seen = {self.entry}
+        work = [self.entry]
+        while work:
+            cur = work.pop(0)
+            order.append(cur)
+            for nxt, _ in self.succs[cur]:
+                if nxt not in seen:
+                    seen.add(nxt)
+                    work.append(nxt)
+        doms: list[set[int] | None] = [None] * len(self.nodes)
+        full = set(order)
+        for n in order:
+            doms[n] = set(full)
+        doms[self.entry] = {self.entry}
+        changed = True
+        while changed:
+            changed = False
+            for n in order:
+                if n == self.entry:
+                    continue
+                ins = [doms[p] for p, _ in preds[n] if p in full]
+                ins = [d for d in ins if d is not None]
+                if not ins:
+                    continue
+                new = set.intersection(*ins)
+                new.add(n)
+                if new != doms[n]:
+                    doms[n] = new
+                    changed = True
+        self._doms = doms
+        return doms
+
+    def dominated_by(self, n: int, candidates: set[int]) -> bool:
+        """Is node ``n`` dominated by ANY node in ``candidates``?
+        Unreachable nodes count as dominated (dead code stays silent)."""
+        doms = self.dominators()[n]
+        if doms is None:
+            return True
+        return bool((doms - {n}) & candidates)
+
+    def search(
+        self,
+        starts: Iterable[int],
+        stop: Callable[[Node], bool] | None = None,
+    ) -> dict[int, tuple[int, str] | None]:
+        """BFS from ``starts``. Nodes satisfying ``stop`` are visited
+        but not expanded (the search cannot pass through them). Returns
+        ``{node: (parent, edge_kind)}`` (None for the starts) — enough
+        to reconstruct a witness path to anything reached."""
+        visited: dict[int, tuple[int, str] | None] = {}
+        work: list[int] = []
+        for s in starts:
+            if s not in visited:
+                visited[s] = None
+                work.append(s)
+        while work:
+            cur = work.pop(0)
+            if stop is not None and stop(self.nodes[cur]):
+                continue
+            for nxt, kind in self.succs[cur]:
+                if nxt not in visited:
+                    visited[nxt] = (cur, kind)
+                    work.append(nxt)
+        return visited
+
+
+def solve_forward(
+    cfg: CFG,
+    init: frozenset,
+    transfer: Callable[[Node, frozenset], frozenset],
+) -> list[frozenset]:
+    """Generic forward may-analysis: states are frozensets, merge is
+    union, ``transfer`` maps a node's in-state to its out-state.
+    Returns the IN-state per node (fixpoint)."""
+    n = len(cfg.nodes)
+    in_states: list[frozenset] = [frozenset()] * n
+    in_states[cfg.entry] = init
+    # seed with every reachable node (BFS order) so a node whose
+    # in-state never *changes* from the initial empty set still runs
+    # its transfer once and feeds its successors
+    work: list[int] = []
+    seen = {cfg.entry}
+    frontier = [cfg.entry]
+    while frontier:
+        cur = frontier.pop(0)
+        work.append(cur)
+        for nxt, _ in cfg.succs[cur]:
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    queued = set(work)
+    while work:
+        cur = work.pop(0)
+        queued.discard(cur)
+        out = transfer(cfg.nodes[cur], in_states[cur])
+        for nxt, _ in cfg.succs[cur]:
+            merged = in_states[nxt] | out
+            if merged != in_states[nxt]:
+                in_states[nxt] = merged
+                if nxt not in queued:
+                    queued.add(nxt)
+                    work.append(nxt)
+    return in_states
+
+
+# --------------------------------------------------------------------------
+# expression scanning: what can a statement header raise / suspend on?
+
+
+def _scan_exprs(exprs: Iterable[ast.AST | None]) -> tuple[bool, bool]:
+    """(can_raise, suspends) over the given expressions, not descending
+    into nested defs/lambdas (their bodies run elsewhere)."""
+    can_raise = False
+    suspends = False
+    stack = [e for e in exprs if e is not None]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(cur, (ast.Call,)):
+            can_raise = True
+        elif isinstance(cur, (ast.Await, ast.Yield, ast.YieldFrom)):
+            can_raise = True
+            suspends = True
+        stack.extend(ast.iter_child_nodes(cur))
+    return can_raise, suspends
+
+
+def _header_exprs(stmt: ast.stmt) -> list[ast.AST | None]:
+    """The expressions a compound statement's HEADER node evaluates
+    (its body statements get their own nodes); simple statements
+    evaluate everything they contain."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return list(stmt.decorator_list)
+    return [stmt]
+
+
+class _Handler:
+    __slots__ = ("node", "catches_normal", "definite_normal",
+                 "catches_cancel", "definite_cancel")
+
+    def __init__(self, node: int, h: ast.ExceptHandler):
+        self.node = node
+        names: list[str] = []
+        if h.type is None:
+            names = ["BaseException"]
+        else:
+            types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+            for t in types:
+                if isinstance(t, ast.Attribute):
+                    names.append(t.attr)
+                elif isinstance(t, ast.Name):
+                    names.append(t.id)
+                else:
+                    names.append("?")
+        self.catches_normal = False
+        self.definite_normal = False
+        self.catches_cancel = False
+        self.definite_cancel = False
+        for name in names:
+            if name == "BaseException":
+                self.catches_normal = self.definite_normal = True
+                self.catches_cancel = self.definite_cancel = True
+            elif name == "Exception":
+                self.catches_normal = self.definite_normal = True
+            elif name == "CancelledError":
+                self.catches_cancel = self.definite_cancel = True
+            else:
+                # a specific type (OSError, TimeoutError, ...): may
+                # catch an ordinary exception, never cancellation
+                self.catches_normal = True
+
+
+class _TryFrame:
+    __slots__ = ("handlers", "cleanup")
+
+    def __init__(self, handlers: list[_Handler], cleanup: int | None):
+        self.handlers = handlers
+        self.cleanup = cleanup  # finally/with-cleanup entry node
+
+
+class _Builder:
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef):
+        self.cfg = CFG(fn)
+        self.frames: list[_TryFrame] = []
+        # each loop: {"breaks": [], "cont": hdr, "depth": len(frames)}
+        self.loops: list[dict] = []
+
+    # -- exception routing -------------------------------------------------
+
+    def _route_exc(self, n: int, *, cancel: bool,
+                   frames: list[_TryFrame] | None = None) -> None:
+        """Wire the EXC edges an exception thrown at ``n`` can take."""
+        frames = self.frames if frames is None else frames
+        for frame in reversed(frames):
+            for h in frame.handlers:
+                if cancel and h.catches_cancel:
+                    self.cfg.add_edge(n, h.node, EXC)
+                    if h.definite_cancel:
+                        return
+                elif not cancel and h.catches_normal:
+                    self.cfg.add_edge(n, h.node, EXC)
+                    if h.definite_normal:
+                        return
+            if frame.cleanup is not None:
+                # the finally (or __exit__) sees the exception; its own
+                # outward continuation edges were wired when it was built
+                self.cfg.add_edge(n, frame.cleanup, EXC)
+                return
+        self.cfg.add_edge(n, self.cfg.raise_, EXC)
+
+    def _mark_and_route(self, n: int, exprs: list[ast.AST | None],
+                        *, force_raise: bool = False) -> None:
+        can_raise, suspends = _scan_exprs(exprs)
+        node = self.cfg.nodes[n]
+        node.suspends = suspends
+        node.can_raise = can_raise or force_raise
+        if node.can_raise:
+            self._route_exc(n, cancel=False)
+        if suspends:
+            # cancellation can be delivered at any suspension point and
+            # sails past `except Exception`
+            self._route_exc(n, cancel=True)
+
+    def _cleanup_chain_target(self, upto_depth: int = 0) -> int | None:
+        """Innermost pending finally/with-cleanup at or above
+        ``upto_depth`` — what a return/break/continue must run first."""
+        for frame in reversed(self.frames[upto_depth:]):
+            if frame.cleanup is not None:
+                return frame.cleanup
+        return None
+
+    # -- statement dispatch ------------------------------------------------
+
+    def build(self) -> CFG:
+        exits = self._stmts(self.cfg.fn.body, [self.cfg.entry])
+        for e in exits:
+            self.cfg.add_edge(e, self.cfg.exit)
+        return self.cfg
+
+    def _stmts(self, body: list[ast.stmt], preds: list[int]) -> list[int]:
+        for stmt in body:
+            preds = self._stmt(stmt, preds)
+        return preds
+
+    def _stmt(self, s: ast.stmt, preds: list[int]) -> list[int]:
+        if isinstance(s, ast.If):
+            return self._build_if(s, preds)
+        if isinstance(s, (ast.While,)):
+            return self._build_while(s, preds)
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            return self._build_for(s, preds)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            return self._build_with(s, preds)
+        if isinstance(s, ast.Try):
+            return self._build_try(s, preds)
+        if isinstance(s, ast.Return):
+            return self._build_return(s, preds)
+        if isinstance(s, ast.Raise):
+            n = self._simple(s, preds, force_raise=True)
+            self._route_exc(n, cancel=True)
+            return []
+        if isinstance(s, (ast.Break, ast.Continue)):
+            return self._build_break_continue(s, preds)
+        if isinstance(s, ast.Assert):
+            n = self._simple(s, preds, force_raise=True)
+            return [n]
+        if isinstance(s, (ast.Import, ast.ImportFrom)):
+            n = self._simple(s, preds, force_raise=True)
+            return [n]
+        if hasattr(ast, "Match") and isinstance(s, ast.Match):
+            return self._build_match(s, preds)
+        if isinstance(s, ast.ClassDef):
+            # a class BODY executes inline at definition time (methods
+            # defined there are just statements) — matters when a
+            # module/class body takes locks at import time
+            n = self._simple(s, preds, force_raise=True)
+            return self._stmts(s.body, [n])
+        # simple statement (Expr/Assign/AugAssign/AnnAssign/Delete/...)
+        n = self._simple(s, preds,
+                         force_raise=not isinstance(s, _SAFE_SIMPLE))
+        if isinstance(s, _SAFE_SIMPLE):
+            self.cfg.nodes[n].can_raise = False
+        return [n]
+
+    def _simple(self, s: ast.stmt, preds: list[int],
+                *, force_raise: bool = False) -> int:
+        n = self.cfg._new(s, STMT)
+        for p in preds:
+            self.cfg.add_edge(p, n)
+        exprs = _header_exprs(s)
+        can_raise, suspends = _scan_exprs(exprs)
+        node = self.cfg.nodes[n]
+        node.suspends = suspends
+        node.can_raise = can_raise or (force_raise and not isinstance(
+            s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)))
+        # only call/await/raise/assert/import-bearing nodes get EXC
+        # edges: `x = 1` failing is a programming error, not a path
+        if node.can_raise and (can_raise or isinstance(
+                s, (ast.Raise, ast.Assert, ast.Import, ast.ImportFrom))):
+            self._route_exc(n, cancel=False)
+        else:
+            node.can_raise = can_raise
+        if suspends:
+            self._route_exc(n, cancel=True)
+        return n
+
+    def _build_if(self, s: ast.If, preds: list[int]) -> list[int]:
+        n = self.cfg._new(s, STMT)
+        for p in preds:
+            self.cfg.add_edge(p, n)
+        self._mark_and_route(n, [s.test])
+        then_exits = self._stmts(s.body, [n])
+        if s.orelse:
+            else_exits = self._stmts(s.orelse, [n])
+        else:
+            else_exits = [n]
+        return then_exits + else_exits
+
+    def _build_while(self, s: ast.While, preds: list[int]) -> list[int]:
+        hdr = self.cfg._new(s, STMT)
+        for p in preds:
+            self.cfg.add_edge(p, hdr)
+        self._mark_and_route(hdr, [s.test])
+        loop = {"breaks": [], "cont": hdr, "depth": len(self.frames)}
+        self.loops.append(loop)
+        body_exits = self._stmts(s.body, [hdr])
+        for e in body_exits:
+            self.cfg.add_edge(e, hdr)
+        self.loops.pop()
+        infinite = isinstance(s.test, ast.Constant) and bool(s.test.value)
+        false_exits = [] if infinite else [hdr]
+        if s.orelse:
+            false_exits = self._stmts(s.orelse, false_exits)
+        return loop["breaks"] + false_exits
+
+    def _build_for(self, s: ast.For | ast.AsyncFor,
+                   preds: list[int]) -> list[int]:
+        hdr = self.cfg._new(s, STMT)
+        for p in preds:
+            self.cfg.add_edge(p, hdr)
+        self._mark_and_route(hdr, [s.iter])
+        if isinstance(s, ast.AsyncFor):
+            node = self.cfg.nodes[hdr]
+            node.suspends = True
+            node.can_raise = True
+            self._route_exc(hdr, cancel=True)
+            self._route_exc(hdr, cancel=False)
+        loop = {"breaks": [], "cont": hdr, "depth": len(self.frames)}
+        self.loops.append(loop)
+        body_exits = self._stmts(s.body, [hdr])
+        for e in body_exits:
+            self.cfg.add_edge(e, hdr)
+        self.loops.pop()
+        false_exits = [hdr]
+        if s.orelse:
+            false_exits = self._stmts(s.orelse, false_exits)
+        return loop["breaks"] + false_exits
+
+    def _build_with(self, s: ast.With | ast.AsyncWith,
+                    preds: list[int]) -> list[int]:
+        n = self.cfg._new(s, STMT)
+        for p in preds:
+            self.cfg.add_edge(p, n)
+        self._mark_and_route(n, [item.context_expr for item in s.items])
+        if isinstance(s, ast.AsyncWith):
+            node = self.cfg.nodes[n]
+            node.suspends = True
+            node.can_raise = True
+            self._route_exc(n, cancel=True)
+            self._route_exc(n, cancel=False)
+        # exceptional exit (__exit__ as rollback/cleanup) — wired into
+        # the frame stack like a finally; outward continuation first
+        cleanup = self.cfg._new(s, WITH_CLEANUP)
+        if isinstance(s, ast.AsyncWith):
+            self.cfg.nodes[cleanup].suspends = True
+        self._route_exc(cleanup, cancel=False)
+        self._route_exc(cleanup, cancel=True)
+        # a return routed through __exit__ continues down the cleanup
+        # chain (an enclosing finally still runs) before leaving
+        ret_target = self._cleanup_chain_target()
+        self.cfg.add_edge(
+            cleanup, self.cfg.exit if ret_target is None else ret_target
+        )
+        self.frames.append(_TryFrame([], cleanup))
+        body_exits = self._stmts(s.body, [n])
+        self.frames.pop()
+        # normal exit (__exit__ as commit)
+        wexit = self.cfg._new(s, WITH_EXIT)
+        if isinstance(s, ast.AsyncWith):
+            self.cfg.nodes[wexit].suspends = True
+        for e in body_exits:
+            self.cfg.add_edge(e, wexit)
+        return [wexit]
+
+    def _build_try(self, s: ast.Try, preds: list[int]) -> list[int]:
+        fin_enter: int | None = None
+        fin_abrupt: int | None = None
+        fin_exits: list[int] = []
+        if s.finalbody:
+            # two copies of the finally body (the CPython compilation
+            # strategy): the NORMAL copy continues to the code after
+            # the try; the ABRUPT copy carries exception propagation
+            # and return/break continuations (its exits go outward and
+            # to EXIT). One shared copy conflated the two and let an
+            # early `return` appear to fall through to the close after
+            # the try — hiding real leaks from SD008/SD016.
+            # Both copies run under the OUTER frames (their own
+            # exceptions propagate past this try).
+            fin_enter = self.cfg._new(s, FINALLY)
+            fin_exits = self._stmts(s.finalbody, [fin_enter])
+            fin_abrupt = self.cfg._new(s, FINALLY)
+            abrupt_exits = self._stmts(s.finalbody, [fin_abrupt])
+            ret_target = self._cleanup_chain_target()
+            for e in abrupt_exits:
+                self._route_exc(e, cancel=False)
+                self._route_exc(e, cancel=True)
+                # the return/break continuation chains through any
+                # enclosing cleanup before leaving the function
+                self.cfg.add_edge(
+                    e, self.cfg.exit if ret_target is None else ret_target
+                )
+        handlers = [
+            _Handler(self.cfg._new(h, HANDLER), h) for h in s.handlers
+        ]
+        frame = _TryFrame(handlers, fin_abrupt)
+        self.frames.append(frame)
+        body_exits = self._stmts(s.body, preds)
+        self.frames.pop()
+        # orelse: runs after an exception-free body; its exceptions see
+        # the finally (abrupt copy) but NOT this try's handlers
+        if s.orelse:
+            if fin_abrupt is not None:
+                self.frames.append(_TryFrame([], fin_abrupt))
+            body_exits = self._stmts(s.orelse, body_exits)
+            if fin_abrupt is not None:
+                self.frames.pop()
+        handler_exits: list[int] = []
+        for h, hinfo in zip(s.handlers, handlers):
+            if fin_abrupt is not None:
+                self.frames.append(_TryFrame([], fin_abrupt))
+            handler_exits += self._stmts(h.body, [hinfo.node])
+            if fin_abrupt is not None:
+                self.frames.pop()
+        if fin_enter is not None:
+            for e in body_exits + handler_exits:
+                self.cfg.add_edge(e, fin_enter)
+            return list(fin_exits)
+        return body_exits + handler_exits
+
+    def _build_return(self, s: ast.Return, preds: list[int]) -> list[int]:
+        n = self._simple(s, preds)
+        target = self._cleanup_chain_target()
+        self.cfg.add_edge(n, self.cfg.exit if target is None else target)
+        return []
+
+    def _build_break_continue(self, s: ast.stmt,
+                              preds: list[int]) -> list[int]:
+        n = self._simple(s, preds)
+        if not self.loops:
+            self.cfg.add_edge(n, self.cfg.exit)  # malformed code; be safe
+            return []
+        loop = self.loops[-1]
+        target = self._cleanup_chain_target(loop["depth"])
+        if target is not None:
+            # a pending finally runs first; its continuation edges
+            # over-approximate where control goes next
+            self.cfg.add_edge(n, target)
+        elif isinstance(s, ast.Break):
+            loop["breaks"].append(n)
+        else:
+            self.cfg.add_edge(n, loop["cont"])
+        return []
+
+    def _build_match(self, s: ast.AST, preds: list[int]) -> list[int]:
+        n = self.cfg._new(s, STMT)
+        for p in preds:
+            self.cfg.add_edge(p, n)
+        self._mark_and_route(n, [s.subject])
+        exits: list[int] = [n]  # no case may match
+        for case in s.cases:
+            exits += self._stmts(case.body, [n])
+        return exits
+
+
+def build_cfg(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> CFG:
+    """Build the statement-level CFG for one function body."""
+    return _Builder(fn).build()

@@ -3,13 +3,20 @@ all share.
 
 Exit codes: 0 clean (every finding baselined), 1 unbaselined findings,
 2 usage/parse/baseline errors. ``--format=json`` emits a machine-stable
-document; text mode is for humans at the terminal.
+document; text mode is for humans at the terminal. With
+``SDLINT_ANNOTATE=1`` in the environment (or ``--annotate``), every
+unbaselined finding is additionally emitted as a GitHub Actions
+annotation (``::error file=…,line=…``) so CI surfaces findings inline
+on the diff. ``--prune-baseline`` removes baseline entries whose
+finding no longer fires — dead entries otherwise accumulate silently
+and hide a *re-introduced* copy of the bug behind a stale key.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -41,6 +48,18 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="rewrite the baseline to cover current findings (existing "
         "justifications are kept; new entries need one filled in)",
+    )
+    p.add_argument(
+        "--prune-baseline",
+        action="store_true",
+        help="remove baseline entries whose finding no longer fires "
+        "(reports what was pruned; exits 0)",
+    )
+    p.add_argument(
+        "--annotate",
+        action="store_true",
+        help="emit GitHub Actions ::error annotations for unbaselined "
+        "findings (also enabled by SDLINT_ANNOTATE=1)",
     )
     p.add_argument(
         "--rules",
@@ -85,6 +104,51 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: {err}", file=sys.stderr)
         return 2
 
+    if args.prune_baseline:
+        try:
+            baseline = Baseline.load(args.baseline)
+        except (BaselineError, json.JSONDecodeError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        _, _, stale = baseline.split(findings)
+        # scope guard: a path- or rules-scoped run did not evaluate
+        # out-of-scope entries, so "didn't fire" means nothing for them.
+        # FILE-rule entries are prunable when their file was analyzed
+        # and their rule ran (a file rule's verdict depends only on its
+        # own file). PROJECT-rule verdicts depend on files anywhere in
+        # the tree (a classify helper, a frozen-class definition, a
+        # caller set) — scoping any of that context out can silently
+        # flip a finding off — so their entries are prunable only when
+        # the entry's whole top-level package was an analysis root.
+        from .core import iter_python_files
+
+        analyzed = {
+            f.as_posix()
+            for root in args.paths
+            for f in iter_python_files(Path(root))
+        }
+        roots = {Path(root).as_posix().rstrip("/") for root in args.paths}
+
+        def prunable(key: str) -> bool:
+            rid, path = key.split(":", 2)[:2]
+            if rule_ids is not None and rid not in rule_ids:
+                return False
+            rule = RULES.get(rid)
+            if rule is not None and rule.check_project is not None:
+                return path.split("/", 1)[0] in roots
+            return path in analyzed
+
+        stale = [key for key in stale if prunable(key)]
+        if not stale:
+            print("prune-baseline: no stale entries")
+            return 0
+        pruned = baseline.prune(args.baseline, stale)
+        for key in pruned:
+            print(f"pruned stale baseline entry: {key}")
+        print(f"prune-baseline: removed {len(pruned)} of "
+              f"{len(pruned) + len(baseline.entries)} entries")
+        return 0
+
     if args.write_baseline:
         baseline = Baseline.load(args.baseline, strict=False)
         baseline.write(args.baseline, findings)
@@ -109,6 +173,17 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: {exc}", file=sys.stderr)
             return 2
         unbaselined, suppressed, stale = baseline.split(findings)
+
+    if args.annotate or os.environ.get("SDLINT_ANNOTATE") == "1":
+        for f in unbaselined:
+            # GitHub annotation format: properties then ::message;
+            # newlines inside the message must be %0A-escaped. Emitted
+            # on STDERR so --format=json stdout stays a parseable
+            # document (the runner scans both streams for commands).
+            msg = f.message.replace("%", "%25").replace("\n", "%0A")
+            print(f"::error file={f.path},line={f.line},"
+                  f"col={f.col + 1},title=sdlint {f.rule}::{msg}",
+                  file=sys.stderr)
 
     if args.fmt == "json":
         doc = {

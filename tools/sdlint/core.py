@@ -171,6 +171,7 @@ class FileContext:
         self._locks: list[LockInfo] | None = None
         self._async_lock_attrs: set[tuple[str | None, str]] | None = None
         self._functions: list[FunctionInfo] | None = None
+        self._cfgs: dict[ast.AST, object] = {}
 
     # -- lazy indexes ------------------------------------------------------
 
@@ -321,6 +322,16 @@ class FileContext:
             if lock.attr == attr:
                 return lock
         return None
+
+    def cfg(self, fn: ast.FunctionDef | ast.AsyncFunctionDef):
+        """The function's control-flow graph (:mod:`tools.sdlint.cfg`),
+        built once and shared by every flow-sensitive rule."""
+        got = self._cfgs.get(fn)
+        if got is None:
+            from .cfg import build_cfg
+
+            got = self._cfgs[fn] = build_cfg(fn)
+        return got
 
     def finding(self, rule_id: str, node: ast.AST, message: str) -> Finding:
         line = getattr(node, "lineno", 1)

@@ -9,3 +9,7 @@ from . import journalrules  # noqa: F401  SD012
 from . import autotunerules  # noqa: F401  SD013
 from . import p2prules  # noqa: F401  SD014
 from . import serverules  # noqa: F401  SD015
+from . import flowrules  # noqa: F401  SD016
+from . import commitorder  # noqa: F401  SD017
+from . import frozenrules  # noqa: F401  SD018
+from . import breakerrules  # noqa: F401  SD019

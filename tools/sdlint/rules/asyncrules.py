@@ -91,34 +91,71 @@ def check_blocking(ctx: FileContext) -> Iterator[Finding]:
 def check_lock_await(ctx: FileContext) -> Iterator[Finding]:
     if not ctx.sync_locks:
         return
+    from ..cfg import WITH_CLEANUP, WITH_EXIT
+
     for info in ctx.functions:
         fn = info.node
         if not isinstance(fn, ast.AsyncFunctionDef):
             continue
+        cfg = ctx.cfg(fn)
+        for cnode in list(cfg.stmt_nodes()):
+            # `with self._lock:` — CFG-search the held region for a
+            # suspension point. Flow-sensitive: the region ends at the
+            # with's exit/cleanup nodes OR an explicit `.release()`, so
+            # an `await` after an early release stays clean while one
+            # reached through any branch/loop inside the region fires.
+            if cnode.kind != "stmt" or not isinstance(cnode.ast, ast.With):
+                continue
+            held = [
+                item.context_expr
+                for item in cnode.ast.items
+                if ctx.lock_for_expr(item.context_expr, at=cnode.ast)
+                is not None
+            ]
+            if not held:
+                continue
+            lock_name = dotted_name(held[0]) or "lock"
+            ends = {
+                n.idx for n in cfg.nodes
+                if n.kind in (WITH_EXIT, WITH_CLEANUP)
+                and n.ast is cnode.ast
+            }
+
+            def _releases(nd, _name=lock_name) -> bool:
+                if nd.ast is None or nd.kind != "stmt":
+                    return False
+                for call in ast.walk(nd.ast):
+                    if isinstance(call, ast.Call) and isinstance(
+                        call.func, ast.Attribute
+                    ) and call.func.attr == "release" and dotted_name(
+                            call.func.value) == _name:
+                        return True
+                return False
+
+            starts = [t for t, kind in cfg.succs[cnode.idx]
+                      if kind == "normal"]
+            visited = cfg.search(
+                starts,
+                stop=lambda nd: nd.idx in ends or _releases(nd),
+            )
+            suspenders = sorted(
+                (cfg.nodes[i] for i in visited
+                 if cfg.nodes[i].suspends and i not in ends),
+                key=lambda nd: nd.line,
+            )
+            if suspenders:
+                yield ctx.finding(
+                    "SD002",
+                    cnode.ast,
+                    f"`await` at line {suspenders[0].line} while "
+                    f"holding sync lock `{lock_name}` in async "
+                    f"`{info.qualname}` — release before awaiting "
+                    f"or use `asyncio.Lock`",
+                )
+        # blocking lock.acquire() on the loop thread (the acquire
+        # itself is the bug, wherever control flows after)
         for node in walk_shallow(fn):
-            # `with self._lock:` whose body awaits
-            if isinstance(node, ast.With):
-                held = [
-                    item.context_expr
-                    for item in node.items
-                    if ctx.lock_for_expr(item.context_expr, at=node) is not None
-                ]
-                if not held:
-                    continue
-                for inner in walk_shallow(node):
-                    if isinstance(inner, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
-                        lock_name = dotted_name(held[0]) or "lock"
-                        yield ctx.finding(
-                            "SD002",
-                            node,
-                            f"`await` at line {inner.lineno} while holding "
-                            f"sync lock `{lock_name}` in async "
-                            f"`{info.qualname}` — release before awaiting "
-                            f"or use `asyncio.Lock`",
-                        )
-                        break
-            # blocking lock.acquire() on the loop thread
-            elif (
+            if (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr == "acquire"

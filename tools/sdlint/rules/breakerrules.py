@@ -1,0 +1,127 @@
+"""SD019 — breaker-feed discipline for ResiliencePolicy sites.
+
+A circuit breaker measures *target health*. An answered-but-negative
+reply — an HTTP 4xx, a membership refusal, a malformed-request
+rejection — is proof the target is ALIVE; counting it as a breaker
+failure opens the circuit against a healthy dependency. That is the
+federation-relay bug PR 6 fixed (the relay leg re-hammered a live
+relay as "dead" after a few 4xxs) and the FILE_POLICY bug PR 9 fixed
+(a not-found answer fed the peer's breaker).
+
+The default classifier can't know a policy's answered-negative
+vocabulary, so every ``ResiliencePolicy(...)`` construction must pass
+a ``classify`` whose code can actually return ``PASS``:
+
+- no ``classify=`` kwarg at all → finding (every negative answer will
+  feed the breaker);
+- ``classify=`` resolving to a lambda or a same-/imported-module
+  function with no reachable ``PASS`` result → finding;
+- an unresolvable ``classify`` (attribute on an object, dynamic) is
+  given the benefit of the doubt.
+
+A policy whose legs genuinely cannot receive answered-negative replies
+(pure transport, failures only) is exactly what the baseline with a
+written justification is for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    FileContext,
+    Finding,
+    ProjectContext,
+    call_name,
+    rule,
+    walk_shallow,
+)
+from ..summaries import CallGraph
+
+
+def _mentions_pass(expr: ast.AST | None) -> bool:
+    if expr is None:
+        return False
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id == "PASS":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "PASS":
+            return True
+        if isinstance(node, ast.Constant) and node.value == "pass":
+            return True
+    return False
+
+
+def _fn_can_pass(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for node in walk_shallow(fn):
+        if isinstance(node, ast.Return) and _mentions_pass(node.value):
+            return True
+    return False
+
+
+@rule(
+    "SD019",
+    "breaker-feed-discipline",
+    "every ResiliencePolicy must carry a classify that can return PASS "
+    "for answered-but-negative replies (4xx, refusals) — otherwise a "
+    "healthy target's rejections open its breaker",
+    project=True,
+)
+def check_breaker_feed(project: ProjectContext) -> Iterator[Finding]:
+    graph = CallGraph.of(project)
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            if name.rsplit(".", 1)[-1] != "ResiliencePolicy":
+                continue
+            classify = None
+            for kw in node.keywords:
+                if kw.arg == "classify":
+                    classify = kw.value
+                    break
+            if classify is None:
+                yield ctx.finding(
+                    "SD019", node,
+                    "ResiliencePolicy without a classify= — the default "
+                    "classifier feeds every answered-but-negative reply "
+                    "(4xx, refusal) to the breaker, opening it against a "
+                    "healthy target; pass a classify that can return "
+                    "PASS (or baseline with why this policy's legs "
+                    "cannot receive answered-negative replies)",
+                )
+                continue
+            if isinstance(classify, ast.Lambda):
+                if not _mentions_pass(classify.body):
+                    yield ctx.finding(
+                        "SD019", node,
+                        "ResiliencePolicy classify lambda can never "
+                        "return PASS — answered-but-negative replies "
+                        "(4xx, refusals) will feed the breaker",
+                    )
+                continue
+            if isinstance(classify, (ast.Name, ast.Attribute)):
+                target = None
+                cname = None
+                if isinstance(classify, ast.Name):
+                    cname = classify.id
+                else:
+                    from ..core import dotted_name
+
+                    cname = dotted_name(classify)
+                if cname is not None:
+                    target = graph.resolve_name(ctx, cname, node)
+                if target is None:
+                    continue  # dynamic/foreign: benefit of the doubt
+                _tctx, tinfo = target
+                if not _fn_can_pass(tinfo.node):
+                    yield ctx.finding(
+                        "SD019", node,
+                        f"ResiliencePolicy classify `{cname}` has no "
+                        f"reachable `return PASS` — answered-but-"
+                        f"negative replies (4xx, refusals) will feed "
+                        f"the breaker and open it against a healthy "
+                        f"target",
+                    )

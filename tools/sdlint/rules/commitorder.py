@@ -1,0 +1,265 @@
+"""SD017 — commit-ordering: vouches must follow the commit they vouch.
+
+The PR 7 truth discipline, machine-checked: an index-journal write, a
+sync watermark advance, or a ``sd_sync_ops_total`` bump is a *vouch* —
+a durable or observable claim that some store/DB commit happened. A
+vouch that can execute on a path where the commit did NOT happen is a
+lie waiting for a crash: the journal swears by a cas that was rolled
+back, the watermark advances past ops that were never stored (the
+PR 10 write-combined-ingest invariant), replication metrics count
+phantom ops.
+
+Mechanically: every **vouch site** must be *dominated* (CFG) by a
+**commit site** —
+
+- vouch sites: ``<journal-ish>.record*(...)`` calls (receiver mentions
+  ``journal``/``Journal``), ``SYNC_WATERMARK.set(...)``,
+  ``SYNC_OPS.inc(...)``;
+- commit sites: the WITH_EXIT of ``with *.transaction():`` (the commit
+  happens when the block *exits* — a vouch inside the block is before
+  the commit, and the exceptional exit is a rollback and deliberately
+  not a commit node), ``*.commit()`` calls, ``db.execute*`` on the
+  autocommitting Database facade (receiver tail ``db``/``database`` —
+  ``conn.execute`` inside an open transaction is NOT a commit), and
+  calls into functions whose summary says they commit (compositional,
+  over the project call graph).
+
+Inter-procedural half: a function whose vouch is not locally dominated
+becomes a *vouch carrier* — the obligation moves to its call sites,
+recursively (``_finalize(...)`` called after the transaction block is
+fine; called on a path that skipped the transaction is a finding). A
+carrier with no resolvable callers is reported at the original vouch
+site: nothing proves the ordering anywhere.
+
+The index-journal module itself owns the raw writes and is allowlisted
+(same stance as SD012's stat ownership).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..cfg import WITH_EXIT
+from ..core import (
+    FileContext,
+    Finding,
+    FunctionInfo,
+    ProjectContext,
+    call_name,
+    dotted_name,
+    rule,
+    walk_shallow,
+)
+from ..summaries import CallGraph
+
+#: module that owns raw journal writes (vouch implementation, not use)
+ALLOWLIST_FRAGMENTS = ("location/indexer/journal.py",)
+
+#: metric handles whose writes finalize a sync commit
+_SYNC_FINALIZE_HANDLES = ("SYNC_WATERMARK", "SYNC_OPS")
+
+#: autocommitting DB facade receivers (tail segment)
+_DB_TAILS = ("db", "database")
+
+
+def _mentions_journal(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident is not None and "journal" in ident.lower():
+            return True
+    return False
+
+
+def _vouch_of(call: ast.Call) -> str | None:
+    """A human-readable tag when ``call`` is a vouch site, else None."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr.startswith("record") and _mentions_journal(call.func.value):
+        return f"journal.{attr}"
+    if attr in ("set", "inc"):
+        recv = dotted_name(call.func.value) or ""
+        tail = recv.rsplit(".", 1)[-1]
+        if tail in _SYNC_FINALIZE_HANDLES:
+            return f"{tail}.{attr}"
+    return None
+
+
+def _is_transaction_with(stmt: ast.AST) -> bool:
+    if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return False
+    for item in stmt.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            name = call_name(expr) or ""
+            if name.rsplit(".", 1)[-1] == "transaction":
+                return True
+    return False
+
+
+def _is_commit_call(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    attr = call.func.attr
+    if attr == "commit":
+        return True
+    if attr == "write_ops":
+        # SyncManager.write_ops is THE transactional write seam (domain
+        # rows + op log in one transaction) — it is always reached via
+        # a `library.sync` local, which name-based call resolution
+        # cannot follow, so the name itself is the commit marker
+        return True
+    if attr in ("execute", "executemany", "executescript"):
+        recv = dotted_name(call.func.value) or ""
+        tail = recv.rsplit(".", 1)[-1]
+        return tail in _DB_TAILS
+    return False
+
+
+def _stmt_calls(stmt: ast.AST) -> Iterator[ast.Call]:
+    """Call expressions evaluated by one CFG node's statement header."""
+    from .flowrules import walk_shallow_stmt
+
+    for node in walk_shallow_stmt(stmt):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _function_commits(graph: CallGraph):
+    """summary_of(ctx, info) -> True when the function (transitively)
+    contains a commit site."""
+
+    def compute(ctx: FileContext, info: FunctionInfo, summary_of) -> bool:
+        for node in walk_shallow(info.node):
+            if _is_transaction_with(node):
+                return True
+            if isinstance(node, ast.Call):
+                if _is_commit_call(node):
+                    return True
+                resolved = graph.resolve(ctx, node, node)
+                if resolved is not None and summary_of(*resolved):
+                    return True
+        return False
+
+    return graph.summarize(compute, default=False)
+
+
+def _commit_nodes(ctx: FileContext, cfg, commits_summary, graph) -> set[int]:
+    """CFG nodes after which a commit has definitely happened."""
+    out: set[int] = set()
+    for node in cfg.nodes:
+        if node.ast is None:
+            continue
+        if node.kind == WITH_EXIT and _is_transaction_with(node.ast):
+            out.add(node.idx)
+            continue
+        if node.kind not in ("stmt",):
+            continue
+        for call in _stmt_calls(node.ast):
+            if _is_commit_call(call):
+                out.add(node.idx)
+                break
+            resolved = graph.resolve(ctx, call, call)
+            if resolved is not None and commits_summary(*resolved):
+                out.add(node.idx)
+                break
+    return out
+
+
+@rule(
+    "SD017",
+    "vouch-before-commit",
+    "journal vouches / sync watermark advances / sync-op metric bumps "
+    "must be dominated by the store or DB commit they vouch for — a "
+    "vouch reachable without its commit lies after a crash or rollback "
+    "(inter-procedural via call-graph summaries)",
+    project=True,
+)
+def check_commit_ordering(project: ProjectContext) -> Iterator[Finding]:
+    graph = CallGraph.of(project)
+    commits = _function_commits(graph)
+
+    # pass 1: local verdicts. For each function: vouch sites that are
+    # locally dominated are fine; the rest make the function a carrier.
+    carriers: dict[tuple[str, str], list[tuple[FileContext, ast.AST, str]]] = {}
+    for ctx in project.files:
+        if any(frag in ctx.path for frag in ALLOWLIST_FRAGMENTS):
+            continue
+        for info in ctx.functions:
+            cfg = ctx.cfg(info.node)
+            vouches: list[tuple[int, ast.AST, str]] = []
+            for node in cfg.nodes:
+                if node.ast is None or node.kind != "stmt":
+                    continue
+                for call in _stmt_calls(node.ast):
+                    tag = _vouch_of(call)
+                    if tag is not None:
+                        vouches.append((node.idx, node.ast, tag))
+            if not vouches:
+                continue
+            commit_idxs = _commit_nodes(ctx, cfg, commits, graph)
+            for idx, site, tag in vouches:
+                if not cfg.dominated_by(idx, commit_idxs):
+                    carriers.setdefault(
+                        (ctx.path, info.qualname), []
+                    ).append((ctx, site, tag))
+
+    # pass 2: push carrier obligations up the call graph. A carrier's
+    # call site must be dominated by a commit in ITS function, else the
+    # caller becomes a carrier too; running out of callers reports.
+    reported: set[tuple[str, int, str]] = set()
+    work = list(carriers.items())
+    seen: set[tuple[str, str]] = set(carriers)
+    while work:
+        (path, qual), sites = work.pop(0)
+        ctx = graph.modules[path]
+        info = graph.functions[(path, qual)]
+        callers = graph.callers_of(ctx, info)
+        if not callers:
+            for vctx, vsite, tag in sites:
+                key = (vctx.path, vsite.lineno, tag)
+                if key not in reported:
+                    reported.add(key)
+                    yield vctx.finding(
+                        "SD017", vsite,
+                        f"`{tag}` vouch is not dominated by the commit it "
+                        f"vouches for (and `{qual}` has no analyzed caller "
+                        f"that proves the ordering) — move the vouch after "
+                        f"the transaction/store commit",
+                    )
+            continue
+        for cctx, cinfo, call in callers:
+            if any(frag in cctx.path for frag in ALLOWLIST_FRAGMENTS):
+                continue
+            cfg = cctx.cfg(cinfo.node)
+            # the CFG node evaluating this call expression
+            call_idx = None
+            for node in cfg.nodes:
+                if node.ast is None or node.kind != "stmt":
+                    continue
+                if any(c is call for c in _stmt_calls(node.ast)):
+                    call_idx = node.idx
+                    break
+            if call_idx is None:
+                continue
+            commit_idxs = _commit_nodes(cctx, cfg, commits, graph)
+            if cfg.dominated_by(call_idx, commit_idxs):
+                continue
+            ckey = (cctx.path, cinfo.qualname)
+            tags = sorted({t for _, _, t in sites})
+            if ckey in seen:
+                # the caller is already a carrier (own vouches or
+                # another callee), so ITS call sites are being checked
+                # for commit dominance — and a caller that dominates
+                # its call covers every obligation inside, this one
+                # included. Re-reporting here would flag call sites
+                # whose callers are in fact provably ordered.
+                continue
+            seen.add(ckey)
+            entry = [(cctx, call, f"{qual}→{t}") for t in tags]
+            work.append((ckey, entry))

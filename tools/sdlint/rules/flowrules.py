@@ -1,0 +1,238 @@
+"""SD016 — cancellation-unsafe async resource flow.
+
+The PR 10 bug class, machine-checked: an async function acquires a
+budgeted resource (an ``asyncio`` semaphore/lock permit via ``await
+x.acquire()``, or a counter-style slot like ``self.inflight[klass] +=
+1``), and some path out of the function — a ``return``, an exception
+from a later call, or **CancelledError delivered at an intervening
+``await``** — escapes without the matching release. A client
+disconnect then permanently shrinks the budget: exactly how the
+admission gate leaked slots until its post-review hardening.
+
+What counts as an acquire/release protocol (repo-tuned, to keep the
+rule quiet on ordinary code):
+
+- ``await X.acquire()`` paired with ``X.release()`` on the same
+  receiver. An acquire with NO release anywhere in the function is a
+  cross-method protocol (``__aenter__``-style) and is skipped — SD008
+  already polices the sync flavor the same way.
+- ``T += <const>`` paired with ``T -= <const>`` on the *same
+  normalized target* (``self.inflight[klass]``), where at least one
+  decrement is CFG-reachable from the increment. Reachability is the
+  protocol discriminator: a controller nudging a knob ``+= 1`` in one
+  branch and ``-= 1`` in a *sibling* branch is tuning, not a resource.
+
+The check itself is pure CFG: from the acquire's normal successors,
+search forward stopping at release nodes; reaching EXIT or RAISE means
+some path leaks. The witness node makes the message concrete — "leaks
+on the CancelledError path out of the await at line N" names the exact
+suspension point the PR 10 incident taught us to fear.
+
+``async with x:`` / ``with x:`` resources are structurally safe and
+never tracked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..cfg import CFG, EXC
+from ..core import FileContext, Finding, dotted_name, rule, walk_shallow
+
+
+def _target_key(node: ast.AST) -> str | None:
+    """Normalized text for an augmented-assignment target: the dotted
+    receiver plus any literal/name subscript — ``self.inflight[klass]``.
+    None for targets too dynamic to pair reliably."""
+    if isinstance(node, ast.Subscript):
+        base = _target_key(node.value)
+        if base is None:
+            return None
+        sl = node.slice
+        if isinstance(sl, ast.Constant):
+            return f"{base}[{sl.value!r}]"
+        inner = dotted_name(sl)
+        if inner is not None:
+            return f"{base}[{inner}]"
+        return None
+    name = dotted_name(node)
+    return name
+
+
+def _const_step(value: ast.AST) -> bool:
+    return isinstance(value, ast.Constant) and isinstance(
+        value.value, (int, float)
+    )
+
+
+def _stmt_of(cfg: CFG, ast_node: ast.AST) -> int | None:
+    return cfg.by_ast.get(ast_node)
+
+
+def _escape(
+    cfg: CFG, acquire_idx: int, releases: set
+) -> tuple[str, int, int] | None:
+    """Does some path from ``acquire_idx`` reach EXIT/RAISE without
+    passing a release statement?  ``releases`` holds release-site AST
+    statements (AST identity, not node index: a finally-resident
+    release exists as two CFG nodes — normal and abrupt copy — sharing
+    one AST, and both must stop the search). Returns ``(how,
+    witness_line, sink)`` for the first escaping path found, None when
+    every path releases."""
+    # the acquire's own failure edges don't count: if the acquire
+    # raised, nothing was held
+    starts = [t for t, kind in cfg.succs[acquire_idx] if kind != EXC]
+    visited = cfg.search(
+        starts, stop=lambda nd: nd.ast is not None and nd.ast in releases
+    )
+    for sink in (cfg.raise_, cfg.exit):
+        if sink not in visited:
+            continue
+        # walk the witness back to the edge that escaped
+        cur, via = sink, visited[sink]
+        while via is not None:
+            parent, kind = via
+            node = cfg.nodes[parent]
+            if sink == cfg.raise_ and cur == sink:
+                how = "cancel" if (kind == EXC and node.suspends) else "exc"
+                return how, node.line, sink
+            cur, via = parent, visited[parent]
+        # escaped straight from a start node (acquire's direct succ)
+        if sink == cfg.raise_:
+            return "exc", cfg.nodes[acquire_idx].line, sink
+        return "return", cfg.nodes[acquire_idx].line, sink
+    return None
+
+
+def _describe(qualname: str, what: str,
+              esc: tuple[str, int, int]) -> str:
+    how, line, sink = esc
+    if how == "cancel":
+        path = (f"the CancelledError path out of the `await` at line "
+                f"{line}")
+    elif how == "exc":
+        path = f"the exception path out of line {line}"
+    else:
+        path = "a return path"
+    return (
+        f"{what} in async `{qualname}` is not released on {path} — a "
+        f"cancelled or failed request permanently shrinks the budget; "
+        f"release in a `finally` (or start the try before any code that "
+        f"can raise)"
+    )
+
+
+@rule(
+    "SD016",
+    "cancellation-unsafe-resource",
+    "an acquired slot/semaphore/lease in an async function must be "
+    "released on every path out of the scope, including the "
+    "CancelledError path out of an intervening await (the PR 10 "
+    "admission-slot leak class)",
+)
+def check_cancellation_unsafe(ctx: FileContext) -> Iterator[Finding]:
+    for info in ctx.functions:
+        fn = info.node
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        if fn.name in ("__aenter__", "__aexit__", "__enter__", "__exit__"):
+            continue  # cross-method protocols live at the caller's with
+        cfg = ctx.cfg(fn)
+
+        # --- awaited acquire / release pairs --------------------------
+        acquires: dict[str, list[ast.AST]] = {}
+        releases: dict[str, list[ast.AST]] = {}
+        incs: dict[str, list[ast.AST]] = {}
+        decs: dict[str, list[ast.AST]] = {}
+        for stmt_ast, idx in cfg.by_ast.items():
+            for node in walk_shallow_stmt(stmt_ast):
+                if isinstance(node, ast.Await) and isinstance(
+                    node.value, ast.Call
+                ) and isinstance(node.value.func, ast.Attribute) \
+                        and node.value.func.attr == "acquire":
+                    recv = dotted_name(node.value.func.value)
+                    if recv is not None:
+                        acquires.setdefault(recv, []).append(stmt_ast)
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ) and node.func.attr == "release":
+                    recv = dotted_name(node.func.value)
+                    if recv is not None:
+                        releases.setdefault(recv, []).append(stmt_ast)
+            if isinstance(stmt_ast, ast.AugAssign) and _const_step(
+                    stmt_ast.value):
+                key = _target_key(stmt_ast.target)
+                if key is None:
+                    continue
+                if isinstance(stmt_ast.op, ast.Add):
+                    incs.setdefault(key, []).append(stmt_ast)
+                elif isinstance(stmt_ast.op, ast.Sub):
+                    decs.setdefault(key, []).append(stmt_ast)
+
+        for recv, acq_sites in sorted(acquires.items()):
+            rel_sites = releases.get(recv)
+            if not rel_sites:
+                continue  # cross-method protocol: SD008's stance
+            rel_asts = set(rel_sites)
+            for site in acq_sites:
+                idx = _stmt_of(cfg, site)
+                if idx is None:
+                    continue
+                esc = _escape(cfg, idx, rel_asts)
+                if esc is not None:
+                    yield ctx.finding(
+                        "SD016", site,
+                        _describe(info.qualname,
+                                  f"`await {recv}.acquire()`", esc),
+                    )
+
+        # --- counter-slot protocols -----------------------------------
+        for key, inc_sites in sorted(incs.items()):
+            dec_sites = decs.get(key)
+            if not dec_sites:
+                continue
+            dec_asts = set(dec_sites)
+            for site in inc_sites:
+                idx = _stmt_of(cfg, site)
+                if idx is None:
+                    continue
+                # protocol discriminator: some decrement must be
+                # reachable from this increment, else it's a knob
+                # nudged in sibling branches, not an acquire
+                reach = cfg.search([t for t, _ in cfg.succs[idx]])
+                if not any(cfg.nodes[i].ast in dec_asts for i in reach):
+                    continue
+                esc = _escape(cfg, idx, dec_asts)
+                if esc is not None:
+                    yield ctx.finding(
+                        "SD016", site,
+                        _describe(info.qualname,
+                                  f"slot `{key} += 1`", esc),
+                    )
+
+
+def walk_shallow_stmt(stmt: ast.AST) -> Iterator[ast.AST]:
+    """Walk one statement's own expressions: for compound statements
+    only the header (their bodies are separate CFG nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        roots: list[ast.AST] = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        roots = [stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        roots = [item.context_expr for item in stmt.items]
+    elif isinstance(stmt, ast.Try):
+        roots = []
+    elif isinstance(stmt, ast.ExceptHandler):
+        # the HANDLER node only models exception matching; its body
+        # statements are separate CFG nodes — walking them here would
+        # attribute a handler-resident release to the handler header
+        # and stop leak searches at the wrong node
+        roots = []
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        roots = []
+    else:
+        roots = [stmt]
+    for root in roots:
+        yield from walk_shallow(root)

@@ -1,0 +1,196 @@
+"""SD018 — attribute stores on frozen-dataclass instances.
+
+The delta-guard latent bug class: ``CRDTOperation`` and friends are
+``@dataclass(frozen=True)`` — shared, hash-stable value objects that
+ride wires and op logs. An attribute store on one doesn't corrupt
+state; it raises ``FrozenInstanceError`` *at runtime, on the path that
+tried it* — which for the delta guard was the rarely-exercised
+rejection path, so the crash shipped and sat latent until PR 10's
+review. Static typing would catch it; this rule is the stdlib-ast
+version:
+
+- inventory every ``@dataclass(frozen=True)`` class in the analyzed
+  tree (project rule — the class and the mutation are usually in
+  different modules);
+- in each function, collect names whose static type is one of them:
+  parameters with a matching annotation (``op: CRDTOperation``,
+  ``Optional[CRDTOperation]``, ``"CRDTOperation"`` strings, unions),
+  locals assigned from ``FrozenClass(...)`` or a
+  ``FrozenClass.factory(...)`` classmethod, and ``for x in xs:`` where
+  ``xs`` is a parameter annotated as a container of a frozen class;
+- flag ``x.attr = ...`` / ``x.attr += ...`` / ``del x.attr`` on those
+  names.
+
+``object.__setattr__`` inside the class's own ``__post_init__`` is the
+documented escape hatch and is not matched (it isn't an attribute-store
+statement). ``dataclasses.replace`` is the sanctioned mutation idiom —
+the fix this rule wants is "return the new value, don't stash it".
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    FileContext,
+    Finding,
+    ProjectContext,
+    call_name,
+    dotted_name,
+    rule,
+    walk_shallow,
+)
+
+
+def _is_frozen_dataclass(cls: ast.ClassDef) -> bool:
+    for deco in cls.decorator_list:
+        if not isinstance(deco, ast.Call):
+            continue
+        name = call_name(deco) or ""
+        if name.rsplit(".", 1)[-1] != "dataclass":
+            continue
+        for kw in deco.keywords:
+            if kw.arg == "frozen" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return True
+    return False
+
+
+def frozen_classes(project: ProjectContext) -> set[str]:
+    got = getattr(project, "_frozen_classes", None)
+    if got is None:
+        got = set()
+        for ctx in project.files:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ClassDef) and _is_frozen_dataclass(node):
+                    got.add(node.name)
+        project._frozen_classes = got  # type: ignore[attr-defined]
+    return got
+
+
+def _annotation_names(ann: ast.AST | None) -> Iterator[str]:
+    """Class names mentioned by a (possibly wrapped) annotation:
+    ``X``, ``mod.X``, ``Optional[X]``, ``X | None``, ``"X"``."""
+    if ann is None:
+        return
+    stack = [ann]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Name):
+            yield cur.id
+        elif isinstance(cur, ast.Attribute):
+            yield cur.attr
+        elif isinstance(cur, ast.Constant) and isinstance(cur.value, str):
+            for tok in cur.value.replace("|", " ").replace("[", " ") \
+                    .replace("]", " ").replace(",", " ").split():
+                yield tok.rsplit(".", 1)[-1]
+        else:
+            stack.extend(ast.iter_child_nodes(cur))
+
+
+_CONTAINER_HEADS = {"list", "List", "set", "Set", "tuple", "Tuple",
+                    "Sequence", "Iterable", "Iterator", "Collection",
+                    "frozenset", "FrozenSet", "deque"}
+
+
+def _container_element(ann: ast.AST | None) -> Iterator[str]:
+    """Element class names when the annotation is a container of them."""
+    if isinstance(ann, ast.Subscript):
+        head = dotted_name(ann.value) or ""
+        if head.rsplit(".", 1)[-1] in _CONTAINER_HEADS:
+            yield from _annotation_names(ann.slice)
+    elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        text = ann.value
+        if any(text.startswith(h + "[") for h in _CONTAINER_HEADS):
+            yield from _annotation_names(ann)
+
+
+def _frozen_bindings(fn, frozen: set[str]) -> dict[str, str]:
+    """name -> frozen class it is statically known to hold."""
+    out: dict[str, str] = {}
+    args = list(fn.args.posonlyargs) + list(fn.args.args) + \
+        list(fn.args.kwonlyargs)
+    for arg in args:
+        for name in _annotation_names(arg.annotation):
+            if name in frozen:
+                out[arg.arg] = name
+                break
+    iter_sources: dict[str, str] = {}
+    for arg in args:
+        for name in _container_element(arg.annotation):
+            if name in frozen:
+                iter_sources[arg.arg] = name
+                break
+    for node in walk_shallow(fn):
+        if isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            for name in _annotation_names(node.annotation):
+                if name in frozen:
+                    out[node.target.id] = name
+                    break
+        elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            cname = call_name(node.value) or ""
+            head, _, tail = cname.partition(".")
+            cls = None
+            if head in frozen and (not tail or "." not in tail):
+                # FrozenClass(...) or FrozenClass.factory(...)
+                cls = head
+            elif tail and tail.rsplit(".", 1)[0] in frozen:
+                cls = tail.rsplit(".", 1)[0]
+            if cls is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = cls
+        elif isinstance(node, (ast.For, ast.AsyncFor)) and isinstance(
+                node.target, ast.Name) and isinstance(node.iter, ast.Name):
+            cls = iter_sources.get(node.iter.id)
+            if cls is not None:
+                out[node.target.id] = cls
+    return out
+
+
+@rule(
+    "SD018",
+    "frozen-dataclass-mutation",
+    "attribute stores on frozen-dataclass instances raise "
+    "FrozenInstanceError on whatever path reaches them — return the "
+    "new value or use dataclasses.replace (the delta-guard latent bug "
+    "class)",
+    project=True,
+)
+def check_frozen_mutation(project: ProjectContext) -> Iterator[Finding]:
+    frozen = frozen_classes(project)
+    if not frozen:
+        return
+    for ctx in project.files:
+        for info in ctx.functions:
+            bindings = _frozen_bindings(info.node, frozen)
+            if not bindings:
+                continue
+            for node in walk_shallow(info.node):
+                targets: list[ast.AST] = []
+                verb = "assignment to"
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                elif isinstance(node, ast.Delete):
+                    targets = node.targets
+                    verb = "delete of"
+                for tgt in targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)):
+                        continue
+                    cls = bindings.get(tgt.value.id)
+                    if cls is None:
+                        continue
+                    yield ctx.finding(
+                        "SD018", node,
+                        f"{verb} `{tgt.value.id}.{tgt.attr}` but "
+                        f"`{tgt.value.id}` is a frozen dataclass "
+                        f"(`{cls}`) — this raises FrozenInstanceError "
+                        f"at runtime; return the value or use "
+                        f"dataclasses.replace",
+                    )

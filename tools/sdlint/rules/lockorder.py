@@ -91,12 +91,113 @@ class _ModuleLocks:
                     if lock is not None:
                         acquired.add(_lock_id(self.ctx, lock))
             elif isinstance(node, ast.Call):
+                # explicit `X.acquire()` calls count too (the old
+                # with-only summary was blind to manual protocols)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "acquire":
+                    lock = self.resolve_lock(node.func.value, node)
+                    if lock is not None:
+                        acquired.add(_lock_id(self.ctx, lock))
+                        continue
                 callee = self.resolve_call(node, node)
                 if callee is not None:
                     acquired |= self.locks_acquired(callee)
         self._in_progress.discard(qualname)
         self._summaries[qualname] = acquired
         return acquired
+
+
+class _ModuleBody:
+    """FunctionInfo-shaped wrapper so the module's own top-level (and
+    class-body) statements replay through the same CFG machinery —
+    ``build_cfg`` only reads ``.body`` off the node it is given, and an
+    ``ast.Module`` has one."""
+
+    def __init__(self, tree: ast.Module):
+        self.node = tree
+        self.qualname = "<module>"
+        self.owner = None
+
+
+def _replay_function(
+    ctx: FileContext,
+    mod: _ModuleLocks,
+    info,
+    edges: dict[tuple[str, str], tuple[FileContext, ast.AST]],
+) -> None:
+    """CFG dataflow replay of one function: the in-state at every node
+    is the may-held lock set (union over paths), so manual
+    ``X.acquire()`` / ``X.release()`` protocols, early returns, and
+    loops all order correctly — the old AST walk only understood
+    ``with`` nesting. ``with``-items still evaluate before their lock
+    is held, items acquire left-to-right, and both with-exits (normal
+    commit and exceptional cleanup) release."""
+    from ..cfg import WITH_CLEANUP, WITH_EXIT, solve_forward
+    from .flowrules import walk_shallow_stmt
+
+    fn = info.node
+    cfg = ctx.cfg(fn)
+
+    def transfer(node, state: frozenset, record: bool = False) -> frozenset:
+        held = set(state)
+        a = node.ast
+        if node.kind in (WITH_EXIT, WITH_CLEANUP):
+            for item in a.items:
+                lock = mod.resolve_lock(item.context_expr, a)
+                if lock is not None:
+                    held.discard(_lock_id(ctx, lock))
+            return frozenset(held)
+        if a is None or node.kind not in ("stmt",):
+            return frozenset(held)
+
+        def handle_call(call: ast.Call) -> None:
+            if isinstance(call.func, ast.Attribute):
+                if call.func.attr == "acquire":
+                    lock = mod.resolve_lock(call.func.value, call)
+                    if lock is not None:
+                        lid = _lock_id(ctx, lock)
+                        if record:
+                            for h in held:
+                                edges.setdefault((h, lid), (ctx, call))
+                        held.add(lid)
+                        return
+                elif call.func.attr == "release":
+                    lock = mod.resolve_lock(call.func.value, call)
+                    if lock is not None:
+                        held.discard(_lock_id(ctx, lock))
+                        return
+            callee = mod.resolve_call(call, call)
+            if callee is not None and held:
+                for lid in mod.locks_acquired(callee):
+                    if record:
+                        for h in held:
+                            edges.setdefault((h, lid), (ctx, call))
+
+        if isinstance(a, (ast.With, ast.AsyncWith)):
+            for item in a.items:
+                # the item expression evaluates BEFORE its lock is
+                # held: `with helper(), _a:` runs helper() lock-free
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        handle_call(sub)
+                lock = mod.resolve_lock(item.context_expr, a)
+                if lock is not None:
+                    lid = _lock_id(ctx, lock)
+                    if record:
+                        for h in held:
+                            edges.setdefault((h, lid), (ctx, a))
+                    # items acquire left-to-right: `with a, b:` orders
+                    # a before b just like nested withs
+                    held.add(lid)
+        else:
+            for sub in walk_shallow_stmt(a):
+                if isinstance(sub, ast.Call):
+                    handle_call(sub)
+        return frozenset(held)
+
+    in_states = solve_forward(cfg, frozenset(), transfer)
+    for node in cfg.nodes:
+        transfer(node, in_states[node.idx], record=True)
 
 
 @rule(
@@ -117,45 +218,12 @@ def check_lock_order(project: ProjectContext) -> Iterator[Finding]:
         mod = _ModuleLocks(ctx)
         for lock in ctx.sync_locks:
             reentrant[_lock_id(ctx, lock)] = lock.reentrant
-
-        def visit(node: ast.AST, held: list[str]) -> None:
-            for child in ast.iter_child_nodes(node):
-                visit_node(child, held)
-
-        def visit_node(child: ast.AST, held: list[str]) -> None:
-            if isinstance(child, (ast.With, ast.AsyncWith)):
-                got = 0
-                for item in child.items:
-                    # the item expression evaluates BEFORE its lock is
-                    # held: `with helper(), _a:` runs helper() lock-free
-                    visit_node(item.context_expr, held)
-                    lock = mod.resolve_lock(item.context_expr, child)
-                    if lock is None:
-                        continue
-                    lid = _lock_id(ctx, lock)
-                    for h in held:
-                        edges.setdefault((h, lid), (ctx, child))
-                    # items acquire left-to-right: `with a, b:` orders
-                    # a before b just like nested withs
-                    held.append(lid)
-                    got += 1
-                for stmt in child.body:
-                    visit_node(stmt, held)
-                del held[len(held) - got:]
-            elif isinstance(child, ast.Call):
-                callee = mod.resolve_call(child, child)
-                if callee is not None and held:
-                    for lid in mod.locks_acquired(callee):
-                        for h in held:
-                            edges.setdefault((h, lid), (ctx, child))
-                visit(child, held)
-            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                # a nested def does not run where it is defined
-                visit(child, [])
-            else:
-                visit(child, held)
-
-        visit(ctx.tree, [])
+        for info in ctx.functions:
+            _replay_function(ctx, mod, info, edges)
+        # module-level (and class-body) code runs at import time and
+        # orders locks like any function — the old whole-tree walk saw
+        # it, so the CFG replay must too
+        _replay_function(ctx, mod, _ModuleBody(ctx.tree), edges)
 
     # self-edges: re-acquiring a non-reentrant lock while held
     for (a, b), (ctx, site) in sorted(edges.items()):

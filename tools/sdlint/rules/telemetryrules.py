@@ -318,10 +318,18 @@ _OPEN_BUILTIN_CLOSERS = {"close"}
     "SD008",
     "unclosed-on-exception",
     "manually paired open/close (acquire/release, __enter__/__exit__, "
-    "open/close) where the close is not in a `finally` leaks the resource "
-    "on the exception path",
+    "open/close) where some CFG path escapes the function without the "
+    "close leaks the resource",
 )
 def check_unclosed(ctx: FileContext) -> Iterator[Finding]:
+    """Flow-sensitive since the CFG engine landed: instead of "is the
+    close syntactically inside a `finally`", the check asks the CFG
+    whether EVERY path from the open — normal fall-through, early
+    returns, and the exception edges of intervening calls — passes a
+    close. That cuts the old blind spots both ways: branch-structured
+    code that really closes on every path is clean without a `finally`,
+    and a close that IS in a finally but guarded by a condition still
+    fires."""
     for info in ctx.functions:
         fn = info.node
         if fn.name in ("__enter__", "__aenter__", "__exit__", "__aexit__"):
@@ -354,6 +362,7 @@ def check_unclosed(ctx: FileContext) -> Iterator[Finding]:
                         if isinstance(tgt, ast.Name):
                             opens.append((tgt.id, "open", node.value))
 
+        cfg = None
         for recv, opener, site in opens:
             closers = (
                 _OPEN_BUILTIN_CLOSERS if opener == "open" else _PAIRS[opener]
@@ -375,27 +384,47 @@ def check_unclosed(ctx: FileContext) -> Iterator[Finding]:
                     f"in a `finally`",
                 )
                 continue
-            if not any(_in_finally(ctx, n, fn) for (_, _, n) in matching):
+            if cfg is None:
+                cfg = ctx.cfg(fn)
+            open_idx = _cfg_stmt_of(ctx, cfg, site)
+            if open_idx is None:
+                continue
+            # stop the search on the close's enclosing STATEMENT ast —
+            # a finally-resident close exists as two CFG nodes (normal
+            # and abrupt copy) and both must stop it
+            close_asts = set()
+            for (_, _, n) in matching:
+                i = _cfg_stmt_of(ctx, cfg, n)
+                if i is not None and cfg.nodes[i].ast is not None:
+                    close_asts.add(cfg.nodes[i].ast)
+            from .flowrules import _escape
+
+            esc = _escape(cfg, open_idx, close_asts)
+            if esc is not None:
+                how, line, sink = esc
+                if how == "return":
+                    path = "an early-return path"
+                elif how == "cancel":
+                    path = (f"the CancelledError path out of the `await` "
+                            f"at line {line}")
+                else:
+                    path = f"the exception path out of line {line}"
                 yield ctx.finding(
                     "SD008",
                     site,
                     f"`{recv}` opened via `.{opener}()` in "
-                    f"`{info.qualname}` but only closed on the happy path — "
-                    f"move the close into `finally` (or use `with`)",
+                    f"`{info.qualname}` but {path} escapes without the "
+                    f"close — move the close into `finally` (or use "
+                    f"`with`)",
                 )
 
 
-def _in_finally(ctx: FileContext, node: ast.AST, stop: ast.AST) -> bool:
-    cur = node
-    parent = ctx.parents.get(cur)
-    while parent is not None and cur is not stop:
-        if isinstance(parent, ast.Try) and any(
-            cur is stmt or _contains(stmt, cur) for stmt in parent.finalbody
-        ):
-            return True
-        cur, parent = parent, ctx.parents.get(parent)
-    return False
-
-
-def _contains(root: ast.AST, target: ast.AST) -> bool:
-    return any(n is target for n in ast.walk(root))
+def _cfg_stmt_of(ctx: FileContext, cfg, expr: ast.AST) -> int | None:
+    """The CFG node whose statement contains ``expr``."""
+    cur: ast.AST | None = expr
+    while cur is not None:
+        idx = cfg.by_ast.get(cur)
+        if idx is not None:
+            return idx
+        cur = ctx.parents.get(cur)
+    return None

@@ -1,0 +1,228 @@
+"""Project call graph + compositional per-function summaries.
+
+SD004 proved the pattern on one module: summarize each function
+bottom-up ("which locks can this acquire"), then let callers fold
+callee summaries into their own analysis instead of inlining bodies.
+This module generalizes that seam to the whole analyzed tree so rules
+like SD017 (commit-ordering) can follow a vouch through helper layers:
+
+- :class:`CallGraph` indexes every function in the
+  :class:`~tools.sdlint.core.ProjectContext` and resolves call sites —
+  ``self.m(...)`` via the enclosing class, bare names via the module's
+  functions and ``from x import f`` bindings, ``mod.f(...)`` via
+  ``import``/``from``-module aliases (absolute and relative imports
+  both mapped onto the analyzed file set). Unresolvable calls (builtins,
+  third-party, dynamic dispatch) return None — summaries must treat
+  them as opaque.
+- :meth:`CallGraph.summarize` is the memoized bottom-up driver:
+  ``compute(ctx, info, summary_of)`` produces one function's summary,
+  pulling callee summaries through ``summary_of`` (recursion returns
+  the ``default`` — the same cycle discipline SD004 uses).
+
+Everything stays stdlib-``ast``; resolution is deliberately name-based
+and static. Precision goal: follow the helper layers this repo really
+writes (module functions, methods on ``self``, imported siblings), not
+arbitrary dynamic dispatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Callable, Iterator
+
+from .core import FileContext, FunctionInfo, ProjectContext, call_name
+
+
+class CallGraph:
+    """Name-based project call graph over the analyzed file set."""
+
+    def __init__(self, project: ProjectContext):
+        self.project = project
+        #: module path (as analyzed, posix) -> FileContext
+        self.modules: dict[str, FileContext] = {c.path: c for c in project.files}
+        #: (path, qualname) -> FunctionInfo
+        self.functions: dict[tuple[str, str], FunctionInfo] = {}
+        #: path -> {qualname} for bare-name lookup
+        self._by_module: dict[str, dict[str, FunctionInfo]] = {}
+        for ctx in project.files:
+            table = {info.qualname: info for info in ctx.functions}
+            self._by_module[ctx.path] = table
+            for qual, info in table.items():
+                self.functions[(ctx.path, qual)] = info
+        self._imports: dict[str, dict[str, tuple[str, str | None]]] = {}
+        self._callers: dict[tuple[str, str], list[tuple[str, str, ast.Call]]] | None = None
+
+    @classmethod
+    def of(cls, project: ProjectContext) -> "CallGraph":
+        """One graph per ProjectContext, built lazily and shared by
+        every rule that needs it."""
+        graph = getattr(project, "_call_graph", None)
+        if graph is None:
+            graph = cls(project)
+            project._call_graph = graph  # type: ignore[attr-defined]
+        return graph
+
+    # -- import resolution -------------------------------------------------
+
+    def _module_for(self, dotted: str) -> str | None:
+        """Map a dotted module name onto an analyzed file path."""
+        base = dotted.replace(".", "/")
+        for cand in (f"{base}.py", f"{base}/__init__.py"):
+            if cand in self.modules:
+                return cand
+        return None
+
+    def _rel_base(self, path: str, level: int) -> str:
+        """Package directory ``level`` dots up from ``path``."""
+        parts = path.split("/")[:-1]  # drop the file
+        for _ in range(max(0, level - 1)):
+            if parts:
+                parts.pop()
+        return "/".join(parts)
+
+    def imports_of(self, ctx: FileContext) -> dict[str, tuple[str, str | None]]:
+        """local name -> (module_path, attr|None). attr None means the
+        name IS the module (``import x.y as z``); an attr means a
+        ``from``-import of a function/object."""
+        if ctx.path in self._imports:
+            return self._imports[ctx.path]
+        table: dict[str, tuple[str, str | None]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod = self._module_for(alias.name)
+                    if mod is None:
+                        continue
+                    local = alias.asname or alias.name
+                    table[local] = (mod, None)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = self._rel_base(ctx.path, node.level)
+                    dotted = (base.replace("/", ".") + "." + (node.module or "")).strip(".")
+                else:
+                    dotted = node.module or ""
+                mod = self._module_for(dotted)
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # `from pkg import submodule` binds a module
+                    sub = self._module_for(f"{dotted}.{alias.name}") if dotted else None
+                    if sub is not None:
+                        table[local] = (sub, None)
+                    elif mod is not None:
+                        table[local] = (mod, alias.name)
+        self._imports[ctx.path] = table
+        return table
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve(
+        self, ctx: FileContext, call: ast.Call, site: ast.AST
+    ) -> tuple[FileContext, FunctionInfo] | None:
+        name = call_name(call)
+        if name is None:
+            return None
+        return self.resolve_name(ctx, name, site)
+
+    def resolve_name(
+        self, ctx: FileContext, name: str, site: ast.AST | None = None
+    ) -> tuple[FileContext, FunctionInfo] | None:
+        parts = name.split(".")
+        table = self._by_module[ctx.path]
+        imports = self.imports_of(ctx)
+        # self.m() -> method on the enclosing class
+        if parts[0] == "self" and len(parts) == 2 and site is not None:
+            owner = ctx.enclosing_class(site)
+            if owner is not None:
+                info = table.get(f"{owner}.{parts[1]}")
+                if info is not None:
+                    return ctx, info
+            return None
+        # bare name / Class.method within this module
+        info = table.get(name)
+        if info is not None:
+            return ctx, info
+        # from x import f  (possibly then f.attr — only f() resolves)
+        if len(parts) == 1 and parts[0] in imports:
+            mod, attr = imports[parts[0]]
+            if attr is not None:
+                target = self._by_module.get(mod, {}).get(attr)
+                if target is not None:
+                    return self.modules[mod], target
+            return None
+        # mod.f() / pkg.mod.f() via the longest importable prefix
+        for cut in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:cut])
+            if prefix in imports:
+                mod, attr = imports[prefix]
+                tail = parts[cut:]
+                if attr is not None:
+                    tail = [attr] + tail
+                target = self._by_module.get(mod, {}).get(".".join(tail))
+                if target is not None:
+                    return self.modules[mod], target
+                return None
+        return None
+
+    def calls_in(
+        self, ctx: FileContext, info: FunctionInfo
+    ) -> Iterator[tuple[ast.Call, tuple[FileContext, FunctionInfo] | None]]:
+        """Every call expression in ``info``'s body (not descending into
+        nested defs) with its resolution."""
+        from .core import walk_shallow
+
+        for node in walk_shallow(info.node):
+            if isinstance(node, ast.Call):
+                yield node, self.resolve(ctx, node, node)
+
+    def callers_of(
+        self, ctx: FileContext, info: FunctionInfo
+    ) -> list[tuple[FileContext, FunctionInfo, ast.Call]]:
+        """Reverse edges: call sites across the project that resolve to
+        ``info``. Built once, lazily, for the whole graph."""
+        if self._callers is None:
+            self._callers = {}
+            for cctx in self.project.files:
+                for cinfo in cctx.functions:
+                    for call, resolved in self.calls_in(cctx, cinfo):
+                        if resolved is None:
+                            continue
+                        key = (resolved[0].path, resolved[1].qualname)
+                        self._callers.setdefault(key, []).append(
+                            (cctx.path, cinfo.qualname, call)
+                        )
+        out = []
+        for path, qual, call in self._callers.get((ctx.path, info.qualname), []):
+            out.append((self.modules[path], self._by_module[path][qual], call))
+        return out
+
+    # -- summaries ---------------------------------------------------------
+
+    def summarize(
+        self,
+        compute: Callable[..., Any],
+        default: Any = None,
+    ) -> Callable[[FileContext, FunctionInfo], Any]:
+        """Memoized bottom-up summary driver.
+
+        ``compute(ctx, info, summary_of)`` returns the summary for one
+        function; ``summary_of(ctx2, info2)`` pulls a callee's summary.
+        Recursion (direct or mutual) yields ``default`` for the
+        in-progress function, the same cycle discipline SD004 uses."""
+        cache: dict[tuple[str, str], Any] = {}
+        in_progress: set[tuple[str, str]] = set()
+
+        def summary_of(ctx: FileContext, info: FunctionInfo) -> Any:
+            key = (ctx.path, info.qualname)
+            if key in cache:
+                return cache[key]
+            if key in in_progress:
+                return default
+            in_progress.add(key)
+            try:
+                result = compute(ctx, info, summary_of)
+            finally:
+                in_progress.discard(key)
+            cache[key] = result
+            return result
+
+        return summary_of
