@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: lint test tier1 trace-smoke debug-bundle bench-devices bench-check \
-	bench-warm bench-autotune bench-mesh bench-serve chaos
+.PHONY: lint test tier1 trace-smoke slo-smoke debug-bundle bench-devices \
+	bench-check bench-warm bench-autotune bench-mesh bench-serve chaos
 
 # set SDLINT_ANNOTATE=1 in CI for GitHub ::error annotations on the diff
 lint:
@@ -87,6 +87,17 @@ bench-check: lint
 trace-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_observability_smoke.py \
 		tests/test_trace.py -q -p no:cacheprovider
+
+# attribution + SLO smoke: boot a node, run a small pass, assert a
+# well-formed critical-path report (buckets sum to the window,
+# non-empty critical path) and a complete SLO burn-rate evaluation —
+# plus the attribution/history/SLO unit tiers
+# (docs/observability.md "Attribution, history, and SLOs")
+slo-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest \
+		"tests/test_observability_smoke.py::test_slo_smoke_attribution_and_slo_surfaces" \
+		tests/test_attrib.py tests/test_slo_history.py \
+		-q -p no:cacheprovider
 
 # offline redacted diagnostic bundle (add SDX_URL=http://... for a live
 # node's bundle instead)

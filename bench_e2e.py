@@ -154,6 +154,9 @@ async def run_scan(data_dir: str, corpus: str, *, use_device: bool,
     from spacedrive_tpu.object.file_identifier.job import FileIdentifierJob
     from spacedrive_tpu.object.media.job import MediaProcessorJob
 
+    from spacedrive_tpu.telemetry import attrib as _attrib
+    from spacedrive_tpu.telemetry import trace as _trace
+
     node = Node(data_dir, use_device=use_device, with_labeler=False)
     node.config.config.p2p.enabled = False
     await node.start()
@@ -166,17 +169,27 @@ async def run_scan(data_dir: str, corpus: str, *, use_device: bool,
         await node.jobs.wait_idle()
         index_s = time.perf_counter() - t0
 
+        # each measured pass runs under its OWN fresh trace so its
+        # critical-path attribution (telemetry/attrib.py) can be
+        # computed from the span ring afterwards — the per-config
+        # bucket split bench_compare gates like any rate
         ident = FileIdentifierJob({"location_id": loc["id"], "backend": backend})
+        ident_ctx = _trace.new_context()
         t0 = time.perf_counter()
-        await JobBuilder(ident).spawn(node.jobs, lib)
+        with _trace.use(ident_ctx):
+            await JobBuilder(ident).spawn(node.jobs, lib)
         await node.jobs.wait_idle()
         ident_s = time.perf_counter() - t0
+        ident_attrib = _attrib.report(ident_ctx.trace_id)
 
         media = MediaProcessorJob({"location_id": loc["id"]})
+        media_ctx = _trace.new_context()
         t0 = time.perf_counter()
-        await JobBuilder(media).spawn(node.jobs, lib)
+        with _trace.use(media_ctx):
+            await JobBuilder(media).spawn(node.jobs, lib)
         await node.jobs.wait_idle()
         media_s = time.perf_counter() - t0
+        media_attrib = _attrib.report(media_ctx.trace_id)
 
         files = lib.db.count("file_path", "is_dir = 0", ())
         objects = lib.db.count("object")
@@ -188,9 +201,29 @@ async def run_scan(data_dir: str, corpus: str, *, use_device: bool,
             "index_s": index_s, "identifier_s": ident_s, "media_s": media_s,
             "files": files, "objects": objects, "thumbnails": thumbs,
             "identifier_meta": dict(ident.run_metadata),
+            "identifier_attrib": ident_attrib,
+            "media_attrib": media_attrib,
         }
     finally:
         await node.shutdown()
+
+
+def attrib_summary(raw: dict | None, items: int, wall_s: float) -> dict | None:
+    """The gateable per-config attribution summary: bucket seconds
+    normalized per 1000 items (corpus-size-independent) plus the span
+    coverage of the measured wall time. Buckets are lower-is-better;
+    tools/bench_compare.py fails a >15% bucket regression like any
+    rate regression."""
+    if not raw or not items:
+        return None
+    buckets = raw.get("buckets") or {}
+    out = {
+        f"{name}_s_per_kfile": round(sec / items * 1000.0, 4)
+        for name, sec in buckets.items()
+    }
+    wall = raw.get("wall_seconds") or 0.0
+    out["coverage"] = round(wall / wall_s, 4) if wall_s > 0 else 0.0
+    return out
 
 
 def mutate_corpus(root: str, pct: float, seed: int = 21) -> tuple[int, int]:
@@ -411,6 +444,10 @@ def config_1(tmp: str, n_files: int, repeats: int, probes: dict) -> dict:
             k: runs["device"]["identifier_meta"].get(k)
             for k in ("prefetch_hits", "prefetch_misses", "hash_time", "db_time")
         },
+        "attrib": attrib_summary(
+            runs["device"].get("identifier_attrib"),
+            runs["device"]["files"], runs["device"]["identifier_s"],
+        ),
     }
 
 
@@ -432,6 +469,10 @@ def config_3(tmp: str, n_images: int, repeats: int, probes: dict) -> dict:
         "cpu1_thumbs_per_s": round(cpu, 2),
         "vs_cpu1": round(dev / cpu, 3),
         "vs_cpu16_projected": round(dev / (cpu * CPU_BASELINE_CORES), 3),
+        "attrib": attrib_summary(
+            runs["device"].get("media_attrib"),
+            runs["device"]["thumbnails"], runs["device"]["media_s"],
+        ),
     }
 
 
@@ -453,6 +494,10 @@ def config_4(tmp: str, n_clips: int, repeats: int, probes: dict) -> dict:
         "cpu1_clips_per_s": round(cpu, 2),
         "vs_cpu1": round(dev / cpu, 3),
         "vs_cpu16_projected": round(dev / (cpu * CPU_BASELINE_CORES), 3),
+        "attrib": attrib_summary(
+            runs["device"].get("media_attrib"),
+            runs["device"]["thumbnails"], runs["device"]["media_s"],
+        ),
     }
 
 

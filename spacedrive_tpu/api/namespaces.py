@@ -1656,6 +1656,31 @@ def _telemetry(r: Router) -> None:
             force=bool(opts.get("force")),
         )
 
+    @r.query("telemetry.attrib", priority="background")
+    async def attrib(node, arg=None):
+        # critical-path attribution for one distributed trace (default:
+        # the last completed pass): bucket split + critical-path
+        # segments, with executor-side spans pulled from mesh peers.
+        # BACKGROUND like trace_export — assembly dials peers, so it
+        # must never ride the unsheddable control class
+        from ..telemetry import attrib as _attrib
+
+        opts = arg if isinstance(arg, dict) else {}
+        return await _attrib.assemble(
+            node,
+            opts.get("trace_id") or None,
+            refresh=bool(opts.get("refresh")),
+        )
+
+    @r.query("telemetry.slo")
+    def slo(node):
+        # SLO burn-rate posture over the node's persistent history
+        # (telemetry/slo.py) — the same evaluation the `slo` health
+        # subsystem embeds in federation snapshots
+        from ..telemetry import slo as _slo
+
+        return _slo.evaluate(getattr(node, "history", None))
+
     @r.query("telemetry.serve")
     def serve_status(node):
         # admission gate + read-cache state (the overload posture):

@@ -123,14 +123,31 @@ class Router:
         if serve is None:
             return await self._exec_direct(node, proc, key, arg, library_id)
         klass = class_for_key(key, proc.priority)
+        import time as _time
+
+        from ..serve.gate import observe_request_seconds
+
+        t0 = _time.perf_counter()
         try:
-            return await self._exec_gated(
+            result = await self._exec_gated(
                 node, serve, proc, key, arg, library_id, klass
             )
         except Shed as e:
             err = RspcError(429, f"SHED: {e.reason}")
             err.retry_after_s = e.retry_after_s
             raise err from None
+        except BaseException:
+            # errored-but-answered work counts: a handler that burned
+            # 30 s before failing is exactly the latency the
+            # interactive_p99 SLO exists to catch (sheds stay excluded
+            # — fast 429s would bias the percentile low under overload)
+            observe_request_seconds(klass, _time.perf_counter() - t0)
+            raise
+        # answered rspc calls feed the same per-class request latency
+        # series the HTTP middleware does — without this leg the
+        # interactive_p99 SLO would only ever see raw-route traffic
+        observe_request_seconds(klass, _time.perf_counter() - t0)
+        return result
 
     async def _exec_gated(
         self, node: Any, serve: Any, proc: Procedure, key: str,

@@ -19,6 +19,7 @@ import logging
 import mimetypes
 import os
 import re
+import time
 import uuid
 from typing import Any
 
@@ -27,6 +28,7 @@ from aiohttp import WSMsgType, web
 from .. import telemetry
 from ..files.isolated_path import full_path_from_db_row
 from ..serve import BACKGROUND, CONTROL, INTERACTIVE, Shed, runtime_for
+from ..serve.gate import observe_request_seconds
 from .router import Router, RspcError
 
 logger = logging.getLogger(__name__)
@@ -79,6 +81,7 @@ class ApiServer:
                 self._gated(web.get("/", self._index), INTERACTIVE),
                 self._gated(web.get("/metrics", self._metrics), CONTROL),
                 self._gated(web.get("/trace", self._trace), BACKGROUND),
+                self._gated(web.get("/attrib", self._attrib), BACKGROUND),
                 self._gated(web.get("/health", self._health), CONTROL),
                 self._gated(web.get("/mesh", self._mesh), INTERACTIVE),
                 self._gated(
@@ -187,7 +190,16 @@ class ApiServer:
             return await handler(request)
         try:
             async with serve.gate.admit(klass, key=canonical or request.path):
-                return await handler(request)
+                t0 = time.perf_counter()
+                try:
+                    return await handler(request)
+                finally:
+                    # admitted request wall time per class — the
+                    # interactive series is the interactive_p99 SLO
+                    # input (telemetry/slo.py)
+                    observe_request_seconds(
+                        klass, time.perf_counter() - t0
+                    )
         except Shed as e:
             return _shed_response(e)
 
@@ -208,6 +220,37 @@ class ApiServer:
             telemetry.trace_export(request.query.get("trace_id") or None),
             headers={"Content-Disposition": "inline; filename=sd-trace.json"},
         )
+
+    async def _attrib(self, request: web.Request) -> web.Response:
+        """Critical-path attribution for one distributed trace (default:
+        the last completed pass) — device / host_cpu / link /
+        queue_wait / gap bucket split plus the critical-path segments
+        (telemetry/attrib.py). `?trace_id=<hex>` picks a trace,
+        `?refresh=1` bypasses the per-trace report cache and re-pulls
+        peers. Cached through the serve meta cache so dashboard polls
+        cost one mesh pull per TTL window."""
+        from ..telemetry import attrib as _attrib_mod
+
+        trace_id = request.query.get("trace_id") or None
+        refresh = request.query.get("refresh") == "1"
+
+        async def load() -> Any:
+            return await _attrib_mod.assemble(
+                self.node, trace_id, refresh=refresh
+            )
+
+        serve = runtime_for(self.node)
+        if serve is None or refresh:
+            doc = await load()
+        else:
+            result = await serve.meta.get(
+                ("attrib", trace_id or ""),
+                load,
+                ttl_s=serve.policy.mesh_ttl_s,
+                stale_ok=serve.gate.in_brownout(),
+            )
+            doc = result.value
+        return web.json_response(doc, dumps=_dumps)
 
     async def _health(self, _request: web.Request) -> web.Response:
         """Per-subsystem → per-node health rollup (telemetry.health).

@@ -594,6 +594,87 @@ def cmd_trace_export(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_attrib(args: argparse.Namespace) -> int:
+    """Critical-path attribution report from a running node: where the
+    last pass's (or --trace-id's) wall-clock went — device / host_cpu /
+    link / queue_wait / unattributed-gap — with executor-side spans
+    pulled from mesh peers (docs/observability.md "Attribution,
+    history, and SLOs")."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/attrib"
+    query = {}
+    if args.trace_id:
+        query["trace_id"] = args.trace_id
+    if args.refresh:
+        query["refresh"] = "1"
+    if query:
+        url += "?" + urllib.parse.urlencode(query)
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            doc = json.loads(resp.read().decode())
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"attrib: cannot reach {url}: {e}", file=sys.stderr)
+        print("is a node running? start one with `sdx serve`",
+              file=sys.stderr)
+        return 1
+    if doc.get("error"):
+        print(f"attrib: {doc['error']}", file=sys.stderr)
+        return 1
+    _write_or_print(json.dumps(doc, indent=2), args.out)
+    buckets = doc.get("buckets") or {}
+    if buckets:
+        wall = doc.get("wall_seconds") or 0.0
+        split = "  ".join(
+            f"{k}={v:.2f}s" for k, v in sorted(
+                buckets.items(), key=lambda kv: kv[1], reverse=True)
+        )
+        print(f"attrib: {wall:.2f}s critical path — {split}",
+              file=sys.stderr)
+    return 0
+
+
+def cmd_slo(args: argparse.Namespace) -> int:
+    """SLO burn-rate posture. With --url, the live evaluation from a
+    running node (rspc telemetry.slo); otherwise evaluated offline over
+    the data dir's persistent telemetry history — which survives
+    restarts, so this reads a continuous series across node
+    generations."""
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/rspc/telemetry.slo"
+        req = urllib.request.Request(
+            url, data=b"{}", headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"slo: cannot reach {url}: {e}", file=sys.stderr)
+            print("is a node running? start one with `sdx serve`",
+                  file=sys.stderr)
+            return 1
+        doc = payload.get("result")
+    else:
+        from .telemetry import slo as _slo
+        from .telemetry.history import history_dir
+
+        doc = _slo.evaluate(directory=history_dir(args.data_dir))
+    _write_or_print(json.dumps(doc, indent=2), args.out)
+    if isinstance(doc, dict):
+        for s in doc.get("slos") or []:
+            print(f"slo: {s['name']}: {s['status']}"
+                  + (f"  (current {s['current']:g})"
+                     if isinstance(s.get("current"), (int, float)) else ""),
+                  file=sys.stderr)
+    return 0
+
+
 async def cmd_debug_bundle_peer(args: argparse.Namespace) -> int:
     """Pull a REMOTE node's debug bundle across the mesh. The bundle is
     built — and fully redacted — by the OWNING node before anything
@@ -815,6 +896,31 @@ def build_parser() -> argparse.ArgumentParser:
                     help="discovery settle time before dialing --peer")
     db.add_argument("--out", help="write JSON here instead of stdout")
 
+    at = sub.add_parser(
+        "attrib",
+        help="critical-path attribution: where the last pass's "
+             "wall-clock went (device / host_cpu / link / queue_wait / "
+             "unattributed-gap), mesh-wide",
+    )
+    at.add_argument("trace_id", nargs="?", default=None,
+                    help="trace id (hex; default: the last completed pass)")
+    at.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="the node's HTTP API origin (sdx serve)")
+    at.add_argument("--refresh", action="store_true",
+                    help="bypass the report cache and re-pull mesh peers")
+    at.add_argument("--out", help="write JSON here instead of stdout")
+
+    so = sub.add_parser(
+        "slo",
+        help="SLO burn-rate posture: per-objective status over the "
+             "persistent telemetry history (multi-window burn rates)",
+    )
+    so.add_argument("--url", default=None,
+                    help="read a running node's rspc telemetry.slo "
+                         "instead of evaluating the data dir's history "
+                         "offline")
+    so.add_argument("--out", help="write JSON here instead of stdout")
+
     ms = sub.add_parser(
         "mesh-status",
         help="mesh-wide observability: every peer's latest telemetry "
@@ -895,6 +1001,10 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_bench(args)
     if args.cmd == "trace-export":
         return cmd_trace_export(args)
+    if args.cmd == "attrib":
+        return cmd_attrib(args)
+    if args.cmd == "slo":
+        return cmd_slo(args)
     if args.cmd == "debug-bundle":
         return cmd_debug_bundle(args)
     if args.cmd == "mesh-status":

@@ -76,6 +76,11 @@ class JobManager:
         )
         report.create(library.db)
         JOB_EVENTS.emit("queued", job=job.NAME, id=str(job.id))
+        # pass boundary marker: attribution's "last pass" resolves
+        # through these instead of guessing from the span ring
+        from ..telemetry import attrib as _attrib
+
+        _attrib.mark_pass(job.NAME, job.trace_ctx.trace_id, "started")
         self._dispatch(job, library, report)
 
     def _dispatch(self, job: StatefulJob, library: Any, report: JobReport) -> None:
@@ -132,6 +137,13 @@ class JobManager:
             status=report.status.name,
             errors=len(report.errors_text),
         )
+        if job.trace_ctx is not None:
+            from ..telemetry import attrib as _attrib
+
+            _attrib.mark_pass(
+                job.NAME, job.trace_ctx.trace_id, "settled",
+                status=report.status.name,
+            )
 
         self._notify_outcome(job, library, report)
 

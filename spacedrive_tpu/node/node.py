@@ -93,6 +93,14 @@ class Node:
 
         self.router = mount()  # ref:lib.rs Node::new returns (node, router)
         self.loop_monitor = LoopLagMonitor()
+        # persistent telemetry history: sampled allowlisted series into
+        # an append-only segment store under the data dir — constructed
+        # unconditionally so offline readers (sdx slo, bench_compare)
+        # can open the same directory; sampling only starts with the
+        # node and only when SD_HISTORY != 0
+        from ..telemetry.history import HistoryWriter, history_dir
+
+        self.history = HistoryWriter(history_dir(self.data_dir))
         # the process-wide closed-loop autotuner: started with the node
         # so pipeline policies adapt while jobs run (SD_AUTOTUNE=0 keeps
         # every policy at the static defaults and starts nothing)
@@ -136,6 +144,7 @@ class Node:
 
         install_loop_excepthook(asyncio.get_running_loop())
         self.loop_monitor.start()
+        self.history.start()
         self.autotuner.start()
         # bind the thumbnailer to THIS loop up front: enqueues arrive
         # from worker threads (non-indexed walker) and can only wake the
@@ -260,6 +269,7 @@ class Node:
                 await cloud.shutdown()
                 await cloud.client.close()
         await self.loop_monitor.stop()
+        await self.history.stop()
         await self.autotuner.stop()
         await self.thumbnailer.shutdown()
         if self.image_labeler is not None:

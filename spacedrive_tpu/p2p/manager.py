@@ -38,8 +38,10 @@ from .operations import (
     SpacedropManager,
     _wireable_snapshot,
     request_telemetry,
+    request_trace,
     respond_file,
     respond_telemetry,
+    respond_trace,
 )
 from .p2p import P2P
 from .protocol import Header, HeaderType
@@ -390,6 +392,50 @@ class P2PManager:
             if pid not in recovered:
                 self.federation.record_failure(pid, err)
 
+    async def pull_remote_spans(
+        self, trace_id: str,
+    ) -> tuple[list[dict], dict[str, str]]:
+        """Distributed-trace assembly (telemetry/attrib.py): pull every
+        discovered peer's completed spans for ``trace_id``. Pulls run
+        concurrently under the sync-plane resilience policy (per-peer
+        breakers — a vanished peer costs one fast failure, never a
+        blocked report). Returns ``(spans, failures)``: spans are
+        tagged with the serving peer's short-hash label, failures map
+        that label to the error string (the report's ``partial``
+        evidence)."""
+        from ..telemetry import metrics as _tm2
+        from ..telemetry.peers import peer_label
+
+        async def pull(peer: Any) -> tuple[str, list[dict] | None, str]:
+            label = peer_label(str(peer.identity))
+            try:
+                spans = await SYNC_POLICY.call(
+                    str(peer.identity),
+                    lambda peer=peer: request_trace(
+                        self.p2p, peer.identity, trace_id
+                    ),
+                )
+                return label, spans, ""
+            except (BreakerOpen, ConnectionError, OSError, EOFError,
+                    asyncio.TimeoutError, PermissionError, ValueError) as e:
+                return label, None, f"{type(e).__name__}: {e}"
+
+        results = await asyncio.gather(
+            *(pull(p) for p in self.p2p.discovered_peers())
+        )
+        spans: list[dict] = []
+        failures: dict[str, str] = {}
+        for label, got, err in results:
+            if got is None:
+                failures[label] = err[:200]
+                _tm2.ATTRIB_PULL_FAILURES.inc()
+                continue
+            for rec in got:
+                rec = dict(rec)
+                rec["node"] = label
+                spans.append(rec)
+        return spans, failures
+
     # --- inbound dispatch (ref:manager.rs stream handler) --------------
 
     def _serve_admit(self, key: str):
@@ -465,9 +511,25 @@ class P2PManager:
             if self._is_library_member(
                 getattr(stream, "remote_identity", None)
             ):
-                async with self._serve_admit("p2p.telemetry_serve"):
-                    with _span("p2p.telemetry_serve"):
-                        await respond_telemetry(stream, self.node)
+                op = (header.telemetry_op or {}).get("op")
+                if op == "trace_pull":
+                    if _faults.hit("p2p.trace_pull") is not None:
+                        await stream.close()  # peer vanishes mid-pull
+                        return
+                    async with self._serve_admit("p2p.trace_serve"):
+                        with _span("p2p.trace_serve"):
+                            await respond_trace(
+                                stream,
+                                (header.telemetry_op or {}).get("trace_id"),
+                            )
+                elif op not in (None, "snapshot"):
+                    w = Writer(stream)
+                    w.msgpack({"error": f"unknown TELEMETRY op {op!r}"})
+                    await w.flush()
+                else:
+                    async with self._serve_admit("p2p.telemetry_serve"):
+                        with _span("p2p.telemetry_serve"):
+                            await respond_telemetry(stream, self.node)
             else:
                 w = Writer(stream)
                 w.msgpack(

@@ -267,6 +267,55 @@ async def respond_telemetry(stream: Any, node: Any) -> None:
     await w.flush()
 
 
+#: spans shipped per trace_pull response — a full trace ring is 4096
+#: records; one pass's share is far smaller, and the cap bounds what a
+#: member can make us serialize per exchange
+TRACE_PULL_MAX_SPANS = 2048
+
+
+async def request_trace(p2p: Any, identity: RemoteIdentity,
+                        trace_id: str) -> list[dict]:
+    """Pull a peer's completed spans for one distributed trace (the
+    ``trace_pull`` TELEMETRY op — critical-path attribution assembly,
+    telemetry/attrib.py). Raises ``PermissionError`` on a membership
+    refusal, ``ValueError`` on a malformed response — both PASS through
+    the caller's resilience policy without feeding the breaker."""
+    from ..utils.compat import timeout
+
+    stream = await p2p.new_stream(identity)
+    try:
+        async with timeout(TELEMETRY_TIMEOUT):
+            await Header(
+                HeaderType.TELEMETRY, trace=_trace.wire_current(),
+                telemetry_op={"op": "trace_pull", "trace_id": str(trace_id)},
+            ).write(stream)
+            resp = await Reader(stream).msgpack()
+    finally:
+        await stream.close()
+    if isinstance(resp, dict) and resp.get("error"):
+        raise PermissionError(str(resp["error"]))
+    if not isinstance(resp, dict) or not isinstance(resp.get("spans"), list):
+        raise ValueError("peer served a malformed trace_pull response")
+    return [s for s in resp["spans"] if isinstance(s, dict)]
+
+
+async def respond_trace(stream: Any, trace_id: Any) -> None:
+    """Server half of ``trace_pull``: this node's span records for one
+    trace id, straight off the trace ring (bounded). Span records carry
+    stages, ids, and timings — no payloads, paths, or secrets — so
+    nothing needing redaction crosses here."""
+    from ..telemetry import trace as _trace_mod
+
+    w = Writer(stream)
+    if not isinstance(trace_id, str) or not trace_id:
+        w.msgpack({"error": "trace_pull requires a trace_id"})
+        await w.flush()
+        return
+    spans = _trace_mod.recent(trace_id)[-TRACE_PULL_MAX_SPANS:]
+    w.msgpack({"spans": _wireable_snapshot(spans)})
+    await w.flush()
+
+
 def _wireable_snapshot(obj: Any) -> Any:
     """msgpack-encodable projection (floats/str/ints pass, odd leaves
     stringify) — snapshots must never fail to serialize."""

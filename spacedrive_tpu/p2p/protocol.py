@@ -55,6 +55,12 @@ class Header:
     # same wire revision; a cross-revision handshake would have to land
     # before any rolling-upgrade story.
     trace: dict | None = None
+    # TELEMETRY sub-operation ({} = the default snapshot pull):
+    # {"op": "trace_pull", "trace_id": "<hex>"} asks the responder for
+    # its completed spans of one distributed trace (critical-path
+    # attribution, telemetry/attrib.py) — same flag-day discipline as
+    # `trace` above
+    telemetry_op: dict | None = None
 
     async def write(self, stream: Any) -> None:
         w = Writer(stream)
@@ -75,6 +81,7 @@ class Header:
             w.msgpack(self.file.range.to_wire())
         elif self.type == HeaderType.TELEMETRY:
             w.msgpack(self.trace or {})
+            w.msgpack(self.telemetry_op or {})
         await w.flush()
 
     @classmethod
@@ -97,5 +104,9 @@ class Header:
                 ),
             )
         if t == HeaderType.TELEMETRY:
-            return cls(t, trace=(await r.msgpack()) or None)
+            return cls(
+                t,
+                trace=(await r.msgpack()) or None,
+                telemetry_op=(await r.msgpack()) or None,
+            )
         return cls(t)

@@ -41,10 +41,26 @@ from ..telemetry import metrics as _tm
 from ..telemetry.events import SERVE_EVENTS
 from ..telemetry.snapshot import gauge_value
 from . import policy as _policy
-from .policy import BACKGROUND, CLASSES, ServePolicy
+from .policy import BACKGROUND, CLASSES, CONTROL, INTERACTIVE, SYNC, ServePolicy
 
 NORMAL = "normal"
 BROWNOUT = "brownout"
+
+
+def observe_request_seconds(klass: str, seconds: float) -> None:
+    """Admitted-request wall time per priority class — the ONE record
+    site both serve surfaces share (the HTTP admission middleware and
+    the rspc Router.exec leg), so the `interactive_p99` SLO input
+    covers rspc traffic, not just raw HTTP routes. The conditional maps
+    onto the class-constant vocabulary (an unknown string — which the
+    gate itself degrades to background — records as background too)."""
+    _tm.SERVE_REQUEST_SECONDS.observe(
+        seconds,
+        klass="control" if klass == CONTROL
+        else "sync" if klass == SYNC
+        else "interactive" if klass == INTERACTIVE
+        else "background",
+    )
 
 
 class Shed(Exception):

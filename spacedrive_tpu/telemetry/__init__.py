@@ -22,7 +22,7 @@ Surface:
 - ``reset()`` — test isolation across metrics, spans, traces, rings.
 """
 
-from . import events, federation, health, metrics, trace
+from . import attrib, events, federation, health, history, metrics, slo, trace
 from .registry import (
     BYTE_BUCKETS,
     MAX_SERIES_PER_FAMILY,
@@ -45,11 +45,17 @@ def render() -> str:
 
 def reset() -> None:
     """Test/bench isolation: zero every metric series AND clear the
-    span ring, the trace ring, and every flight-recorder ring."""
+    span ring, the trace ring, every flight-recorder ring, the
+    attribution report cache + pass markers, SLO evaluation state, and
+    every history writer's in-memory tail (durable history segments
+    are data-dir state and deliberately survive)."""
     REGISTRY.reset()
     clear_recent()
     trace.clear()
     events.clear_all()
+    attrib.reset()
+    slo.reset()
+    history.reset_tails()
     # the index journal's per-location runtime counters + stats cache
     # live like registry series (lazy import: journal imports metrics)
     from ..location.indexer.journal import reset_runtime
@@ -90,5 +96,5 @@ __all__ = [
     "clear_recent", "snapshot", "histogram_recent", "gauge_value",
     "counter_value", "render", "counter", "gauge", "histogram",
     "trace", "events", "reset", "trace_export", "debug_bundle",
-    "health", "federation",
+    "health", "federation", "attrib", "history", "slo",
 ]

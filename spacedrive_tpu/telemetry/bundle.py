@@ -162,6 +162,7 @@ def build_bundle(node: Any = None, data_dir: str | None = None) -> dict[str, Any
     """Assemble the bundle dict (JSON-serializable, already redacted)."""
     from . import trace as _trace
     from .events import all_events
+    from .events import drop_counts as _drop_counts
     from .snapshot import snapshot as _snapshot
 
     trace_events = _trace.recent()
@@ -181,6 +182,9 @@ def build_bundle(node: Any = None, data_dir: str | None = None) -> dict[str, Any
         # key-based pass over ring fields too (a field literally named
         # "token"/"key" gets hidden even before the value scrub)
         "events": redact(all_events()),
+        # per-ring overflow drops: a ring that displaced events is a
+        # suffix of the story, and the bundle must say so
+        "ring_drops": _drop_counts(),
     }
     if node is not None:
         bundle["libraries"] = _libraries(node)

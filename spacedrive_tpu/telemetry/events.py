@@ -41,9 +41,13 @@ class EventRing:
         self.name = name
         self._ring: deque[dict[str, Any]] = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        # honest-overflow accounting: a bounded ring that silently
+        # displaces its oldest events reads as "nothing else happened";
+        # the drop counter says how much story is missing
+        self.dropped = 0
 
     def emit(self, type: str, **fields: Any) -> None:
-        from . import trace
+        from . import metrics, trace
 
         ctx = trace.current()
         rec: dict[str, Any] = {"ts": time.time(), "type": type}
@@ -52,7 +56,16 @@ class EventRing:
         if fields:
             rec["fields"] = fields
         with self._lock:
+            overflowed = (
+                self._ring.maxlen is not None
+                and len(self._ring) >= self._ring.maxlen
+            )
+            if overflowed:
+                self.dropped += 1
             self._ring.append(rec)
+        if overflowed:
+            # outside the ring lock: the registry has its own
+            metrics.RING_DROPPED.inc(ring=self.name)
 
     def snapshot(self) -> list[dict[str, Any]]:
         with self._lock:
@@ -61,6 +74,7 @@ class EventRing:
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
+            self.dropped = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -85,6 +99,15 @@ def all_events() -> dict[str, list[dict[str, Any]]]:
     with _rings_lock:
         rings = list(_rings.values())
     return {r.name: r.snapshot() for r in rings}
+
+
+def drop_counts() -> dict[str, int]:
+    """Per-ring overflow drops since the last clear — rides the debug
+    bundle next to the ring payloads so a consumer can tell a quiet
+    ring from a saturated one."""
+    with _rings_lock:
+        rings = list(_rings.values())
+    return {r.name: r.dropped for r in rings if r.dropped}
 
 
 def clear_all() -> None:

@@ -73,6 +73,9 @@ def _ring_digests() -> dict[str, Any]:
     see 'the error ring is filling with watcher failures' from across
     the mesh without shipping payloads (which may embed paths or
     messages that only the owning node's bundle redaction may touch)."""
+    from .events import drop_counts
+
+    drops = drop_counts()
     out: dict[str, Any] = {}
     for ring_name, events in all_events().items():
         types: dict[str, int] = {}
@@ -84,6 +87,10 @@ def _ring_digests() -> dict[str, Any]:
             "last_ts": events[-1].get("ts") if events else None,
             "types": types,
         }
+        if drops.get(ring_name):
+            # overflow honesty crosses the mesh too: a saturated ring
+            # on a peer should read as "suffix", not "quiet"
+            out[ring_name]["dropped"] = drops[ring_name]
     return out
 
 

@@ -291,6 +291,41 @@ def _serve(node: Any = None) -> dict[str, Any]:
     return _verdict(HEALTHY, **signals)
 
 
+def _slo(node: Any = None) -> dict[str, Any]:
+    """SLO burn-rate posture (telemetry/slo.py over the node's
+    persistent history). A breach — fast AND slow windows burning the
+    error budget past their thresholds (or any protected-class shed)
+    — is UNHEALTHY: the node is violating its stated contract, not
+    merely degraded. A fast-window-only burn is DEGRADED (the warn
+    stage of the standard multi-window alert). No history, or no
+    samples yet, reads UNKNOWN and never worsens the rollup."""
+    from . import slo as _slo_mod
+
+    history = getattr(node, "history", None) if node is not None else None
+    if history is None:
+        return _verdict(UNKNOWN, "no telemetry history")
+    evaluation = _slo_mod.evaluate(history)
+    breached = [s["name"] for s in evaluation["slos"]
+                if s["status"] == _slo_mod.BREACH]
+    warned = [s["name"] for s in evaluation["slos"]
+              if s["status"] == _slo_mod.WARN]
+    signals = {"slos": {
+        s["name"]: {"status": s["status"], "current": s.get("current")}
+        for s in evaluation["slos"]
+    }}
+    if breached:
+        return _verdict(
+            UNHEALTHY, f"SLO breach: {', '.join(sorted(breached))}",
+            **signals)
+    if warned:
+        return _verdict(
+            DEGRADED,
+            f"fast-window burn: {', '.join(sorted(warned))}", **signals)
+    if evaluation["status"] == _slo_mod.NO_DATA:
+        return _verdict(UNKNOWN, "no history samples yet")
+    return _verdict(HEALTHY, **signals)
+
+
 def evaluate(node: Any = None) -> dict[str, Any]:
     """The full health rollup: per-subsystem verdicts plus the overall
     status (worst subsystem; ``unknown`` counts as healthy)."""
@@ -302,6 +337,7 @@ def evaluate(node: Any = None) -> dict[str, Any]:
         "sync": _sync(node),
         "resilience": _resilience(),
         "serve": _serve(node),
+        "slo": _slo(node),
     }
     overall = HEALTHY
     for v in subsystems.values():

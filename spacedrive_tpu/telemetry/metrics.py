@@ -397,6 +397,65 @@ SYNC_TXN_COMBINED = REGISTRY.counter(
     "transaction that carried them)",
 )
 
+# --- flight-recorder drop accounting (telemetry/events.py) ------------------
+
+RING_DROPPED = REGISTRY.counter(
+    "sd_ring_dropped_total",
+    "flight-recorder events silently displaced by ring overflow (the "
+    "bounded deque dropped its oldest entry to admit a new one) — a "
+    "nonzero count means the debug bundle's rings are a suffix, not "
+    "the whole story",
+    labels=("ring",),
+)
+
+# --- critical-path attribution (telemetry/attrib.py) ------------------------
+
+ATTRIB_REPORTS = REGISTRY.counter(
+    "sd_attrib_reports_total",
+    "critical-path attribution reports computed (GET /attrib, rspc "
+    "telemetry.attrib, sdx attrib, bench_e2e summaries)",
+)
+ATTRIB_BUCKET_SECONDS = REGISTRY.gauge(
+    "sd_attrib_bucket_seconds",
+    "wall-clock seconds per attribution bucket of the most recent "
+    "report: device / host_cpu / link / queue_wait / gap (the "
+    "unattributed-gap bucket is the GIL signature)",
+    labels=("bucket",),
+)
+ATTRIB_PULL_FAILURES = REGISTRY.counter(
+    "sd_attrib_pull_failures_total",
+    "remote trace_pull exchanges that failed during distributed trace "
+    "assembly (the report degrades to partial, never blocks)",
+)
+
+# --- telemetry history + SLO engine (telemetry/history.py, telemetry/slo.py)
+
+HISTORY_SAMPLES = REGISTRY.counter(
+    "sd_history_samples_total",
+    "samples appended to the persistent telemetry history segment store",
+)
+SLO_EVALUATIONS = REGISTRY.counter(
+    "sd_slo_evaluations_total",
+    "SLO registry evaluations (health reads, federation snapshots, "
+    "sdx slo)",
+)
+SLO_STATUS = REGISTRY.gauge(
+    "sd_slo_status",
+    "latest per-SLO verdict: 0 = ok/no-data, 1 = warn (fast-window "
+    "burn), 2 = breach (fast AND slow windows burning)",
+    labels=("slo",),
+)
+
+# --- serve request latency (api/server.py admission middleware) -------------
+
+SERVE_REQUEST_SECONDS = REGISTRY.histogram(
+    "sd_serve_request_seconds",
+    "admitted HTTP request wall time per priority class (handler run "
+    "under its admission slot) — the interactive series is the "
+    "interactive_p99 SLO input",
+    labels=("klass",),
+)
+
 # --- event loop health (telemetry/events.py LoopLagMonitor) -----------------
 
 EVENT_LOOP_LAG = REGISTRY.gauge(

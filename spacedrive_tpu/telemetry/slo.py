@@ -1,0 +1,278 @@
+"""SLO registry + multi-window burn-rate evaluation over history.
+
+Health verdicts (``telemetry/health.py``) answer "is the node OK right
+now"; an SLO answers the operator contract question: *are we spending
+our error budget faster than we can afford?* Each
+:class:`SLO` declares a **good-sample predicate** over one history
+series (``telemetry/history.py``) and a target good-fraction; the
+evaluator computes the classic multi-window **burn rate** — the bad
+fraction divided by the error budget — over a fast and a slow window:
+
+- ``burn = bad_fraction / (1 - target)``: burn 1.0 spends exactly the
+  budget over the window; 14.4 over 5 minutes is the page-worthy pace
+  (a 30-day budget gone in ~2 days — the SRE-workbook default);
+- **breach** requires the fast AND slow windows to burn past their
+  thresholds (the standard guard against paging on a blip);
+- **warn** is the fast window alone.
+
+Counter-shaped SLOs (``protected sheds == 0``) use zero-tolerance
+semantics instead: ANY increase of the cumulative counter within the
+fast window is an immediate breach — a protected-class shed is a
+serve-layer bug, not budget spend.
+
+The registry is declarative and process-global (:data:`REGISTRY`,
+seeded with :func:`default_slos`); evaluation state (last verdicts,
+for delta-free reads) is cached per process and cleared by
+``telemetry.reset()``. The ``slo`` health subsystem wraps
+:func:`evaluate` so every federation snapshot — and therefore every
+peer's ``GET /mesh`` — carries this node's SLO posture with zero new
+wire surface. Read paths: rspc ``telemetry.slo``, ``sdx slo``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+#: SRE-workbook-shaped defaults: (window_seconds, burn_threshold)
+FAST_WINDOW_S = 300.0
+SLOW_WINDOW_S = 3600.0
+FAST_BURN = 14.4
+SLOW_BURN = 6.0
+
+OK = "ok"
+WARN = "warn"
+BREACH = "breach"
+NO_DATA = "no_data"
+
+_RANK = {OK: 0, NO_DATA: 0, WARN: 1, BREACH: 2}
+
+
+@dataclass(frozen=True)
+class SLO:
+    """One declarative objective over a history series.
+
+    ``kind``:
+    - ``upper``: a sample is good while ``value <= objective``;
+    - ``lower``: good while ``value >= objective`` — with
+      ``ignore_zero`` (pass throughput) samples at 0 are idle, not bad;
+    - ``zero_tolerance``: the series is a cumulative counter; ANY
+      increase inside the fast window breaches.
+    """
+
+    name: str
+    series: str
+    objective: float
+    kind: str = "upper"  # upper | lower | zero_tolerance
+    target: float = 0.99
+    description: str = ""
+    ignore_zero: bool = False
+    fast_window_s: float = FAST_WINDOW_S
+    slow_window_s: float = SLOW_WINDOW_S
+    fast_burn: float = FAST_BURN
+    slow_burn: float = SLOW_BURN
+
+    def is_good(self, value: float) -> bool | None:
+        """None = the sample doesn't count (idle)."""
+        if self.ignore_zero and value == 0:
+            return None
+        if self.kind == "lower":
+            return value >= self.objective
+        return value <= self.objective
+
+
+def default_slos() -> list[SLO]:
+    objective = float(os.environ.get("SD_SLO_INTERACTIVE_P99_MS", "250"))
+    throughput = float(os.environ.get("SD_SLO_FILES_PER_S", "50"))
+    return [
+        SLO("interactive_p99", series="interactive_p99_ms",
+            objective=objective, kind="upper", target=0.99,
+            description="serve-layer interactive request p99 under "
+                        f"{objective:g} ms"),
+        SLO("sync_lag", series="sync_lag_max_s", objective=600.0,
+            kind="upper", target=0.99,
+            description="worst per-peer replication lag under the sync "
+                        "unhealthy bar (600 s)"),
+        SLO("pass_throughput", series="files_per_s", objective=throughput,
+            kind="lower", target=0.95, ignore_zero=True,
+            description=f"observed identify throughput ≥ {throughput:g} "
+                        "files/s while a pass is running (idle samples "
+                        "don't count)"),
+        SLO("protected_sheds", series="protected_sheds_total",
+            objective=0.0, kind="zero_tolerance", target=1.0,
+            description="control/sync-class sheds are contractually zero "
+                        "— any increase is an immediate breach"),
+    ]
+
+
+class SloRegistry:
+    """Named SLOs + the last evaluation (process-global)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._slos: dict[str, SLO] = {}
+        self.last_evaluation: dict[str, Any] | None = None
+        for s in default_slos():
+            self._slos[s.name] = s
+
+    def register(self, slo: SLO) -> None:
+        with self._lock:
+            self._slos[slo.name] = slo
+
+    def get(self, name: str) -> SLO | None:
+        with self._lock:
+            return self._slos.get(name)
+
+    def all(self) -> list[SLO]:
+        with self._lock:
+            return list(self._slos.values())
+
+    def reset(self) -> None:
+        """telemetry.reset(): restore defaults, drop evaluation state."""
+        with self._lock:
+            self._slos = {s.name: s for s in default_slos()}
+            self.last_evaluation = None
+
+
+REGISTRY = SloRegistry()
+
+
+# --- evaluation ----------------------------------------------------------
+
+
+def _window_stats(slo: SLO, samples: list[tuple[float, float]]) \
+        -> dict[str, Any]:
+    good = bad = 0
+    for _, v in samples:
+        verdict = slo.is_good(v)
+        if verdict is None:
+            continue
+        if verdict:
+            good += 1
+        else:
+            bad += 1
+    counted = good + bad
+    bad_fraction = (bad / counted) if counted else 0.0
+    budget = max(1e-9, 1.0 - slo.target)
+    return {
+        "samples": counted,
+        "bad": bad,
+        "bad_fraction": round(bad_fraction, 4),
+        "burn": round(bad_fraction / budget, 2),
+    }
+
+
+def _counter_increase(samples: list[tuple[float, float]]) -> float:
+    vals = [v for _, v in samples]
+    if len(vals) < 2:
+        return 0.0
+    # cumulative counter: restart resets read as no increase (monotonic
+    # re-baselining), increases sum across the window
+    inc = 0.0
+    prev = vals[0]
+    for v in vals[1:]:
+        if v > prev:
+            inc += v - prev
+        prev = v
+    return inc
+
+
+def evaluate_slo(slo: SLO, samples_for: Callable[[float],
+                                                 list[tuple[float, float]]],
+                 now: float | None = None) -> dict[str, Any]:
+    """One SLO against a window-reader ``samples_for(seconds) ->
+    [(ts, value)]``."""
+    fast = samples_for(slo.fast_window_s)
+    slow = samples_for(slo.slow_window_s)
+    current = fast[-1][1] if fast else (slow[-1][1] if slow else None)
+    doc: dict[str, Any] = {
+        "name": slo.name,
+        "series": slo.series,
+        "kind": slo.kind,
+        "objective": slo.objective,
+        "target": slo.target,
+        "description": slo.description,
+        "current": current,
+    }
+    if slo.kind == "zero_tolerance":
+        inc = _counter_increase(fast)
+        doc["windows"] = {
+            "fast": {"seconds": slo.fast_window_s, "samples": len(fast),
+                     "increase": inc},
+        }
+        if not fast:
+            doc["status"] = NO_DATA
+        else:
+            doc["status"] = BREACH if inc > 0 else OK
+        return doc
+    f, s = _window_stats(slo, fast), _window_stats(slo, slow)
+    doc["windows"] = {
+        "fast": {"seconds": slo.fast_window_s, **f,
+                 "burn_threshold": slo.fast_burn},
+        "slow": {"seconds": slo.slow_window_s, **s,
+                 "burn_threshold": slo.slow_burn},
+    }
+    if f["samples"] == 0 and s["samples"] == 0:
+        doc["status"] = NO_DATA
+    elif f["burn"] >= slo.fast_burn and s["burn"] >= slo.slow_burn:
+        doc["status"] = BREACH
+    elif f["burn"] >= slo.fast_burn:
+        doc["status"] = WARN
+    else:
+        doc["status"] = OK
+    return doc
+
+
+def evaluate(history: Any = None, *, directory: str | None = None,
+             now: float | None = None) -> dict[str, Any]:
+    """Every registered SLO against a history source: a live
+    :class:`~spacedrive_tpu.telemetry.history.HistoryWriter` (tail-backed
+    fast path — the /health + federation read), or a bare history
+    ``directory`` (``sdx slo`` offline / post-restart)."""
+    from . import metrics as _tm
+
+    now = now if now is not None else time.time()
+    results: list[dict[str, Any]] = []
+    worst = NO_DATA
+    for slo in REGISTRY.all():
+        if history is not None:
+            def samples_for(seconds: float, _s=slo) \
+                    -> list[tuple[float, float]]:
+                recs = history.recent(seconds, now=now)
+                return [
+                    (r["ts"], float(r["v"][_s.series]))
+                    for r in recs
+                    if isinstance((r.get("v") or {}).get(_s.series),
+                                  (int, float))
+                    and not isinstance(r["v"][_s.series], bool)
+                ]
+        elif directory is not None:
+            from .history import series as _series
+
+            def samples_for(seconds: float, _s=slo) \
+                    -> list[tuple[float, float]]:
+                return _series(directory, _s.series, since=now - seconds,
+                               until=now)
+        else:
+            def samples_for(seconds: float) -> list[tuple[float, float]]:
+                return []
+        doc = evaluate_slo(slo, samples_for, now=now)
+        results.append(doc)
+        if _RANK[doc["status"]] > _RANK[worst] or (
+            worst == NO_DATA and doc["status"] == OK
+        ):
+            # rank-0 tie: an evaluated-and-met objective upgrades the
+            # rollup from "no data" to "ok"
+            worst = doc["status"]
+        _tm.SLO_STATUS.set(_RANK[doc["status"]], slo=slo.name)
+    evaluation = {"ts": now, "status": worst, "slos": results}
+    _tm.SLO_EVALUATIONS.inc()
+    REGISTRY.last_evaluation = evaluation
+    return evaluation
+
+
+def reset() -> None:
+    REGISTRY.reset()

@@ -74,6 +74,12 @@ FAULT_POINTS: dict[str, tuple[str, tuple[str, ...]]] = {
         "vanishes mid-exchange",
         ("vanish",),
     ),
+    "p2p.trace_pull": (
+        "inbound TELEMETRY trace_pull responder (p2p/manager) — the "
+        "peer vanishes before serving its spans; distributed trace "
+        "assembly must degrade to a partial report, never block",
+        ("vanish",),
+    ),
     "p2p.steal": (
         "work-stealing shard plane (p2p/work.py): `vanish` at arg "
         "'lease' kills the claiming worker after the lease is granted "

@@ -129,3 +129,63 @@ def test_offline_debug_bundle_cli_path(tmp_path):
     empty = build_bundle(data_dir=str(tmp_path / "nothing"))
     assert empty["node_config"] is None
     assert empty["versions"]
+
+
+@pytest.mark.asyncio
+async def test_slo_smoke_attribution_and_slo_surfaces(tmp_path, corpus):
+    """`make slo-smoke`: boot a node, run a small pass, and assert a
+    well-formed attribution report (buckets sum to the window, the
+    critical path is non-empty, the pass is findable as "the last
+    pass") plus a complete SLO evaluation over live history."""
+    import aiohttp
+
+    from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+    from spacedrive_tpu.node import Node
+
+    node = Node(os.path.join(tmp_path, "slo-node"), use_device=False,
+                with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        lib = await node.create_library("slo-lib")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+        await scan_location(lib, loc, node.jobs)
+        await node.jobs.wait_idle()
+        node.history.sample()  # don't wait for the 10 s timer
+        port = await node.start_api()
+        async with aiohttp.ClientSession() as http:
+            async with http.get(f"http://127.0.0.1:{port}/attrib") as resp:
+                assert resp.status == 200
+                report = json.loads(await resp.text())
+            async with http.post(
+                f"http://127.0.0.1:{port}/rspc/telemetry.slo", json={},
+            ) as resp:
+                assert resp.status == 200
+                slo_doc = (await resp.json())["result"]
+            async with http.post(
+                f"http://127.0.0.1:{port}/rspc/telemetry.attrib",
+                json={},
+            ) as resp:
+                assert resp.status == 200
+                rspc_report = (await resp.json())["result"]
+    finally:
+        await node.shutdown()
+
+    # attribution: resolved "the last pass" via the job-boundary
+    # markers, with a sane partition and a non-empty critical path
+    assert "error" not in report, report
+    assert report["spans"] > 0
+    assert report["wall_seconds"] > 0
+    assert sum(report["buckets"].values()) == pytest.approx(
+        report["wall_seconds"], abs=1e-4)  # per-bucket 6-dp rounding
+    assert report["top_segments"], "empty critical path"
+    assert set(report["buckets"]) == {
+        "device", "host_cpu", "link", "queue_wait", "gap"}
+    assert rspc_report["trace_id"] == report["trace_id"]
+
+    # SLO: every default objective evaluated; nothing breached by a
+    # healthy 5-file pass
+    names = {s["name"] for s in slo_doc["slos"]}
+    assert names == {"interactive_p99", "sync_lag", "pass_throughput",
+                     "protected_sheds"}
+    assert slo_doc["status"] in ("ok", "no_data")

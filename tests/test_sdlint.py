@@ -1983,3 +1983,104 @@ def test_sd017_carrier_caller_subsumes_callee_obligation(tmp_path):
         ["SD017"],
     )
     assert findings == []
+
+
+# --- SD020 metric-catalog-drift --------------------------------------------
+
+
+def _catalog(tmp_path, rows):
+    doc = tmp_path / "telemetry.md"
+    lines = ["# Telemetry", "", "| metric | type | labels | source |",
+             "|---|---|---|---|"]
+    lines += [f"| `{name}` | counter | – | fixture |" for name in rows]
+    doc.write_text("\n".join(lines) + "\n")
+    return doc
+
+
+def run_sd020(tmp_path, source, catalog_rows, monkeypatch):
+    doc = _catalog(tmp_path, catalog_rows)
+    monkeypatch.setenv("SDLINT_TELEMETRY_CATALOG", str(doc))
+    return run_on(tmp_path, source, ["SD020"])
+
+
+def test_sd020_minted_family_without_catalog_row(tmp_path, monkeypatch):
+    findings = run_sd020(
+        tmp_path,
+        """
+        from .registry import REGISTRY
+
+        CATALOGED = REGISTRY.counter("sd_cataloged_total", "fine")
+        ORPHANED = REGISTRY.gauge("sd_orphaned_gauge", "missing from docs")
+        """,
+        ["sd_cataloged_total"],
+        monkeypatch,
+    )
+    assert rules_of(findings) == ["SD020"]
+    assert len(findings) == 1
+    assert "sd_orphaned_gauge" in findings[0].message
+    assert findings[0].path.endswith("fixture.py")
+
+
+def test_sd020_stale_catalog_row(tmp_path, monkeypatch):
+    findings = run_sd020(
+        tmp_path,
+        """
+        from .registry import REGISTRY
+
+        LIVE = REGISTRY.histogram("sd_live_seconds", "fine")
+        """,
+        ["sd_live_seconds", "sd_deleted_long_ago_total"],
+        monkeypatch,
+    )
+    assert len(findings) == 1
+    assert "sd_deleted_long_ago_total" in findings[0].message
+    assert findings[0].path.endswith("telemetry.md")
+    assert findings[0].line > 0
+
+
+def test_sd020_complete_catalog_is_clean(tmp_path, monkeypatch):
+    findings = run_sd020(
+        tmp_path,
+        """
+        import telemetry
+        from .registry import REGISTRY
+
+        A = REGISTRY.counter("sd_a_total", "x", labels=("k",))
+        B = telemetry.gauge("sd_b")
+        NOT_A_METRIC = other.thing("sd_not_minted_here")
+        """,
+        ["sd_a_total", "sd_b"],
+        monkeypatch,
+    )
+    assert findings == []
+
+
+def test_sd020_missing_catalog_flags_once(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "SDLINT_TELEMETRY_CATALOG", str(tmp_path / "nonexistent.md"))
+    findings = run_on(
+        tmp_path,
+        """
+        from .registry import REGISTRY
+
+        A = REGISTRY.counter("sd_a_total", "x")
+        B = REGISTRY.counter("sd_b_total", "x")
+        """,
+        ["SD020"],
+    )
+    assert len(findings) == 1
+    assert "missing" in findings[0].message
+
+
+def test_sd020_tree_without_metrics_needs_no_catalog(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "SDLINT_TELEMETRY_CATALOG", str(tmp_path / "nonexistent.md"))
+    findings = run_on(
+        tmp_path,
+        """
+        def plain():
+            return 1
+        """,
+        ["SD020"],
+    )
+    assert findings == []

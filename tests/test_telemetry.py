@@ -211,6 +211,75 @@ def test_telemetry_reset_clears_spans_trace_and_event_rings():
     assert events.ring("reset_probe_ring").snapshot() == []
 
 
+def test_telemetry_reset_clears_attrib_slo_and_history_tails(tmp_path):
+    """reset() must also clear the observability planes ISSUE 12 added:
+    the attribution report cache + pass markers, SLO evaluation state,
+    and every live history writer's in-memory tail — WITHOUT touching
+    the durable history segments (data-dir state, not process state)."""
+    from spacedrive_tpu.telemetry import attrib, history, slo
+
+    attrib.mark_pass("indexer", "t-reset", "settled", status="COMPLETED")
+    attrib._cache_store("t-reset", {"trace_id": "t-reset"})
+    w = history.HistoryWriter(
+        str(tmp_path / "hist"), samplers={"x": lambda: 1.0})
+    w.sample()
+    slo.evaluate(w)
+    assert attrib.last_pass_trace() == "t-reset"
+    assert slo.REGISTRY.last_evaluation is not None
+    assert len(w.tail) == 1
+
+    telemetry.reset()
+
+    assert attrib.last_pass_trace() is None
+    assert attrib.cached_report("t-reset") is None
+    assert slo.REGISTRY.last_evaluation is None
+    assert len(w.tail) == 0
+    assert len(history.read(w.dir)) == 1  # durable segments survive
+
+
+def test_overflowing_ring_reports_drops_honestly():
+    """A bounded ring that displaces events must SAY so: per-ring drop
+    counter, the sd_ring_dropped_total{ring} series, and the debug
+    bundle's ring_drops section."""
+    from spacedrive_tpu.telemetry import events
+    from spacedrive_tpu.telemetry.bundle import build_bundle
+
+    telemetry.reset()
+    ring = events.ring("overflow_probe", capacity=8)
+    for i in range(20):
+        ring.emit("tick", i=i)
+    assert len(ring) == 8
+    assert ring.dropped == 12
+    assert telemetry.counter_value(
+        "sd_ring_dropped_total", ring="overflow_probe") == 12
+    assert events.drop_counts()["overflow_probe"] == 12
+    # the debug bundle carries the same honesty
+    bundle = build_bundle()
+    assert bundle["ring_drops"]["overflow_probe"] == 12
+    # federation ring digests flag the saturated ring mesh-wide
+    from spacedrive_tpu.telemetry.federation import _ring_digests
+
+    assert _ring_digests()["overflow_probe"]["dropped"] == 12
+    # clear() resets the account alongside the payloads
+    ring.clear()
+    assert ring.dropped == 0
+    telemetry.reset()
+
+
+def test_ring_within_capacity_drops_nothing():
+    from spacedrive_tpu.telemetry import events
+
+    telemetry.reset()
+    ring = events.ring("no_overflow_probe", capacity=8)
+    for i in range(8):
+        ring.emit("tick", i=i)
+    assert ring.dropped == 0
+    assert telemetry.counter_value(
+        "sd_ring_dropped_total", ring="no_overflow_probe") == 0
+    assert "no_overflow_probe" not in events.drop_counts()
+    telemetry.reset()
+
+
 # --- spans ----------------------------------------------------------------
 
 

@@ -53,6 +53,7 @@ import json
 import os
 import re
 import sys
+from statistics import median
 from typing import Any
 
 DEFAULT_THRESHOLD = 0.15
@@ -145,6 +146,58 @@ def e2e_series(doc: dict[str, Any]) -> dict[str, float]:
     return out
 
 
+# attribution buckets (bench_e2e attrib_summary: seconds per 1000
+# items, LOWER is better). Buckets under the floor are noise — a 15%
+# swing on 10 ms/kfile is measurement jitter, not a regression — but a
+# bucket growing from under the floor to twice it still fails.
+ATTRIB_MIN_S_PER_KFILE = 0.5
+_ATTRIB_KEYS = ("device_s_per_kfile", "host_cpu_s_per_kfile",
+                "link_s_per_kfile", "queue_wait_s_per_kfile",
+                "gap_s_per_kfile")
+
+
+def _compare_attrib(cfg: str, old_cfg: dict[str, Any],
+                    new_cfg: dict[str, Any], threshold: float,
+                    checked: list, regressions: list,
+                    skipped: list) -> None:
+    """Gate one config's attribution bucket split (lower-is-better
+    seconds; a bucket absorbing >threshold more time per file fails
+    like any rate regression). Configs that ran under a congested link
+    (blocked or link_context) are excused wholesale — a weather-
+    inflated link bucket reshuffles every share."""
+    old_a, new_a = old_cfg.get("attrib"), new_cfg.get("attrib")
+    if not isinstance(old_a, dict) or not isinstance(new_a, dict):
+        return
+    if old_cfg.get("blocked") or new_cfg.get("blocked") \
+            or old_cfg.get("link_context") or new_cfg.get("link_context"):
+        skipped.append(f"{cfg}.attrib: congested-link run on one side")
+        return
+    for key in _ATTRIB_KEYS:
+        ov, nv = old_a.get(key), new_a.get(key)
+        if not isinstance(ov, (int, float)) \
+                or not isinstance(nv, (int, float)):
+            continue
+        name = f"{cfg}.attrib.{key}"
+        if max(ov, nv) < ATTRIB_MIN_S_PER_KFILE:
+            continue  # sub-floor noise either side
+        if ov < ATTRIB_MIN_S_PER_KFILE:
+            # a bucket appearing from (near) nothing: gate absolutely
+            bad = nv >= 2 * ATTRIB_MIN_S_PER_KFILE
+            rec = {"name": name, "old": ov, "new": nv,
+                   "delta_pct": float("inf") if ov == 0
+                   else round((nv - ov) / ov * 100, 2)}
+            checked.append(rec)
+            if bad:
+                regressions.append(rec)
+            continue
+        delta = (nv - ov) / ov
+        rec = {"name": name, "old": ov, "new": nv,
+               "delta_pct": round(delta * 100, 2)}
+        checked.append(rec)
+        if delta > threshold:
+            regressions.append(rec)
+
+
 def compare_e2e(old: dict[str, Any], new: dict[str, Any],
                 threshold: float = DEFAULT_THRESHOLD) -> dict[str, Any]:
     """Diff two BENCH_E2E documents (same result shape as compare())."""
@@ -152,6 +205,11 @@ def compare_e2e(old: dict[str, Any], new: dict[str, Any],
     checked: list[dict[str, Any]] = []
     regressions: list[dict[str, Any]] = []
     skipped: list[str] = []
+    for cfg in _E2E_CONFIGS:
+        old_cfg, new_cfg = old.get(cfg), new.get(cfg)
+        if isinstance(old_cfg, dict) and isinstance(new_cfg, dict):
+            _compare_attrib(cfg, old_cfg, new_cfg, threshold,
+                            checked, regressions, skipped)
     for name in sorted(old_s):
         cfg, _, key = name.partition(".")
         if name not in new_s:
@@ -277,6 +335,67 @@ def check_autotune(doc: dict[str, Any]) -> dict[str, Any]:
             "skipped": skipped}
 
 
+# --- telemetry-history leg (telemetry/history.py segment store) ------------
+
+#: history series gated as higher-is-better rates; idle (0) samples are
+#: excluded — a node that stopped indexing is quiet, not slow
+_HISTORY_RATE_SERIES = ("files_per_s",)
+#: recent window = the trailing fraction of the series compared against
+#: the median of everything before it
+HISTORY_RECENT_FRACTION = 0.2
+HISTORY_MIN_SAMPLES = 10
+
+
+def check_history(directory: str,
+                  threshold: float = DEFAULT_THRESHOLD) -> dict[str, Any]:
+    """Gate a node's persistent telemetry history (the
+    ``<data-dir>/telemetry_history/`` segment store): the recent
+    window's median throughput must not sit more than ``threshold``
+    below the long-baseline median. Unlike the artifact diffs, this
+    reads the *continuous* series — restarts included — so a
+    regression that landed between two bench rounds still fails."""
+    # the history store is plain JSONL; the reader lives with the
+    # writer so the two formats cannot drift apart. Script invocation
+    # puts tools/ (not the repo root) on sys.path — fix that up.
+    try:
+        from spacedrive_tpu.telemetry import history as _history
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from spacedrive_tpu.telemetry import history as _history
+
+    checked: list[dict[str, Any]] = []
+    regressions: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    for name in _HISTORY_RATE_SERIES:
+        samples = [v for _, v in _history.series(directory, name) if v > 0]
+        full = f"history.{name}"
+        if len(samples) < HISTORY_MIN_SAMPLES:
+            skipped.append(
+                f"{full}: {len(samples)} non-idle samples "
+                f"(< {HISTORY_MIN_SAMPLES}) — nothing to gate"
+            )
+            continue
+        cut = max(1, int(len(samples) * (1 - HISTORY_RECENT_FRACTION)))
+        baseline, recent = samples[:cut], samples[cut:]
+        if not recent:
+            skipped.append(f"{full}: no recent window")
+            continue
+        ov, nv = median(baseline), median(recent)
+        if ov <= 0:
+            skipped.append(f"{full}: non-positive baseline {ov}")
+            continue
+        delta = (nv - ov) / ov
+        rec = {"name": full, "old": round(ov, 2), "new": round(nv, 2),
+               "delta_pct": round(delta * 100, 2)}
+        checked.append(rec)
+        if delta < -threshold:
+            regressions.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "skipped": skipped}
+
+
 def latest_pair(bench_dir: str) -> tuple[str, str] | None:
     files = sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json")))
     if len(files) < 2:
@@ -294,6 +413,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
                     help="fractional regression that fails the gate "
                          "(default 0.15 = 15%%)")
+    ap.add_argument("--history", metavar="DIR", default=None,
+                    help="additionally gate a node's persistent telemetry "
+                         "history (<data-dir>/telemetry_history): recent "
+                         "median throughput vs the long baseline — "
+                         "regressions that landed between bench rounds "
+                         "still fail")
     args = ap.parse_args(argv)
 
     if args.files and len(args.files) != 2:
@@ -380,6 +505,11 @@ def main(argv: list[str] | None = None) -> int:
             render("BENCH_SERVE.json (absolute graceful-degradation bars)",
                    result)
             total_regressions += len(result["regressions"])
+
+    if args.history:
+        result = check_history(args.history, args.threshold)
+        render(f"telemetry history ({args.history})", result)
+        total_regressions += len(result["regressions"])
 
     if total_regressions:
         print(f"bench-compare: {total_regressions} series regressed "

@@ -7,6 +7,9 @@ SD009  event-ring emissions with non-constant event types / unauditable
        field expansion
 SD010  peer/instance identifiers fed into metric labels without the
        ``peer_label`` short-hash
+SD020  metric-catalog drift: every ``sd_*`` family minted in the tree
+       must have a ``docs/telemetry.md`` catalog row, and every catalog
+       row must name a family that still exists
 
 SD007 keys off this repo's conventions: metric handles are ALL_CAPS
 module attributes (``metrics.SPAN_SECONDS``, ``THUMB_FILES``) and label
@@ -302,6 +305,115 @@ def check_event_ring_cardinality(ctx: FileContext) -> Iterator[Finding]:
                     f"names must be literal keywords so ring consumers "
                     f"can rely on the schema",
                 )
+
+
+# -- SD020 ------------------------------------------------------------------
+
+import os as _os
+import re as _re
+from pathlib import Path
+
+from ..core import Finding, ProjectContext
+
+#: registry factory method names whose first positional string argument
+#: is a metric family name
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+#: env override so fixture tests can point the rule at a temp catalog
+_CATALOG_ENV = "SDLINT_TELEMETRY_CATALOG"
+_CATALOG_DEFAULT = "docs/telemetry.md"
+
+#: a catalog row: a markdown table line whose FIRST cell names the
+#: family in backticks
+_CATALOG_ROW = _re.compile(r"^\|\s*`(sd_[a-z0-9_]+)`")
+
+
+def _catalog_path() -> Path:
+    return Path(_os.environ.get(_CATALOG_ENV, _CATALOG_DEFAULT))
+
+
+def _catalog_rows(path: Path) -> list[tuple[str, int, str]]:
+    """(family, 1-based line, raw line) per catalog table row."""
+    out: list[tuple[str, int, str]] = []
+    try:
+        lines = path.read_text(encoding="utf-8").splitlines()
+    except OSError:
+        return []
+    for i, line in enumerate(lines, start=1):
+        m = _CATALOG_ROW.match(line.strip())
+        if m:
+            out.append((m.group(1), i, line))
+    return out
+
+
+def _minted_families(project: ProjectContext) \
+        -> dict[str, tuple[FileContext, ast.AST]]:
+    """Every ``sd_*`` family name passed as the first literal argument
+    to a registry factory (``REGISTRY.counter("sd_…")`` and the
+    ``telemetry.counter(...)`` helpers), keyed to its first mint site."""
+    out: dict[str, tuple[FileContext, ast.AST]] = {}
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None \
+                    or callee.rsplit(".", 1)[-1] not in _METRIC_FACTORIES:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str) \
+                    and first.value.startswith("sd_"):
+                out.setdefault(first.value, (ctx, node))
+    return out
+
+
+@rule(
+    "SD020",
+    "metric-catalog-drift",
+    "every sd_* metric family minted in the tree needs a docs/telemetry.md "
+    "catalog row, and every catalog row must name a family that still "
+    "exists — an uncataloged series is invisible to operators, a stale "
+    "row documents a lie",
+    project=True,
+)
+def check_metric_catalog(project: ProjectContext) -> Iterator[Finding]:
+    minted = _minted_families(project)
+    if not minted:
+        return  # fixture trees with no metrics have nothing to drift
+    path = _catalog_path()
+    rows = _catalog_rows(path)
+    if not rows:
+        ctx, node = next(iter(minted.values()))
+        yield ctx.finding(
+            "SD020",
+            node,
+            f"metric families are minted here but the catalog "
+            f"({path.as_posix()}) is missing or has no `sd_*` table rows "
+            f"— document every family",
+        )
+        return
+    cataloged = {name for name, _, _ in rows}
+    for name, (ctx, node) in sorted(minted.items()):
+        if name not in cataloged:
+            yield ctx.finding(
+                "SD020",
+                node,
+                f"metric family `{name}` has no catalog row in "
+                f"{path.as_posix()} — add one (name, type, labels, source)",
+            )
+    for name, line_no, raw in rows:
+        if name not in minted:
+            snippet = " ".join(raw.split())[:160]
+            yield Finding(
+                "SD020",
+                path.as_posix(),
+                line_no,
+                0,
+                f"catalog row for `{name}` names a family no longer minted "
+                f"anywhere in the tree — delete or fix the stale row",
+                snippet,
+            )
 
 
 # -- SD008 ------------------------------------------------------------------
