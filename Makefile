@@ -3,8 +3,9 @@
 
 PY ?= python
 
-.PHONY: lint test tier1 trace-smoke slo-smoke debug-bundle bench-devices \
-	bench-check bench-warm bench-autotune bench-mesh bench-serve chaos
+.PHONY: lint test tier1 trace-smoke slo-smoke profile-smoke debug-bundle \
+	bench-devices bench-check bench-warm bench-autotune bench-mesh \
+	bench-serve chaos
 
 # set SDLINT_ANNOTATE=1 in CI for GitHub ::error annotations on the diff
 lint:
@@ -98,6 +99,14 @@ slo-smoke:
 		"tests/test_observability_smoke.py::test_slo_smoke_attribution_and_slo_surfaces" \
 		tests/test_attrib.py tests/test_slo_history.py \
 		-q -p no:cacheprovider
+
+# host-profiling smoke: boot a node → small identify pass → non-empty
+# folded profile whose named frame groups cover ≥70% of sampled wall →
+# gap-decomposed attribution report; plus the sampler/trigger/mesh-pull
+# unit tiers (docs/observability.md "Host profiling")
+profile-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_profile.py \
+		-q -m 'not slow' -p no:cacheprovider
 
 # offline redacted diagnostic bundle (add SDX_URL=http://... for a live
 # node's bundle instead)

@@ -213,7 +213,12 @@ def attrib_summary(raw: dict | None, items: int, wall_s: float) -> dict | None:
     normalized per 1000 items (corpus-size-independent) plus the span
     coverage of the measured wall time. Buckets are lower-is-better;
     tools/bench_compare.py fails a >15% bucket regression like any
-    rate regression."""
+    rate regression. When the host profiler decomposed the gap bucket
+    (telemetry/sampler.py), the top-5 named frame groups ride along as
+    ``gap_<group>_s_per_kfile`` — the baseline artifact the multi-
+    process execution-plane PR (ROADMAP item 2) will be judged
+    against: its win must show up as these groups shrinking, not just
+    the anonymous gap."""
     if not raw or not items:
         return None
     buckets = raw.get("buckets") or {}
@@ -223,6 +228,13 @@ def attrib_summary(raw: dict | None, items: int, wall_s: float) -> dict | None:
     }
     wall = raw.get("wall_seconds") or 0.0
     out["coverage"] = round(wall / wall_s, 4) if wall_s > 0 else 0.0
+    decomp = raw.get("gap_decomposition") or {}
+    groups = decomp.get("groups") or {}
+    for name, sec in sorted(groups.items(), key=lambda kv: kv[1],
+                            reverse=True)[:5]:
+        out[f"gap_{name}_s_per_kfile"] = round(sec / items * 1000.0, 4)
+    if decomp:
+        out["gap_decomposed_coverage"] = decomp.get("coverage")
     return out
 
 

@@ -1672,6 +1672,21 @@ def _telemetry(r: Router) -> None:
             refresh=bool(opts.get("refresh")),
         )
 
+    @r.query("telemetry.profile", priority="background")
+    async def profile(node, arg=None):
+        # the continuous host profiler: frame groups, on-CPU vs
+        # GIL-wait split, deep-capture windows. arg {mesh?: bool,
+        # format?: "folded"}. BACKGROUND like trace_export — the mesh
+        # leg dials peers, so it must never ride the control class
+        from ..telemetry import sampler as _sampler
+
+        opts = arg if isinstance(arg, dict) else {}
+        if opts.get("format") == "folded":
+            return {"folded": _sampler.SAMPLER.folded()}
+        if opts.get("mesh"):
+            return await _sampler.mesh_profile(node)
+        return _sampler.SAMPLER.profile()
+
     @r.query("telemetry.slo")
     def slo(node):
         # SLO burn-rate posture over the node's persistent history

@@ -82,6 +82,7 @@ class ApiServer:
                 self._gated(web.get("/metrics", self._metrics), CONTROL),
                 self._gated(web.get("/trace", self._trace), BACKGROUND),
                 self._gated(web.get("/attrib", self._attrib), BACKGROUND),
+                self._gated(web.get("/profile", self._profile), BACKGROUND),
                 self._gated(web.get("/health", self._health), CONTROL),
                 self._gated(web.get("/mesh", self._mesh), INTERACTIVE),
                 self._gated(
@@ -251,6 +252,31 @@ class ApiServer:
             )
             doc = result.value
         return web.json_response(doc, dumps=_dumps)
+
+    async def _profile(self, request: web.Request) -> web.Response:
+        """The continuous host profiler (telemetry/sampler.py):
+        collapsed-stack frame groups, on-CPU vs GIL-wait split, and
+        triggered deep-capture windows. `?format=folded` serves
+        flamegraph.pl collapsed-stack text (pipe into flamegraph.pl or
+        speedscope); `?mesh=1` also pulls every reachable peer's
+        profile over the TELEMETRY wire (partial on pull failures,
+        never blocking). BACKGROUND class — the mesh leg dials peers,
+        so it must never ride the unsheddable control class."""
+        from ..telemetry import sampler as _sampler_mod
+
+        if request.query.get("format") == "folded":
+            return web.Response(
+                text=_sampler_mod.SAMPLER.folded(),
+                content_type="text/plain",
+                charset="utf-8",
+            )
+        if request.query.get("mesh") == "1":
+            return web.json_response(
+                await _sampler_mod.mesh_profile(self.node), dumps=_dumps
+            )
+        return web.json_response(
+            _sampler_mod.SAMPLER.profile(), dumps=_dumps
+        )
 
     async def _health(self, _request: web.Request) -> web.Response:
         """Per-subsystem → per-node health rollup (telemetry.health).

@@ -636,6 +636,82 @@ def cmd_attrib(args: argparse.Namespace) -> int:
     return 0
 
 
+async def cmd_profile_peer(args: argparse.Namespace) -> int:
+    """Pull a MESH PEER's host profile over the TELEMETRY wire
+    (profile_pull — the same library-members-only trust bar as
+    trace_pull; frame names are module:function only, so nothing
+    needing redaction rides the wire)."""
+    from .p2p.identity import RemoteIdentity
+    from .p2p.manager import SYNC_POLICY
+    from .p2p.operations import request_profile
+    from .utils.resilience import BreakerOpen
+
+    async with _mesh_node(args) as node:
+        try:
+            doc = await SYNC_POLICY.call(
+                args.peer,
+                lambda: request_profile(
+                    node.p2p.p2p, RemoteIdentity.from_str(args.peer)
+                ),
+            )
+        except PermissionError as e:
+            print(f"profile: peer refused: {e}", file=sys.stderr)
+            return 1
+        except (BreakerOpen, ValueError, ConnectionError, OSError,
+                EOFError, asyncio.TimeoutError) as e:
+            print(f"profile: cannot reach peer: {e}", file=sys.stderr)
+            return 1
+        if args.folded:
+            _write_or_print(str(doc.get("folded", "")).rstrip("\n"),
+                            args.out)
+        else:
+            _write_or_print(json.dumps(doc.get("profile"), indent=2),
+                            args.out)
+        return 0
+
+
+def cmd_profile(args: argparse.Namespace) -> int:
+    """Host-profile read path: the continuous sampler's collapsed-stack
+    view from a running node (--url, default), or pulled from a mesh
+    peer (--peer). --folded emits flamegraph.pl collapsed-stack text —
+    pipe it into flamegraph.pl / speedscope."""
+    if args.peer:
+        return asyncio.run(cmd_profile_peer(args))
+    import urllib.error
+
+    url = args.url.rstrip("/") + "/profile"
+    if args.folded:
+        url += "?format=folded"
+    elif args.mesh:
+        url += "?mesh=1"
+    try:
+        doc = _http_get(url)
+    except (urllib.error.URLError, OSError) as e:
+        print(f"profile: cannot reach {url}: {e}", file=sys.stderr)
+        print("is a node running? start one with `sdx serve`",
+              file=sys.stderr)
+        return 1
+    if args.folded:
+        _write_or_print(doc.rstrip("\n"), args.out)
+        return 0
+    try:
+        parsed = json.loads(doc)
+    except ValueError:
+        print(f"profile: {url} did not return JSON "
+              f"(is that really an sdx node?)", file=sys.stderr)
+        return 1
+    _write_or_print(json.dumps(parsed, indent=2), args.out)
+    local = parsed.get("local") if args.mesh else parsed
+    if isinstance(local, dict) and local.get("enabled"):
+        groups = local.get("frame_groups") or []
+        split = "  ".join(
+            f"{g['group']}={g['share']:.0%}" for g in groups[:5]
+        )
+        print(f"profile: {local.get('samples', 0)} samples over "
+              f"{local.get('duration_s', 0)}s — {split}", file=sys.stderr)
+    return 0
+
+
 def cmd_slo(args: argparse.Namespace) -> int:
     """SLO burn-rate posture. With --url, the live evaluation from a
     running node (rspc telemetry.slo); otherwise evaluated offline over
@@ -910,6 +986,28 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bypass the report cache and re-pull mesh peers")
     at.add_argument("--out", help="write JSON here instead of stdout")
 
+    pf = sub.add_parser(
+        "profile",
+        help="continuous host profile: collapsed-stack frame groups, "
+             "on-CPU vs GIL-wait split, triggered deep captures "
+             "(flamegraph.pl text with --folded)",
+    )
+    pf.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="the node's HTTP API origin (sdx serve)")
+    pf.add_argument("--peer", default=None, metavar="IDENTITY",
+                    help="pull a MESH PEER's profile over the TELEMETRY "
+                         "wire (library members only, like trace_pull)")
+    pf_fmt = pf.add_mutually_exclusive_group()
+    pf_fmt.add_argument("--folded", action="store_true",
+                        help="emit flamegraph.pl collapsed-stack text "
+                             "instead of the JSON document")
+    pf_fmt.add_argument("--mesh", action="store_true",
+                        help="with --url: include every reachable peer's "
+                             "profile (partial on pull failures)")
+    pf.add_argument("--wait", type=float, default=3.0,
+                    help="discovery settle time before dialing --peer")
+    pf.add_argument("--out", help="write output here instead of stdout")
+
     so = sub.add_parser(
         "slo",
         help="SLO burn-rate posture: per-objective status over the "
@@ -1003,6 +1101,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_trace_export(args)
     if args.cmd == "attrib":
         return cmd_attrib(args)
+    if args.cmd == "profile":
+        return cmd_profile(args)
     if args.cmd == "slo":
         return cmd_slo(args)
     if args.cmd == "debug-bundle":

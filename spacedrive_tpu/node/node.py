@@ -105,6 +105,13 @@ class Node:
         # so pipeline policies adapt while jobs run (SD_AUTOTUNE=0 keeps
         # every policy at the static defaults and starts nothing)
         self.autotuner = _autotune.CONTROLLER
+        # the process-wide continuous host profiler (telemetry/sampler):
+        # refcounted like the autotuner so two in-process nodes share
+        # one sampling thread; SD_PROFILE=0 starts nothing (true no-op)
+        from ..telemetry import sampler as _sampler
+
+        self.profiler = _sampler.SAMPLER
+        self._profiler_started = False
         self._started = False
 
     # --- identity ------------------------------------------------------
@@ -146,6 +153,11 @@ class Node:
         self.loop_monitor.start()
         self.history.start()
         self.autotuner.start()
+        # host profiling: tag THIS thread as the event-loop thread so
+        # samples classify as loop vs feeder vs worker, then take a
+        # refcounted hold on the process sampler
+        self.profiler.register_loop_thread()
+        self._profiler_started = self.profiler.start()
         # bind the thumbnailer to THIS loop up front: enqueues arrive
         # from worker threads (non-indexed walker) and can only wake the
         # actor thread-safely once it knows its owning loop
@@ -271,6 +283,9 @@ class Node:
         await self.loop_monitor.stop()
         await self.history.stop()
         await self.autotuner.stop()
+        if self._profiler_started:
+            self.profiler.stop()
+            self._profiler_started = False
         await self.thumbnailer.shutdown()
         if self.image_labeler is not None:
             await self.image_labeler.shutdown()

@@ -37,9 +37,11 @@ from .mdns import MdnsDiscovery
 from .operations import (
     SpacedropManager,
     _wireable_snapshot,
+    request_profile,
     request_telemetry,
     request_trace,
     respond_file,
+    respond_profile,
     respond_telemetry,
     respond_trace,
 )
@@ -436,6 +438,43 @@ class P2PManager:
                 spans.append(rec)
         return spans, failures
 
+    async def pull_remote_profiles(
+        self,
+    ) -> tuple[dict[str, dict], dict[str, str]]:
+        """Mesh profile view (``GET /profile?mesh=1`` / ``sdx
+        profile``): pull every discovered peer's host-profile document
+        concurrently under the sync-plane resilience policy — a
+        vanished peer costs one fast recorded failure and a *partial*
+        view, never a block (the trace_pull contract). Returns
+        ``(profiles-by-peer-label, failures-by-peer-label)``."""
+        from ..telemetry.peers import peer_label
+
+        async def pull(peer: Any) -> tuple[str, dict | None, str]:
+            label = peer_label(str(peer.identity))
+            try:
+                doc = await SYNC_POLICY.call(
+                    str(peer.identity),
+                    lambda peer=peer: request_profile(
+                        self.p2p, peer.identity
+                    ),
+                )
+                return label, doc, ""
+            except (BreakerOpen, ConnectionError, OSError, EOFError,
+                    asyncio.TimeoutError, PermissionError, ValueError) as e:
+                return label, None, f"{type(e).__name__}: {e}"
+
+        results = await asyncio.gather(
+            *(pull(p) for p in self.p2p.discovered_peers())
+        )
+        profiles: dict[str, dict] = {}
+        failures: dict[str, str] = {}
+        for label, doc, err in results:
+            if doc is None:
+                failures[label] = err[:200]
+            else:
+                profiles[label] = doc
+        return profiles, failures
+
     # --- inbound dispatch (ref:manager.rs stream handler) --------------
 
     def _serve_admit(self, key: str):
@@ -522,6 +561,13 @@ class P2PManager:
                                 stream,
                                 (header.telemetry_op or {}).get("trace_id"),
                             )
+                elif op == "profile_pull":
+                    if _faults.hit("p2p.profile_pull") is not None:
+                        await stream.close()  # peer vanishes mid-pull
+                        return
+                    async with self._serve_admit("p2p.profile_serve"):
+                        with _span("p2p.profile_serve"):
+                            await respond_profile(stream)
                 elif op not in (None, "snapshot"):
                     w = Writer(stream)
                     w.msgpack({"error": f"unknown TELEMETRY op {op!r}"})

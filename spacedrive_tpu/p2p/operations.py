@@ -316,6 +316,48 @@ async def respond_trace(stream: Any, trace_id: Any) -> None:
     await w.flush()
 
 
+async def request_profile(p2p: Any, identity: RemoteIdentity) -> dict:
+    """Pull a peer's host-profile document + folded collapsed-stack
+    text (the ``profile_pull`` TELEMETRY op — ``sdx profile --peer``
+    and the mesh-profile view). Raises ``PermissionError`` on a
+    membership refusal, ``ValueError`` on a malformed response — both
+    PASS through the caller's resilience policy without feeding the
+    breaker."""
+    from ..utils.compat import timeout
+
+    stream = await p2p.new_stream(identity)
+    try:
+        async with timeout(TELEMETRY_TIMEOUT):
+            await Header(
+                HeaderType.TELEMETRY, trace=_trace.wire_current(),
+                telemetry_op={"op": "profile_pull"},
+            ).write(stream)
+            resp = await Reader(stream).msgpack()
+    finally:
+        await stream.close()
+    if isinstance(resp, dict) and resp.get("error"):
+        raise PermissionError(str(resp["error"]))
+    if not isinstance(resp, dict) or not isinstance(resp.get("profile"),
+                                                    dict):
+        raise ValueError("peer served a malformed profile_pull response")
+    return resp
+
+
+async def respond_profile(stream: Any) -> None:
+    """Server half of ``profile_pull``: this node's profile document
+    and bounded folded text. Frame names are ``module:function`` only
+    (sampler.fold_stack strips paths), so nothing needing redaction
+    crosses here — the same contract trace_pull makes for spans."""
+    from ..telemetry import sampler as _sampler
+
+    w = Writer(stream)
+    w.msgpack(_wireable_snapshot({
+        "profile": _sampler.SAMPLER.profile(),
+        "folded": _sampler.SAMPLER.folded(max_bytes=128 * 1024),
+    }))
+    await w.flush()
+
+
 def _wireable_snapshot(obj: Any) -> Any:
     """msgpack-encodable projection (floats/str/ints pass, odd leaves
     stringify) — snapshots must never fail to serialize."""

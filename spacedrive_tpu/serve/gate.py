@@ -129,6 +129,14 @@ class AdmissionGate:
                 "mode", mode=mode, loop_lag_s=round(lag, 4),
                 saturated=saturated,
             )
+            if mode == BROWNOUT:
+                # brownout ENTRY opens a host-profiler deep capture:
+                # the overload incident's flight record gains the
+                # frames that were burning the loop (hysteresis in the
+                # sampler keeps a flapping gate to one window)
+                from ..telemetry import sampler as _sampler
+
+                _sampler.trigger("brownout")
         return mode
 
     def _note_shed(self) -> None:

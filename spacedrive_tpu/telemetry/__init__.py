@@ -22,7 +22,17 @@ Surface:
 - ``reset()`` — test isolation across metrics, spans, traces, rings.
 """
 
-from . import attrib, events, federation, health, history, metrics, slo, trace
+from . import (
+    attrib,
+    events,
+    federation,
+    health,
+    history,
+    metrics,
+    sampler,
+    slo,
+    trace,
+)
 from .registry import (
     BYTE_BUCKETS,
     MAX_SERIES_PER_FAMILY,
@@ -46,15 +56,17 @@ def render() -> str:
 def reset() -> None:
     """Test/bench isolation: zero every metric series AND clear the
     span ring, the trace ring, every flight-recorder ring, the
-    attribution report cache + pass markers, SLO evaluation state, and
-    every history writer's in-memory tail (durable history segments
-    are data-dir state and deliberately survive)."""
+    attribution report cache + pass markers, SLO evaluation state, the
+    host profiler's accumulators + capture-window ring + trigger
+    state, and every history writer's in-memory tail (durable history
+    segments are data-dir state and deliberately survive)."""
     REGISTRY.reset()
     clear_recent()
     trace.clear()
     events.clear_all()
     attrib.reset()
     slo.reset()
+    sampler.reset()
     history.reset_tails()
     # the index journal's per-location runtime counters + stats cache
     # live like registry series (lazy import: journal imports metrics)
@@ -64,9 +76,29 @@ def reset() -> None:
 
 
 def trace_export(trace_id=None):
-    """Chrome-trace-event JSON of the completed-span ring (the
-    ``GET /trace`` + ``telemetry.trace_export`` payload)."""
-    return trace.export(trace_id)
+    """Chrome-trace-event JSON of the completed-span ring, with the
+    host profiler's capture-window samples merged onto a dedicated
+    ``host-profile`` lane (the ``GET /trace`` + ``telemetry.trace_export``
+    payload — Perfetto shows what Python was doing beside the spans).
+    With a ``trace_id`` filter, profiler events are clipped to the
+    filtered spans' time range — captures from unrelated incidents
+    must not stretch one trace's timeline into a sliver."""
+    doc = trace.export(trace_id)
+    profile_events = sampler.SAMPLER.chrome_events()
+    if trace_id is not None and profile_events:
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        if not spans:
+            return doc
+        lo = min(e["ts"] for e in spans)
+        hi = max(e["ts"] + e.get("dur", 0) for e in spans)
+        profile_events = [
+            e for e in profile_events
+            if e.get("ph") == "M" or lo <= e.get("ts", 0) <= hi
+        ]
+        if all(e.get("ph") == "M" for e in profile_events):
+            profile_events = []  # nothing landed in-window: no lane
+    doc["traceEvents"].extend(profile_events)
+    return doc
 
 
 def debug_bundle(node=None, data_dir=None):
@@ -96,5 +128,5 @@ __all__ = [
     "clear_recent", "snapshot", "histogram_recent", "gauge_value",
     "counter_value", "render", "counter", "gauge", "histogram",
     "trace", "events", "reset", "trace_export", "debug_bundle",
-    "health", "federation", "attrib", "history", "slo",
+    "health", "federation", "attrib", "history", "slo", "sampler",
 ]

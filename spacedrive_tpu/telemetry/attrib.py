@@ -302,6 +302,27 @@ def report(trace_id: str, spans: list[dict[str, Any]] | None = None,
             ((k, round(v, 6)) for k, v in stages.items()),
             key=lambda kv: kv[1], reverse=True)[:16]),
     }
+    # the host profiler (telemetry/sampler.py) names the code inside
+    # the anonymous buckets: every timeline sample landing in a gap
+    # (or host_cpu) critical-path segment votes for its frame group,
+    # and the bucket's seconds split proportionally. The report keeps
+    # the span-derived buckets authoritative — the decomposition only
+    # explains them.
+    from . import sampler as _sampler
+
+    for bucket, key in ((GAP, "gap_decomposition"),
+                        (HOST_CPU, "host_cpu_decomposition")):
+        # LOCAL segments only: the timeline is this process's samples,
+        # and voting them into a wall window owned by a REMOTE
+        # executor's span would name local code for the peer's time
+        # (gap segments have no owner and are always local wall)
+        segs = [(s["t0"], s["t1"]) for s in segments
+                if s["bucket"] == bucket
+                and s["node"] in (None, "local")]
+        local_seconds = sum(t1 - t0 for t0, t1 in segs)
+        decomp = _sampler.decompose_segments(segs, local_seconds)
+        if decomp is not None:
+            doc[key] = decomp
     _tm.ATTRIB_REPORTS.inc()
     _tm.ATTRIB_BUCKET_SECONDS.set(buckets[DEVICE], bucket="device")
     _tm.ATTRIB_BUCKET_SECONDS.set(buckets[HOST_CPU], bucket="host_cpu")

@@ -165,6 +165,8 @@ def build_bundle(node: Any = None, data_dir: str | None = None) -> dict[str, Any
     from .events import drop_counts as _drop_counts
     from .snapshot import snapshot as _snapshot
 
+    from . import sampler as _sampler
+
     trace_events = _trace.recent()
     snap = _snapshot()
     raw_config = _raw_node_config(node, data_dir)
@@ -185,6 +187,14 @@ def build_bundle(node: Any = None, data_dir: str | None = None) -> dict[str, Any
         # per-ring overflow drops: a ring that displaced events is a
         # suffix of the story, and the bundle must say so
         "ring_drops": _drop_counts(),
+        # host-profiler evidence: the full profile document plus the
+        # bounded folded collapsed-stack text (frame names only —
+        # module:function, never filesystem paths or values), so a
+        # support bundle answers "what was Python doing" offline
+        "profile": {
+            "doc": _sampler.SAMPLER.profile(),
+            "folded": _sampler.SAMPLER.folded(max_bytes=64 * 1024),
+        },
     }
     if node is not None:
         bundle["libraries"] = _libraries(node)

@@ -201,6 +201,8 @@ class LoopLagMonitor:
 
         from . import metrics as _tm
 
+        from . import sampler as _sampler
+
         while not self._stopped:
             t0 = time.monotonic()
             await asyncio.sleep(self.interval)
@@ -208,3 +210,8 @@ class LoopLagMonitor:
             _tm.EVENT_LOOP_LAG.set(lag)
             if lag >= self.warn_s:
                 LOOP_EVENTS.emit("lag", seconds=round(lag, 4))
+                # loop-lag degradation opens a deep-capture window: the
+                # profiler names the frames that starved the loop. The
+                # sampler's cooldown absorbs a sustained-lag sample
+                # train into ONE window.
+                _sampler.trigger("loop_lag")

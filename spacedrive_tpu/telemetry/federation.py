@@ -117,6 +117,7 @@ def local_snapshot(node: Any = None) -> dict[str, Any]:
 
 def _local_snapshot(node: Any = None) -> dict[str, Any]:
     from . import health as _health
+    from . import sampler as _sampler
 
     snap: dict[str, Any] = {
         "v": SNAPSHOT_VERSION,
@@ -124,6 +125,10 @@ def _local_snapshot(node: Any = None) -> dict[str, Any]:
         "health": _health.evaluate(node),
         "metrics": _compact_metrics(),
         "rings": _ring_digests(),
+        # host-profiler digest (totals, state split, top frame groups,
+        # capture count) — like ring digests, never stacks or payloads:
+        # those stay on the owning node behind an explicit profile pull
+        "profile": _sampler.SAMPLER.summary(),
     }
     if node is not None:
         cfg = node.config.config

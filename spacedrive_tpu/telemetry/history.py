@@ -106,7 +106,23 @@ def default_samplers() -> dict[str, Callable[[], float]]:
                             klass="sync", outcome="shed")
         )
 
-    return {
+    from . import sampler as _sampler
+
+    def profile_share(group: str) -> Callable[[], float]:
+        def read() -> float:
+            return _sampler.SAMPLER.group_shares().get(group, 0.0)
+
+        return read
+
+    samplers: dict[str, Callable[[], float]] = {
+        # cumulative top-frame-group shares from the host profiler —
+        # the continuous record bench_compare gates attribution drift
+        # against (a pass whose sql share doubles week-over-week fails
+        # even if no bench round ran in between)
+        f"profile_share_{g}": profile_share(g)
+        for g in _sampler.HISTORY_GROUPS
+    }
+    samplers.update({
         "files_per_s": lambda: _autotune.observed_files_per_s("identify")
         or 0.0,
         "sync_lag_max_s": sync_lag_max,
@@ -125,7 +141,8 @@ def default_samplers() -> dict[str, Callable[[], float]]:
             "sd_autotune_window_scale", workload="identify"),
         "autotune_batch_rung": lambda: gauge_value(
             "sd_autotune_batch_rung", workload="identify"),
-    }
+    })
+    return samplers
 
 
 # --- the writer ----------------------------------------------------------

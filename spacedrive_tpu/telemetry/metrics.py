@@ -456,6 +456,36 @@ SERVE_REQUEST_SECONDS = REGISTRY.histogram(
     labels=("klass",),
 )
 
+# --- continuous host profiler (telemetry/sampler.py) ------------------------
+# Deliberately label-free: the per-kind / per-state / per-group splits
+# live in the profile document and federation summary, not the series
+# space — the sampler must stay O(1) registry cost at any stack shape.
+
+PROFILE_SAMPLES = REGISTRY.counter(
+    "sd_profile_samples_total",
+    "thread-stack samples folded into the continuous host profiler's "
+    "collapsed-stack accumulator (one per live thread per tick; the "
+    "sampler's own thread is exempt from its own accounting)",
+)
+PROFILE_CAPTURES = REGISTRY.counter(
+    "sd_profile_captures_total",
+    "triggered deep-capture windows opened (SLO warn/breach, loop-lag "
+    "degradation, brownout entry, manual) — hysteresis guarantees at "
+    "most one per cooldown, so a flapping signal cannot storm this",
+)
+PROFILE_STACKS = REGISTRY.gauge(
+    "sd_profile_stacks",
+    "distinct collapsed stacks currently tracked by the profiler's "
+    "bounded accumulator (cap: 4096; overflow folds into a drop count "
+    "reported by the profile document)",
+)
+PROFILE_OVERHEAD = REGISTRY.gauge(
+    "sd_profile_overhead_ratio",
+    "the profiler's self-measured duty cycle: cumulative sampling CPU "
+    "time over wall time since start — the ≤5% overhead contract's "
+    "always-on witness",
+)
+
 # --- event loop health (telemetry/events.py LoopLagMonitor) -----------------
 
 EVENT_LOOP_LAG = REGISTRY.gauge(

@@ -271,6 +271,17 @@ def evaluate(history: Any = None, *, directory: str | None = None,
     evaluation = {"ts": now, "status": worst, "slos": results}
     _tm.SLO_EVALUATIONS.inc()
     REGISTRY.last_evaluation = evaluation
+    # a warn/breach opens a host-profiler deep-capture window: the
+    # flight recorder gains "what was Python doing when the budget
+    # started burning". The sampler's own hysteresis absorbs repeats —
+    # health polls re-evaluating a burning SLO open ONE window per
+    # cooldown, never a storm.
+    from . import sampler as _sampler
+
+    if worst == BREACH:
+        _sampler.trigger("slo_breach")
+    elif worst == WARN:
+        _sampler.trigger("slo_warn")
     return evaluation
 
 

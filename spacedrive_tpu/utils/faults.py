@@ -80,6 +80,12 @@ FAULT_POINTS: dict[str, tuple[str, tuple[str, ...]]] = {
         "assembly must degrade to a partial report, never block",
         ("vanish",),
     ),
+    "p2p.profile_pull": (
+        "inbound TELEMETRY profile_pull responder (p2p/manager) — the "
+        "peer vanishes before serving its host profile; the mesh "
+        "profile view must degrade to a partial answer, never block",
+        ("vanish",),
+    ),
     "p2p.steal": (
         "work-stealing shard plane (p2p/work.py): `vanish` at arg "
         "'lease' kills the claiming worker after the lease is granted "

@@ -132,7 +132,8 @@ def test_offline_debug_bundle_cli_path(tmp_path):
 
 
 @pytest.mark.asyncio
-async def test_slo_smoke_attribution_and_slo_surfaces(tmp_path, corpus):
+async def test_slo_smoke_attribution_and_slo_surfaces(tmp_path, corpus,
+                                                      monkeypatch):
     """`make slo-smoke`: boot a node, run a small pass, and assert a
     well-formed attribution report (buckets sum to the window, the
     critical path is non-empty, the pass is findable as "the last
@@ -141,6 +142,17 @@ async def test_slo_smoke_attribution_and_slo_surfaces(tmp_path, corpus):
 
     from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
     from spacedrive_tpu.node import Node
+
+    # the objectives are env-tunable for rig variance — pin them so a
+    # 5-file smoke corpus on a loaded 2-core box can't trip the
+    # throughput/latency objectives (their burn semantics are separately
+    # unit-tested in tests/test_slo_history.py; this test proves the
+    # evaluation machinery end-to-end, not this box's speed)
+    monkeypatch.setenv("SD_SLO_FILES_PER_S", "0.001")
+    monkeypatch.setenv("SD_SLO_INTERACTIVE_P99_MS", "60000")
+    from spacedrive_tpu import telemetry as _telemetry
+
+    _telemetry.reset()  # earlier suites' series must not ride our history
 
     node = Node(os.path.join(tmp_path, "slo-node"), use_device=False,
                 with_labeler=False)
@@ -188,4 +200,4 @@ async def test_slo_smoke_attribution_and_slo_surfaces(tmp_path, corpus):
     names = {s["name"] for s in slo_doc["slos"]}
     assert names == {"interactive_p99", "sync_lag", "pass_throughput",
                      "protected_sheds"}
-    assert slo_doc["status"] in ("ok", "no_data")
+    assert slo_doc["status"] in ("ok", "no_data"), slo_doc

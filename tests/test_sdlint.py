@@ -2084,3 +2084,111 @@ def test_sd020_tree_without_metrics_needs_no_catalog(tmp_path, monkeypatch):
         ["SD020"],
     )
     assert findings == []
+
+# --- SD021 env-knob-catalog-drift -------------------------------------------
+
+
+def _knob_catalog(tmp_path, rows):
+    """rows: list of (knob, scope) tuples."""
+    doc = tmp_path / "knobs.md"
+    lines = ["# Knobs", "", "| knob | scope | default | effect |",
+             "|---|---|---|---|"]
+    lines += [f"| `{name}` | {scope} | `1` | fixture |"
+              for name, scope in rows]
+    doc.write_text("\n".join(lines) + "\n")
+    return doc
+
+
+def run_sd021(tmp_path, source, rows, monkeypatch):
+    doc = _knob_catalog(tmp_path, rows)
+    monkeypatch.setenv("SDLINT_KNOB_CATALOG", str(doc))
+    return run_on(tmp_path, source, ["SD021"])
+
+
+def test_sd021_read_knob_without_catalog_row(tmp_path, monkeypatch):
+    findings = run_sd021(
+        tmp_path,
+        """
+        import os
+
+        CATALOGED = os.environ.get("SD_CATALOGED", "1")
+        ORPHANED = os.environ.get("SD_ORPHANED")
+        """,
+        [("SD_CATALOGED", "core")],
+        monkeypatch,
+    )
+    assert rules_of(findings) == ["SD021"]
+    assert len(findings) == 1
+    assert "SD_ORPHANED" in findings[0].message
+    assert findings[0].path.endswith("fixture.py")
+
+
+def test_sd021_stale_row_flagged_script_row_exempt(tmp_path, monkeypatch):
+    findings = run_sd021(
+        tmp_path,
+        """
+        import os
+
+        LIVE = os.getenv("SD_LIVE")
+        """,
+        [("SD_LIVE", "core"), ("SD_GONE", "core"),
+         ("SD_BENCH_ONLY", "script")],
+        monkeypatch,
+    )
+    assert len(findings) == 1
+    assert "SD_GONE" in findings[0].message
+    assert findings[0].path.endswith("knobs.md")
+    assert findings[0].line > 0
+
+
+def test_sd021_all_read_idioms_and_const_indirection(tmp_path, monkeypatch):
+    findings = run_sd021(
+        tmp_path,
+        """
+        import os
+        from os import environ
+
+        ENV_VAR = "SD_CONSTANT"
+
+        A = os.environ["SD_SUBSCRIPT"]
+        B = "SD_MEMBERSHIP" in os.environ
+        C = environ.setdefault("SD_SETDEFAULT", "x")
+        D = os.environ.get(ENV_VAR)
+        """,
+        [("SD_SUBSCRIPT", "core"), ("SD_MEMBERSHIP", "core"),
+         ("SD_SETDEFAULT", "core"), ("SD_CONSTANT", "core")],
+        monkeypatch,
+    )
+    assert findings == []
+
+
+def test_sd021_missing_catalog_flags_once(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "SDLINT_KNOB_CATALOG", str(tmp_path / "nonexistent.md"))
+    findings = run_on(
+        tmp_path,
+        """
+        import os
+
+        A = os.environ.get("SD_A")
+        B = os.environ.get("SD_B")
+        """,
+        ["SD021"],
+    )
+    assert len(findings) == 1
+    assert "missing" in findings[0].message
+
+
+def test_sd021_tree_reading_no_knobs_needs_no_catalog(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "SDLINT_KNOB_CATALOG", str(tmp_path / "nonexistent.md"))
+    findings = run_on(
+        tmp_path,
+        """
+        import os
+
+        HOME = os.environ.get("HOME")  # not an SD_* knob
+        """,
+        ["SD021"],
+    )
+    assert findings == []

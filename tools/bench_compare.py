@@ -172,7 +172,21 @@ def _compare_attrib(cfg: str, old_cfg: dict[str, Any],
             or old_cfg.get("link_context") or new_cfg.get("link_context"):
         skipped.append(f"{cfg}.attrib: congested-link run on one side")
         return
-    for key in _ATTRIB_KEYS:
+    # fixed bucket keys plus whatever gap_<group>_s_per_kfile frame
+    # groups the host profiler decomposed. Dynamic keys gate only when
+    # BOTH runs recorded them: attrib_summary keeps a top-5, so a group
+    # hovering around rank 5 (or a run with profiling off) is absent on
+    # one side for reasons that are not perf — the total gap bucket
+    # still gates unconditionally, so a real regression cannot hide in
+    # a dropped group. `gap_other` is exempt entirely: growth there is
+    # a classifier-coverage problem the profile-smoke gate owns (the
+    # same policy as the history-share gate below).
+    gap_keys = {
+        k for k in old_a
+        if k in new_a and k.startswith("gap_")
+        and k.endswith("_s_per_kfile") and k != "gap_other_s_per_kfile"
+    }
+    for key in sorted(set(_ATTRIB_KEYS) | gap_keys):
         ov, nv = old_a.get(key), new_a.get(key)
         if not isinstance(ov, (int, float)) \
                 or not isinstance(nv, (int, float)):
@@ -392,8 +406,56 @@ def check_history(directory: str,
         checked.append(rec)
         if delta < -threshold:
             regressions.append(rec)
+    _check_history_profile_shares(_history, directory, checked,
+                                  regressions, skipped)
     return {"checked": checked, "regressions": regressions,
             "skipped": skipped}
+
+
+# host-profiler frame-group shares (history `profile_share_*` series,
+# 0..1): attribution drift against the CONTINUOUS record. Shares are
+# ratios, so the gate is an absolute delta — a group absorbing 15
+# percentage points more of all samples than its baseline is a code
+# path that got hot between bench rounds, restarts included.
+PROFILE_SHARE_MAX_DELTA = 0.15
+
+
+def _check_history_profile_shares(_history, directory: str,
+                                  checked: list, regressions: list,
+                                  skipped: list) -> None:
+    names = sorted({
+        n for rec in _history.read(directory)
+        for n in (rec.get("v") or {})
+        if n.startswith("profile_share_") and not n.endswith(
+            ("__min", "__max"))
+    })
+    for name in names:
+        if name.endswith("_other"):
+            # the honesty bucket: growth there is a classifier-coverage
+            # problem the profile-smoke gate owns, not a perf series
+            continue
+        # zero-valued samples are profiler-off (SD_PROFILE=0) or
+        # pre-first-tick periods, not "this group vanished" — the same
+        # idle-exclusion the throughput gate above applies
+        samples = [v for _, v in _history.series(directory, name) if v > 0]
+        full = f"history.{name}"
+        if len(samples) < HISTORY_MIN_SAMPLES:
+            skipped.append(
+                f"{full}: {len(samples)} samples "
+                f"(< {HISTORY_MIN_SAMPLES}) — nothing to gate"
+            )
+            continue
+        cut = max(1, int(len(samples) * (1 - HISTORY_RECENT_FRACTION)))
+        baseline, recent = samples[:cut], samples[cut:]
+        if not recent:
+            skipped.append(f"{full}: no recent window")
+            continue
+        ov, nv = median(baseline), median(recent)
+        rec = {"name": full, "old": round(ov, 4), "new": round(nv, 4),
+               "delta_pct": round((nv - ov) * 100, 2)}
+        checked.append(rec)
+        if nv - ov > PROFILE_SHARE_MAX_DELTA:
+            regressions.append(rec)
 
 
 def latest_pair(bench_dir: str) -> tuple[str, str] | None:

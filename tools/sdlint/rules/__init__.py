@@ -13,3 +13,4 @@ from . import flowrules  # noqa: F401  SD016
 from . import commitorder  # noqa: F401  SD017
 from . import frozenrules  # noqa: F401  SD018
 from . import breakerrules  # noqa: F401  SD019
+from . import envrules  # noqa: F401  SD021
