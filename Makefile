@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: lint test tier1 trace-smoke slo-smoke profile-smoke debug-bundle \
 	bench-devices bench-check bench-warm bench-autotune bench-mesh \
-	bench-serve chaos
+	bench-procs bench-serve chaos
 
 # set SDLINT_ANNOTATE=1 in CI for GitHub ::error annotations on the diff
 lint:
@@ -64,6 +64,17 @@ bench-autotune:
 bench-mesh:
 	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=mesh SD_E2E_FILES=800 \
 		SD_E2E_REPEATS=2 SD_BENCH_WAIT=0 $(PY) bench_e2e.py
+
+# multi-process execution plane A/B: the SAME shard-plane identify
+# window with SD_PROCS=0 (golden single-process path) vs a 2-worker
+# pool, interleaved arms, recording files/s ratio, per-worker scaling
+# efficiency, and the attrib unattributed-gap + profiler gil_wait
+# shares before/after into BENCH_PROCS.json; `make bench-check` gates
+# bit-identity everywhere and the scaling bars on ≥2-core rigs
+# (1-core rigs record the honest floor, like config_mesh).
+bench-procs:
+	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=procs SD_E2E_FILES=4000 \
+		SD_E2E_REPEATS=3 SD_BENCH_WAIT=0 $(PY) bench_e2e.py
 
 # serving-capacity bench: N simulated HTTP/rspc clients vs one node,
 # clean and with the DB throttled through the db.slow fault point,

@@ -48,6 +48,7 @@ SD_E2E_REPEATS=3 SD_E2E_CONFIGS=1,3,4,5,decode.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import os
 import random
@@ -215,10 +216,10 @@ def attrib_summary(raw: dict | None, items: int, wall_s: float) -> dict | None:
     tools/bench_compare.py fails a >15% bucket regression like any
     rate regression. When the host profiler decomposed the gap bucket
     (telemetry/sampler.py), the top-5 named frame groups ride along as
-    ``gap_<group>_s_per_kfile`` — the baseline artifact the multi-
-    process execution-plane PR (ROADMAP item 2) will be judged
-    against: its win must show up as these groups shrinking, not just
-    the anonymous gap."""
+    ``gap_<group>_s_per_kfile`` — the before/after evidence the multi-
+    process execution plane (config_procs → BENCH_PROCS.json) is
+    judged by: its win must show up as these groups shrinking, not
+    just the anonymous gap."""
     if not raw or not items:
         return None
     buckets = raw.get("buckets") or {}
@@ -799,6 +800,56 @@ def config_mesh(tmp: str, n_files: int, repeats: int, probes: dict) -> dict:
     return result
 
 
+def config_mesh_procs(tmp: str, n_files: int, repeats: int,
+                      probes: dict) -> dict:
+    """config_mesh re-run WITH the multi-process execution plane live
+    (ROADMAP item 2's before/after): the same 1-node vs 2-node A/B,
+    every node holding the shared SD_PROCS pool, recorded BESIDE the
+    single-process floor — it deliberately does not replace the gated
+    ``config_mesh`` series, so the canonical floor recording survives
+    for comparison."""
+    workers = int(os.environ.get("SD_PROCS_BENCH_WORKERS", "2"))
+    log(f"config mesh_procs: config_mesh with SD_PROCS={workers}…")
+    floor = None
+    try:
+        with open("BENCH_E2E.json") as f:
+            prev_cfg = json.load(f).get("config_mesh") or {}
+        if not prev_cfg.get("sd_procs"):
+            floor = prev_cfg.get("scaling_efficiency")
+    except (OSError, ValueError):
+        pass
+    prev_procs = os.environ.get("SD_PROCS")
+    os.environ["SD_PROCS"] = str(workers)
+    try:
+        result = config_mesh(tmp, n_files, repeats, probes)
+    finally:
+        if prev_procs is None:
+            os.environ.pop("SD_PROCS", None)
+        else:
+            os.environ["SD_PROCS"] = prev_procs
+    result["name"] = (
+        "mesh-parallel index with the multi-process execution plane "
+        f"({workers} pool workers shared by the in-process nodes)"
+    )
+    result["sd_procs"] = workers
+    if floor is not None:
+        result["floor_without_pool_efficiency"] = floor
+    result["note"] = (
+        "recorded beside config_mesh's single-process floor "
+        f"(scaling_efficiency {floor if floor is not None else '—'}): "
+        "with the pool live, each in-process node ships its per-entry "
+        "orchestration (journal match, chunk digests, host hashing, "
+        "link prep) onto shared worker processes, so on a multi-core "
+        "rig the two 'nodes' stop serializing on one GIL and this "
+        "efficiency rises toward the cross-host figure. On a rig with "
+        "fewer cores than workers+nodes the pool only adds IPC and "
+        "scheduling overhead — the delta between this figure and the "
+        "floor then MEASURES that overhead, it does not refute the "
+        "design (same honest-floor caveat as config_mesh itself)"
+    )
+    return result
+
+
 # --- config_autotune: static vs adaptive A/B (ISSUE 8) ---------------------
 #
 # Proves the closed-loop autotuner: the SAME identifier pass runs with
@@ -1020,6 +1071,196 @@ def config_autotune(tmp: str, n_files: int, repeats: int) -> dict:
         f"(≥{AUTOTUNE_CLEAN_MIN} {'OK' if out['gate']['clean_ok'] else 'FAIL'})"
         f"  decisions={out['decisions']}")
     with open(AUTOTUNE_PATH, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    return out
+
+
+# --- config_procs: single-process vs multi-process execution plane (ISSUE 15)
+#
+# The A/B the procpool is judged by: the SAME corpus identified through
+# the SAME shard-plane engine (location/indexer/mesh.py — the execute
+# leg that dispatches CPU-bound stages onto the pool) once with
+# SD_PROCS=0 (golden single-process path) and once with the pool live.
+# Arms are interleaved per repeat (autotune discipline: box-load drift
+# lands on both sides) and the gated figure is the median per-pair
+# ratio. Alongside files/s, each arm records the PR 12/13 evidence this
+# plane exists to move: the attribution report's unattributed-gap share
+# and the host profiler's gil_wait share over the timed window — the
+# pool's win must show as those shrinking, not just a faster wall
+# clock. Workers also hash on host CPU, so the whole config is
+# host-bound: probes are context only (link_bound=False treatment via
+# its own artifact). On a <2-core rig the pool cannot show multi-core
+# scaling — the artifact records the honest floor with a note and
+# tools/bench_compare.py gates the ratio only on ≥2-core recordings
+# (the config_mesh precedent).
+
+PROCS_PATH = "BENCH_PROCS.json"
+PROCS_RATIO_MIN = 1.3
+
+
+async def _procs_arm(data_dir: str, corpus: str, procs: int) -> dict:
+    """Walk+save (untimed), then the timed shard-plane identify window
+    under ``SD_PROCS=procs``, with attribution + profiler evidence."""
+    import spacedrive_tpu.telemetry as telemetry
+    from spacedrive_tpu.jobs.manager import JobBuilder
+    from spacedrive_tpu.location.indexer.job import IndexerJob
+    from spacedrive_tpu.location.indexer.mesh import (
+        distribute_location_index,
+    )
+    from spacedrive_tpu.location.locations import LocationCreateArgs
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.telemetry import attrib as _attrib
+    from spacedrive_tpu.telemetry import counter_value
+    from spacedrive_tpu.telemetry import trace as _trace
+    from spacedrive_tpu.telemetry.sampler import SAMPLER
+
+    os.environ["SD_PROCS"] = str(procs)
+    node = Node(data_dir, use_device=False, with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        lib = await node.create_library("procs-bench")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+        await JobBuilder(IndexerJob({"location_id": loc["id"]})).spawn(
+            node.jobs, lib)
+        await node.jobs.wait_idle()
+        if procs:
+            node.procpool.warm()  # spawn cost never lands in the window
+        # fresh telemetry + profiler window so gap/gil shares cover
+        # exactly the timed identify pass
+        telemetry.reset()
+        ctx = _trace.new_context()
+        t0 = time.perf_counter()
+        with _trace.use(ctx):
+            await distribute_location_index(
+                node, lib, loc["id"], run_indexer=False)
+        dt = time.perf_counter() - t0
+        raw = _attrib.report(ctx.trace_id)
+        buckets = (raw or {}).get("buckets") or {}
+        wall = (raw or {}).get("wall_seconds") or dt
+        prof = SAMPLER.profile()
+        states = prof.get("states") or {}
+        samples = prof.get("samples") or 0
+        files = lib.db.count("file_path", "is_dir = 0", ())
+        cas_fp = sorted(
+            (r["cas_id"] or "") for r in lib.db.query(
+                "SELECT cas_id FROM file_path WHERE is_dir = 0")
+        )
+        return {
+            "seconds": dt,
+            "files": files,
+            "gap_share": round(buckets.get("gap", 0.0) / wall, 4)
+            if wall else None,
+            "gil_share": round(states.get("gil_wait", 0) / samples, 4)
+            if samples else None,
+            "pool_jobs": counter_value("sd_procpool_jobs_total",
+                                       result="ok"),
+            "pool_restarts": counter_value("sd_procpool_restarts_total"),
+            # stable across interpreter runs (hash() is salted): two
+            # artifacts with identical output carry identical prints
+            "cas_fingerprint": hashlib.sha256(
+                "\n".join(cas_fp).encode()).hexdigest()[:16],
+            "cas_set": cas_fp,
+        }
+    finally:
+        await node.shutdown()
+
+
+def config_procs(tmp: str, n_files: int, repeats: int) -> dict:
+    """SD_PROCS=0 vs pool A/B over the shard-plane identify window.
+    Writes BENCH_PROCS.json (gated absolutely by tools/bench_compare.py
+    on ≥2-core recordings)."""
+    workers = int(os.environ.get("SD_PROCS_BENCH_WORKERS", "2"))
+    n_files = int(os.environ.get("SD_PROCS_FILES", str(min(n_files, 4000))))
+    repeats = max(1, repeats)
+    log(f"config procs: {n_files} tiny files, SD_PROCS=0 vs "
+        f"{workers} workers, {repeats} pairs…")
+    corpus = os.path.join(tmp, "corpusP")
+    build_tiny_corpus(corpus, n_files)
+    prev_procs = os.environ.get("SD_PROCS")
+    arms: dict[int, list[dict]] = {0: [], workers: []}
+    ratios: list[float] = []
+    try:
+        for r in range(repeats):
+            order = (0, workers) if r % 2 == 0 else (workers, 0)
+            pair: dict[int, dict] = {}
+            for procs in order:
+                data_dir = os.path.join(tmp, f"node-procs-{procs}-{r}")
+                res = asyncio.run(_procs_arm(data_dir, corpus, procs))
+                pair[procs] = res
+                arms[procs].append(res)
+                log(f"  [procs={procs} #{r}] identify "
+                    f"{res['seconds']:.2f}s "
+                    f"({res['files'] / res['seconds']:,.0f} files/s)  "
+                    f"gap={res['gap_share']}  gil={res['gil_share']}")
+                shutil.rmtree(data_dir, ignore_errors=True)
+            ratios.append(pair[0]["seconds"] / pair[workers]["seconds"])
+            log(f"  [pair #{r}] pool/single = {ratios[-1]:.3f}x")
+    finally:
+        if prev_procs is None:
+            os.environ.pop("SD_PROCS", None)
+        else:
+            os.environ["SD_PROCS"] = prev_procs
+    med0, lo0, hi0 = median_spread([a["seconds"] for a in arms[0]])
+    medp, lop, hip = median_spread([a["seconds"] for a in arms[workers]])
+    files = arms[0][0]["files"]
+    ratio = round(median_spread(ratios)[0], 3)
+    cores = os.cpu_count() or 1
+
+    def _share(key: str, runs: list[dict]) -> float | None:
+        vals = [a[key] for a in runs if a.get(key) is not None]
+        return round(median_spread(vals)[0], 4) if vals else None
+
+    identical = all(
+        a["cas_set"] == arms[0][0]["cas_set"]
+        for runs in arms.values() for a in runs
+    )
+    for runs in arms.values():  # the sets were only for the check
+        for a in runs:
+            a.pop("cas_set", None)
+    out = {
+        "name": "multi-process execution plane A/B: SD_PROCS=0 vs "
+                f"{workers}-worker pool, shard-plane identify",
+        "files": files,
+        "workers": workers,
+        "repeats": repeats,
+        "host_cores": cores,
+        "procs0_files_per_s": round(files / med0, 1),
+        "procs0_seconds_spread": [round(lo0, 2), round(med0, 2),
+                                  round(hi0, 2)],
+        "pool_files_per_s": round(files / medp, 1),
+        "pool_seconds_spread": [round(lop, 2), round(medp, 2),
+                                round(hip, 2)],
+        "pair_ratios": [round(x, 3) for x in ratios],
+        "pool_vs_single": ratio,
+        "per_worker_efficiency": round(ratio / workers, 3),
+        "gap_share_single": _share("gap_share", arms[0]),
+        "gap_share_pool": _share("gap_share", arms[workers]),
+        "gil_share_single": _share("gil_share", arms[0]),
+        "gil_share_pool": _share("gil_share", arms[workers]),
+        "pool_jobs_per_pass": arms[workers][-1]["pool_jobs"],
+        "identical": identical,
+        "gate": {
+            "ratio_min": PROCS_RATIO_MIN,
+            "gated": cores >= 2 and workers >= 2,
+            "ratio_ok": ratio >= PROCS_RATIO_MIN,
+        },
+    }
+    if cores < 2:
+        out["note"] = (
+            f"honest floor: this rig has {cores} core(s), so {workers} "
+            "workers + the owner time-slice ONE core and the recorded "
+            "ratio measures pure plane overhead, not the design's "
+            "scaling (the config_mesh precedent). bench_compare gates "
+            "the ratio only on >=2-core recordings; the bit-identity "
+            "check gates everywhere"
+        )
+    log(f"  procs: {out['procs0_files_per_s']:,.0f} -> "
+        f"{out['pool_files_per_s']:,.0f} files/s "
+        f"(pool/single {ratio}x, per-worker eff "
+        f"{out['per_worker_efficiency']})  identical={identical}")
+    with open(PROCS_PATH, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
     return out
@@ -1651,7 +1892,8 @@ def main() -> None:
     configure_compilation_cache()
     which = os.environ.get(
         "SD_E2E_CONFIGS",
-        "compose,1,3,4,5,warm,mesh,decode,autotune").split(",")
+        "compose,1,3,4,5,warm,mesh,decode,autotune,procs,mesh_procs"
+    ).split(",")
     n_files = int(os.environ.get("SD_E2E_FILES", "10000"))
     n_images = int(os.environ.get("SD_E2E_IMAGES", "256"))
     n_clips = int(os.environ.get("SD_E2E_CLIPS", "8"))
@@ -1663,6 +1905,17 @@ def main() -> None:
         tmp = tempfile.mkdtemp(prefix="sd-bench-autotune-")
         try:
             doc = config_autotune(tmp, n_files, repeats)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        print(json.dumps(doc, indent=2), flush=True)
+        return
+
+    if which == ["procs"]:
+        # host-bound by construction (owner + workers all hash on CPU):
+        # owns its artifact (BENCH_PROCS.json), no link probes needed
+        tmp = tempfile.mkdtemp(prefix="sd-bench-procs-")
+        try:
+            doc = config_procs(tmp, n_files, repeats)
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
         print(json.dumps(doc, indent=2), flush=True)
@@ -1712,8 +1965,19 @@ def main() -> None:
             results["config_mesh"] = probed(
                 config_mesh, tmp, n_files, max(1, repeats - 1),
                 link_bound=False)
+        if "mesh_procs" in which:
+            # the ROADMAP-item-2 before/after: config_mesh with the
+            # process pool live, recorded beside (not replacing) the
+            # gated single-process floor series
+            results["config_mesh_procs"] = probed(
+                config_mesh_procs, tmp, n_files, max(1, repeats - 1),
+                link_bound=False)
         if "decode" in which:
             results["decode_scaling"] = decode_scaling(tmp, n_images)
+        if "procs" in which:
+            # writes its own BENCH_PROCS.json; the summary rides along
+            results["config_procs"] = config_procs(
+                tmp, n_files, max(1, repeats - 1))
         if "autotune" in which:
             # writes its own BENCH_AUTOTUNE.json; the summary rides
             # along in this doc for the human log only
@@ -1735,7 +1999,8 @@ def main() -> None:
     carried = []
     if prev:
         for key in (*CONFIG_METRICS, "decode_scaling",
-                    "device_clock_composition"):
+                    "device_clock_composition", "config_procs",
+                    "config_mesh_procs"):
             if key not in results and key in prev:
                 results[key] = prev[key]
                 carried.append(key)

@@ -112,6 +112,14 @@ class Node:
 
         self.profiler = _sampler.SAMPLER
         self._profiler_started = False
+        # the multi-process execution plane (parallel/procpool.py):
+        # spawn-started with the node, refcounted like the sampler so
+        # two in-process nodes share one worker set. SD_PROCS=0 (the
+        # default) starts nothing — the golden single-process path.
+        from ..parallel import procpool as _procpool
+
+        self.procpool = _procpool.POOL
+        self._procpool_started = False
         self._started = False
 
     # --- identity ------------------------------------------------------
@@ -158,6 +166,9 @@ class Node:
         # refcounted hold on the process sampler
         self.profiler.register_loop_thread()
         self._profiler_started = self.profiler.start()
+        # worker processes up before any job runs, so the first shard's
+        # pool batches never pay spawn latency inside a measured pass
+        self._procpool_started = self.procpool.start()
         # bind the thumbnailer to THIS loop up front: enqueues arrive
         # from worker threads (non-indexed walker) and can only wake the
         # actor thread-safely once it knows its owning loop
@@ -286,6 +297,9 @@ class Node:
         if self._profiler_started:
             self.profiler.stop()
             self._profiler_started = False
+        if self._procpool_started:
+            self.procpool.stop()
+            self._procpool_started = False
         await self.thumbnailer.shutdown()
         if self.image_labeler is not None:
             await self.image_labeler.shutdown()

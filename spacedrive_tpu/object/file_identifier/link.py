@@ -58,6 +58,55 @@ def object_pub_for(library_id: Any, cas_id: str) -> bytes:
 #: SQLite's default 999-variable limit
 _LINK_CHUNK = 400
 
+#: smallest result batch worth a pool round-trip for the prep leg
+_PREP_POOL_MIN = 32
+
+
+def _prep_results(lib_id: Any, results: list[dict]) \
+        -> list[tuple[dict, bytes, str, bytes]]:
+    """``(result, fp_pub, cas, deterministic obj_pub)`` per linkable
+    result — apply_cas_results' pure prep. Ships to the process pool
+    (stage ``link.prep``) when it is live and the batch is big enough;
+    the inline loop is both the small-batch path and the fallback, so
+    pooled and single-process prep are identical by construction."""
+    if len(results) >= _PREP_POOL_MIN:
+        from ...parallel import procpool as _procpool
+
+        pool = _procpool.get()
+        if pool is not None:
+            # None pub_id stays None: the worker's fromhex(str(None))
+            # rejects it exactly like the inline KeyError skip
+            wire = [
+                {"pub_id": res.get("pub_id"),
+                 "cas_id": res.get("cas_id"),
+                 "ext": res.get("ext")}
+                for res in results
+            ]
+            try:
+                reply = pool.request(
+                    "link.prep",
+                    {"library_id": str(lib_id), "results": wire},
+                    rows=len(results),
+                )
+                return [
+                    (results[i], bytes(fp_pub), cas, bytes(obj_pub))
+                    for i, fp_pub, cas, obj_pub in reply["usable"]
+                ]
+            except (_procpool.ProcPoolError, KeyError, TypeError,
+                    ValueError, IndexError):
+                pass  # fall through to the inline prep
+    usable: list[tuple[dict, bytes, str, bytes]] = []
+    for res in results:
+        cas = res.get("cas_id")
+        if not cas or not isinstance(cas, str):
+            continue  # empty/unreadable files carry no cas to link
+        try:
+            fp_pub = bytes.fromhex(str(res["pub_id"]))
+        except (KeyError, ValueError):
+            continue
+        usable.append((res, fp_pub, cas, object_pub_for(lib_id, cas)))
+    return usable
+
 
 def _rows_by_pub(
     db: Any, table: str, columns: str, pubs: list[bytes], batched: bool,
@@ -119,17 +168,11 @@ def apply_cas_results(
     created = linked = 0
     # normalize first, then ONE batched fetch per table (a 128-file
     # shard used to cost 256 point SELECTs here — the other half of the
-    # per-entry-SQL floor batched alongside journal.consult_many)
-    usable: list[tuple[dict, bytes, str, bytes]] = []
-    for res in results:
-        cas = res.get("cas_id")
-        if not cas or not isinstance(cas, str):
-            continue  # empty/unreadable files carry no cas to link
-        try:
-            fp_pub = bytes.fromhex(str(res["pub_id"]))
-        except (KeyError, ValueError):
-            continue
-        usable.append((res, fp_pub, cas, object_pub_for(lib_id, cas)))
+    # per-entry-SQL floor batched alongside journal.consult_many).
+    # With the process pool live the normalize/uuid5 prep ships out
+    # (shared-nothing: result subsets in, plain tuples back); the row
+    # fetches and the sync-write commit below stay on this process.
+    usable = _prep_results(lib_id, results)
     fp_rows = _rows_by_pub(
         library.db, "file_path", "pub_id, cas_id, object_id",
         [fp for _res, fp, _cas, _obj in usable], batched,

@@ -31,7 +31,11 @@ from .blake3_ref import (
     parent_chaining_value,
     root_digest_from_pair,
 )
-from . import blake3_jax
+# blake3_jax (and with it jax) loads lazily inside the device dispatch
+# paths: the procpool worker runtime imports this module for its CPU
+# halves (read_message / chunk caches / cas_ids "cpu") and must stay
+# jax-free — a spawned worker paying a jax import to hash on host would
+# defeat the slim-runtime contract (parallel/procworker.py).
 
 SAMPLE_COUNT = 4
 SAMPLE_SIZE = 10 * 1024
@@ -375,6 +379,7 @@ def cas_ids_begin(
     at the demoted rung inside the same `finish()` call instead of
     failing the window (the host path is bit-identical, golden-tested).
     Explicit `devices` stay strict and re-raise."""
+    from . import blake3_jax
     from ..parallel import mesh as _mesh
 
     if devices is not None:

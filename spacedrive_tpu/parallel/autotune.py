@@ -93,6 +93,12 @@ THUMB_DEVICE_BATCH = 32
 FEEDER_BASE_DEPTH = 3
 FEEDER_DEPTH_CAP = 8
 
+#: rows per multi-process-pool batch (parallel/procpool.py): one
+#: round-trip's serialize+frame tax amortized over this many entries.
+#: Small enough that a 128-entry shard still fans out across workers,
+#: large enough that msgpack+pipe overhead stays a rounding error.
+PROCPOOL_BATCH_ROWS = 32
+
 #: window-scale bounds: the static base is the floor (shrinking the
 #: host window below it just multiplies per-window overhead — the
 #: congestion response lives in the dispatch RUNG, which controls how
@@ -184,6 +190,23 @@ class PipelinePolicy:
         if not enabled():
             return base
         return max(1, int(base * self.window_scale))
+
+    def procpool_batch_rows(self) -> int:
+        """Entries per multi-process-pool round-trip (the execute leg's
+        per-stage shipping quantum — parallel/procpool.py). An explicit
+        ``SD_PROCS_BATCH`` pins it; otherwise the window scale the
+        controller already maintains for this workload widens pool
+        batches exactly when it widens host windows (both amortize a
+        per-batch tax against observed starvation)."""
+        explicit = os.environ.get("SD_PROCS_BATCH")
+        if explicit:
+            try:
+                return max(1, int(explicit))
+            except ValueError:
+                pass
+        if not enabled():
+            return PROCPOOL_BATCH_ROWS
+        return max(8, int(PROCPOOL_BATCH_ROWS * self.window_scale))
 
     def feeder_depth(self, n_devices: int = 1) -> int:
         """In-flight feeder windows (read live by WindowPipeline, so a

@@ -323,6 +323,44 @@ FEEDER_RESTARTS = REGISTRY.counter(
     "window-pipeline producer threads restarted after a crash",
 )
 
+# --- multi-process execution plane (parallel/procpool.py) -------------------
+# Counters/histograms here are OWNER-side series. Workers accumulate
+# into their own per-process registry and ship additive deltas back
+# with each batch result (registry.merge_delta) — gauges never merge.
+
+PROCPOOL_WORKERS = REGISTRY.gauge(
+    "sd_procpool_workers",
+    "worker processes currently alive in the multi-process execution "
+    "plane (0 = SD_PROCS disabled or pool stopped)",
+)
+PROCPOOL_JOBS = REGISTRY.counter(
+    "sd_procpool_jobs_total",
+    "pool batches by outcome: ok (result + telemetry delta merged), "
+    "error (worker raised — the call site falls back to its inline "
+    "path), retried (re-dispatched after a worker died mid-batch)",
+    labels=("result",),  # ok | error | retried
+)
+PROCPOOL_DISPATCH_SECONDS = REGISTRY.histogram(
+    "sd_procpool_dispatch_seconds",
+    "owner-side submit cost per batch (msgpack serialization + queue "
+    "put — the IPC tax the PipelinePolicy batch quantum amortizes)",
+)
+PROCPOOL_ROUNDTRIP_SECONDS = REGISTRY.histogram(
+    "sd_procpool_roundtrip_seconds",
+    "submit-to-result wall time per pool batch",
+)
+PROCPOOL_BATCH_ROWS = REGISTRY.histogram(
+    "sd_procpool_batch_rows",
+    "rows per shipped pool batch (sized by the per-workload "
+    "PipelinePolicy procpool quantum)",
+    buckets=ROW_BUCKETS,
+)
+PROCPOOL_RESTARTS = REGISTRY.counter(
+    "sd_procpool_restarts_total",
+    "worker processes restarted after dying mid-batch (each dead "
+    "worker's in-flight batches are re-dispatched exactly once)",
+)
+
 # --- closed-loop autotuner (parallel/autotune.py) ---------------------------
 
 AUTOTUNE_DECISIONS = REGISTRY.counter(

@@ -1,0 +1,401 @@
+"""Multi-process execution plane (parallel/procpool.py + procworker.py).
+
+The plane's three contracts, each proven here:
+
+- **golden**: ``SD_PROCS=0`` starts nothing and every call site runs
+  its inline path; with the pool live, a full walk → identify (shard
+  plane) → thumbnail pass produces bit-identical cas_ids, thumbnail
+  webp bytes, journal vouches, and object grouping — including with a
+  worker killed mid-batch (the PR 6 convergence contract, now for
+  process death);
+- **single-writer telemetry**: worker-side counter/histogram deltas
+  merged into the owner registry equal the in-process accounting of
+  the same work, and a crash-retried batch counts exactly once;
+- **recovery**: a dead worker is restarted once, its in-flight batches
+  re-dispatch, and a twice-fatal batch fails its future (call sites
+  fall back inline — the pool can slow a pass, never wrong it).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.parallel import procpool, procworker
+from spacedrive_tpu.telemetry import counter_value, gauge_value
+from spacedrive_tpu.telemetry.registry import MetricsRegistry
+from spacedrive_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.clear()
+    yield
+    faults.clear()
+    # a test that forgot to balance its holds must not leak workers
+    # into the rest of the tier
+    while procpool.POOL.running():
+        procpool.POOL.stop()
+
+
+@pytest.fixture
+def pool(monkeypatch):
+    monkeypatch.setenv("SD_PROCS", "2")
+    assert procpool.POOL.start()
+    procpool.POOL.warm()
+    yield procpool.POOL
+    procpool.POOL.stop()
+
+
+# --- lifecycle -------------------------------------------------------------
+
+
+def test_sd_procs_zero_is_a_true_noop(monkeypatch):
+    monkeypatch.setenv("SD_PROCS", "0")
+    assert not procpool.enabled()
+    assert procpool.POOL.start() is False
+    assert procpool.get() is None
+    assert gauge_value("sd_procpool_workers") == 0.0
+
+
+def test_refcounted_start_stop(monkeypatch):
+    monkeypatch.setenv("SD_PROCS", "1")
+    assert procpool.POOL.start()
+    assert procpool.POOL.start()  # second hold (a second node)
+    procpool.POOL.stop()
+    assert procpool.POOL.running(), "first stop must not kill the survivor"
+    assert procpool.get() is procpool.POOL
+    procpool.POOL.stop()
+    assert not procpool.POOL.running()
+    assert procpool.get() is None
+
+
+def test_echo_roundtrip_and_worker_gauge(pool):
+    assert gauge_value("sd_procpool_workers") == 2.0
+    out = pool.request("echo", {"x": [1, 2, 3], "b": b"\x00\xff"})
+    assert out == {"x": [1, 2, 3], "b": b"\x00\xff"}
+    assert counter_value("sd_procpool_jobs_total", result="ok") >= 1
+
+
+def test_payload_purity_enforced_at_submit(pool):
+    with pytest.raises(procpool.ProcPoolError):
+        pool.submit("echo", {"db": object()})
+
+
+def test_worker_error_fails_future_pool_survives(pool):
+    with pytest.raises(procpool.ProcPoolError):
+        pool.request("no-such-stage", {})
+    assert pool.request("echo", {"ok": 1}) == {"ok": 1}
+
+
+# --- crash/stall recovery --------------------------------------------------
+
+
+def test_crash_fault_restarts_once_and_redispatches(pool):
+    before = counter_value("sd_procpool_restarts_total")
+    plan = faults.FaultPlan.parse("procpool.worker:crash:times=1", seed=3)
+    with faults.active(plan):
+        out = pool.request("echo", {"v": 42})
+    assert out == {"v": 42}
+    assert plan.activations().get("procpool.worker") == 1
+    assert counter_value("sd_procpool_restarts_total") == before + 1
+    assert counter_value("sd_procpool_jobs_total", result="retried") >= 1
+    deadline = time.monotonic() + 10
+    while pool.worker_count() < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pool.worker_count() == 2
+
+
+def test_stall_fault_delays_inside_worker(pool):
+    plan = faults.FaultPlan.parse(
+        "procpool.worker:stall:times=1,delay_s=0.4", seed=3)
+    t0 = time.monotonic()
+    with faults.active(plan):
+        assert pool.request("echo", {"s": 1}) == {"s": 1}
+    assert time.monotonic() - t0 >= 0.4
+
+
+# --- telemetry delta merge -------------------------------------------------
+
+
+def test_delta_capture_diff_merge_roundtrip():
+    """Pure registry unit: what a worker accumulates equals what the
+    owner ends up with after the merge — counters, histogram sums,
+    bucket counts, and the recent ring."""
+    worker = MetricsRegistry()
+    owner = MetricsRegistry()
+    for reg in (worker, owner):
+        reg.counter("sd_t_total", "t", labels=("result",))
+        reg.histogram("sd_t_seconds", "t")
+    base = worker.delta_capture()
+    worker.get("sd_t_total").inc(3, result="ok")
+    worker.get("sd_t_total").inc(1, result="err")
+    worker.get("sd_t_seconds").observe(0.5)
+    worker.get("sd_t_seconds").observe(2.0)
+    delta = worker.delta_diff(base, worker.delta_capture())
+    owner.merge_delta(delta)
+    assert owner.get("sd_t_total").value(result="ok") == 3
+    assert owner.get("sd_t_total").value(result="err") == 1
+    stats = owner.get("sd_t_seconds").stats()
+    assert stats["count"] == 2 and stats["sum"] == pytest.approx(2.5)
+    assert owner.get("sd_t_seconds").recent() == [0.5, 2.0]
+    # second increment ships only its own delta
+    base2 = worker.delta_capture()
+    worker.get("sd_t_total").inc(2, result="ok")
+    owner.merge_delta(worker.delta_diff(base2, worker.delta_capture()))
+    assert owner.get("sd_t_total").value(result="ok") == 5
+
+
+def _hash_corpus(tmp_path, n=6):
+    root = tmp_path / "hashme"
+    root.mkdir()
+    rng = np.random.default_rng(5)
+    entries = []
+    for i in range(n):
+        (root / f"f{i}.bin").write_bytes(
+            rng.integers(0, 256, 3000 + i * 500, dtype=np.uint8).tobytes()
+        )
+        entries.append({"pub_id": f"{i:02x}" * 16, "mat": "/",
+                        "name": f"f{i}", "ext": "bin"})
+    return str(root), entries
+
+
+def test_pooled_accounting_equals_inline(tmp_path, pool):
+    """The satellite contract: the merged worker delta for a hash batch
+    equals the inline accounting of the identical batch."""
+    import spacedrive_tpu.telemetry as telemetry
+
+    loc_path, entries = _hash_corpus(tmp_path)
+    payload = {"loc_path": loc_path, "entries": entries}
+
+    telemetry.reset()
+    inline = procworker._stage_hash_entries(payload)
+    inline_bytes = counter_value("sd_index_bytes_hashed_total")
+    assert inline_bytes > 0
+
+    telemetry.reset()
+    pooled = pool.request("identify.hash_entries", payload,
+                          rows=len(entries))
+    assert pooled == inline  # cas ids, identities, chunk payloads
+    assert counter_value("sd_index_bytes_hashed_total") == inline_bytes
+
+
+def test_no_double_count_on_crash_retry(tmp_path, pool):
+    """A batch whose worker died before replying never shipped a delta;
+    the re-dispatched run ships exactly one."""
+    import spacedrive_tpu.telemetry as telemetry
+
+    loc_path, entries = _hash_corpus(tmp_path)
+    payload = {"loc_path": loc_path, "entries": entries}
+    telemetry.reset()
+    inline = procworker._stage_hash_entries(payload)
+    inline_bytes = counter_value("sd_index_bytes_hashed_total")
+
+    telemetry.reset()
+    plan = faults.FaultPlan.parse("procpool.worker:crash:times=1", seed=7)
+    with faults.active(plan):
+        pooled = pool.request("identify.hash_entries", payload,
+                              rows=len(entries))
+    assert plan.activations().get("procpool.worker") == 1
+    assert pooled == inline
+    assert counter_value("sd_index_bytes_hashed_total") == inline_bytes
+
+
+# --- consult_many pool parity ----------------------------------------------
+
+def test_consult_many_pool_parity(tmp_path, monkeypatch):
+    """Pooled consult matching returns verdicts, entries, AND counter
+    deltas identical to the inline loop over the same journal state."""
+    import spacedrive_tpu.telemetry as telemetry
+    from spacedrive_tpu.db.database import LibraryDb
+    from spacedrive_tpu.location.indexer import journal as _journal
+    from spacedrive_tpu.ops import cas
+
+    db = LibraryDb(str(tmp_path / "lib.db"))
+    journal = _journal.IndexJournal(db)
+    records = []
+    items = []
+    for i in range(24):
+        key = ("/", f"f{i}", "bin")
+        ident = _journal.Identity(100 + i, 1, 10_000 + i, 2048 + i)
+        msg = b"m" * (2048 + i)
+        records.append((key, ident, f"{i:016x}",
+                        cas.build_chunk_cache(msg), None))
+        # 8 hits, 8 identity-changed, 8 misses
+        if i < 8:
+            items.append((key, ident))
+        elif i < 16:
+            items.append((key, _journal.Identity(999, 1, 1, 2048 + i)))
+    for i in range(8):
+        items.append((("/", f"missing{i}", "bin"), None))
+    journal.record_many(1, records)
+
+    def snap():
+        return {
+            k: counter_value("sd_index_journal_ops_total", result=k)
+            for k in ("hit", "miss", "invalidated", "bypassed")
+        }
+
+    telemetry.reset()
+    inline = journal.consult_many(1, items)
+    inline_counts = snap()
+
+    monkeypatch.setenv("SD_PROCS", "2")
+    assert procpool.POOL.start()
+    try:
+        procpool.POOL.warm()
+        telemetry.reset()
+        pooled = journal.consult_many(1, items)
+        pooled_counts = snap()
+    finally:
+        procpool.POOL.stop()
+
+    assert pooled_counts == inline_counts
+    assert inline.keys() == pooled.keys()
+    for key in inline:
+        vi, ei = inline[key]
+        vp, ep = pooled[key]
+        assert vi == vp
+        assert (ei is None) == (ep is None)
+        if ei is not None:
+            assert ei.identity == ep.identity
+            assert ei.cas_id == ep.cas_id
+            assert ei.stale == ep.stale
+            assert (ei.chunks is None) == (ep.chunks is None)
+            if ei.chunks is not None:
+                assert ei.chunks.to_payload() == ep.chunks.to_payload()
+    db.close()
+
+
+# --- the chaos walk: full pass bit-identical under worker death ------------
+
+
+def _build_corpus(root):
+    from PIL import Image
+
+    rng = np.random.default_rng(7)
+    (root / "docs").mkdir(parents=True)
+    (root / "docs" / "a.txt").write_bytes(b"hello procs")
+    (root / "docs" / "b.txt").write_bytes(b"hello procs")  # dup content
+    (root / "big.bin").write_bytes(
+        rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    )
+    (root / "empty.txt").write_bytes(b"")
+    for i in range(4):
+        Image.fromarray(
+            rng.integers(0, 255, (48 + 8 * i, 64, 3), dtype=np.uint8), "RGB"
+        ).save(root / f"img{i}.png")
+
+
+async def _full_pass(data_dir, corpus):
+    """walk → identify through the shard plane (the execute leg that
+    dispatches onto the pool) → media/thumbnails; returns everything
+    the bit-identity contract covers."""
+    from spacedrive_tpu.jobs.manager import JobBuilder
+    from spacedrive_tpu.location.indexer.job import IndexerJob
+    from spacedrive_tpu.location.indexer.mesh import (
+        distribute_location_index,
+    )
+    from spacedrive_tpu.location.locations import LocationCreateArgs
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.object.media.job import MediaProcessorJob
+
+    node = Node(str(data_dir), use_device=False, with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        lib = await node.create_library("procs-chaos")
+        loc = LocationCreateArgs(path=str(corpus)).create(lib)
+        await JobBuilder(IndexerJob({"location_id": loc["id"]})).spawn(
+            node.jobs, lib)
+        await node.jobs.wait_idle()
+        await distribute_location_index(
+            node, lib, loc["id"], run_indexer=False)
+        await JobBuilder(
+            MediaProcessorJob({"location_id": loc["id"]})
+        ).spawn(node.jobs, lib)
+        await node.jobs.wait_idle()
+        await node.thumbnailer.wait_library_batch(lib.id)
+        cas_by_path = {
+            f"{r['materialized_path']}{r['name']}.{r['extension']}":
+                r["cas_id"]
+            for r in lib.db.query(
+                "SELECT materialized_path, name, extension, cas_id "
+                "FROM file_path WHERE is_dir = 0")
+        }
+        grouping = {
+            r["cas_id"]: r["n"] for r in lib.db.query(
+                "SELECT cas_id, COUNT(DISTINCT object_id) AS n "
+                "FROM file_path WHERE cas_id IS NOT NULL "
+                "GROUP BY cas_id")
+        }
+        vouches = {
+            (r["materialized_path"], r["name"], r["extension"]):
+                r["cas_id"]
+            for r in lib.db.query(
+                "SELECT materialized_path, name, extension, cas_id "
+                "FROM index_journal")
+        }
+        thumbs = {}
+        for cas_id in cas_by_path.values():
+            if cas_id and node.thumbnailer.store.exists(
+                    str(lib.id), cas_id):
+                with open(node.thumbnailer.store.path_for(
+                        str(lib.id), cas_id), "rb") as f:
+                    thumbs[cas_id] = f.read()
+        return cas_by_path, thumbs, grouping, vouches
+    finally:
+        await node.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_worker_crash_chaos_pass_bit_identical(tmp_path, monkeypatch):
+    """The acceptance walk: pool enabled, a worker KILLED mid-batch —
+    the pool restarts it once, re-dispatches, and the whole pass
+    converges bit-identical to the SD_PROCS=0 golden run (cas_ids,
+    thumbnail webp bytes, journal vouches, object grouping)."""
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    _build_corpus(corpus)
+
+    monkeypatch.setenv("SD_PROCS", "0")
+    golden = await _full_pass(tmp_path / "golden", corpus)
+    assert len([c for c in golden[0].values() if c]) >= 7
+    assert len(golden[1]) == 4  # the four pngs
+
+    monkeypatch.setenv("SD_PROCS", "2")
+    restarts_before = counter_value("sd_procpool_restarts_total")
+    plan = faults.FaultPlan.parse("procpool.worker:crash:times=1", seed=11)
+    with faults.active(plan):
+        chaos = await _full_pass(tmp_path / "chaos", corpus)
+
+    assert chaos[0] == golden[0], "cas_ids diverged"
+    assert chaos[1] == golden[1], "thumbnail webp bytes diverged"
+    assert chaos[2] == golden[2], "object grouping diverged"
+    assert chaos[3] == golden[3], "journal vouches diverged"
+    assert plan.activations().get("procpool.worker") == 1
+    assert counter_value("sd_procpool_restarts_total") == \
+        restarts_before + 1
+    assert counter_value("sd_procpool_jobs_total", result="ok") > 0
+
+
+@pytest.mark.asyncio
+async def test_pool_failure_degrades_inline(tmp_path, monkeypatch):
+    """With the pool refusing every batch (stopped mid-pass), call
+    sites fall back inline and the pass still completes correctly."""
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    _build_corpus(corpus)
+    monkeypatch.setenv("SD_PROCS", "0")
+    golden = await _full_pass(tmp_path / "golden", corpus)
+
+    # pool "live" but sized down to a worker that immediately dies:
+    # every request errors past the retry budget → inline fallback
+    monkeypatch.setenv("SD_PROCS", "2")
+    plan = faults.FaultPlan.parse(
+        "procpool.worker:crash:times=inf,prob=1.0", seed=13)
+    with faults.active(plan):
+        degraded = await _full_pass(tmp_path / "degraded", corpus)
+    assert degraded[0] == golden[0]
+    assert degraded[1] == golden[1]
+    assert plan.activations().get("procpool.worker", 0) >= 1

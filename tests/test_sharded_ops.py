@@ -146,14 +146,18 @@ def test_auto_policy_keeps_small_batches_single_device(monkeypatch):
     """Without explicit devices, a tiny batch must NOT shard (padding
     32-row rungs across 8 chips to hash 5 files is a net loss); a batch
     filling half the smallest sharded rung must."""
+    # cas imports blake3_jax lazily (workers must import cas jax-free),
+    # so the patch lands on the blake3_jax module itself
+    from spacedrive_tpu.ops import blake3_jax
+
     calls = []
-    real = cas.blake3_jax.hash_batch
+    real = blake3_jax.hash_batch
 
     def spy(arr, lens, max_chunks=None, devices=None, **kw):
         calls.append(len(devices) if devices is not None else 1)
         return real(arr, lens, max_chunks=max_chunks, devices=devices, **kw)
 
-    monkeypatch.setattr(cas.blake3_jax, "hash_batch", spy)
+    monkeypatch.setattr(blake3_jax, "hash_batch", spy)
     small = [cas.message_from_bytes(b"x" * 100) for _ in range(5)]
     cas.cas_ids_begin(small)()
     assert calls == [1]

@@ -40,6 +40,13 @@ fault-plane-throttled link and ≥0.95× static on the clean link — a
 controller that loses to the config it replaced is a regression by
 definition, no history needed.
 
+BENCH_PROCS leg: when ``BENCH_PROCS.json`` exists (``make
+bench-procs``), the multi-process A/B's bit-identity bar gates on
+every rig; the scaling bars (pool ≥1.3× single, attribution
+gap+gil_wait share shrinking) gate only on recordings taken with ≥2
+cores and ≥2 workers — a 1-core recording is an honest floor, not the
+design's scaling (the config_mesh precedent).
+
 Usage:
     python tools/bench_compare.py [--dir .] [--threshold 0.15] [old new]
 Exit codes: 0 ok / nothing to compare, 1 regression, 2 bad invocation.
@@ -349,6 +356,69 @@ def check_autotune(doc: dict[str, Any]) -> dict[str, Any]:
             "skipped": skipped}
 
 
+# bench_e2e config_procs' absolute bar (mirrored there; this gate
+# re-derives the verdict from the recorded figures). The ratio and the
+# gap/gil-shrink bars gate only on recordings taken on a >=2-core rig
+# with >=2 workers — on a 1-core box N workers + the owner time-slice
+# one core, so the recording is an honest floor, not the design's
+# scaling (the config_mesh precedent). Bit-identity gates EVERYWHERE:
+# a pool that changes pass output is a correctness regression no
+# matter how many cores recorded it.
+PROCS_RATIO_MIN = 1.3
+
+
+def check_procs(doc: dict[str, Any]) -> dict[str, Any]:
+    """Gate a BENCH_PROCS document (same result shape as compare())."""
+    checked: list[dict[str, Any]] = []
+    regressions: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    identical = doc.get("identical")
+    rec = {"name": "procs.identical", "old": 1,
+           "new": 1 if identical else 0,
+           "delta_pct": 0.0 if identical else -100.0}
+    checked.append(rec)
+    if not identical:
+        regressions.append(rec)
+    cores = doc.get("host_cores") or 0
+    workers = doc.get("workers") or 0
+    ratio = doc.get("pool_vs_single")
+    if cores < 2 or workers < 2:
+        skipped.append(
+            f"procs.pool_vs_single: recorded on a {cores}-core rig with "
+            f"{workers} worker(s) — honest-floor recording, scaling "
+            "bars ungated (config_mesh precedent)"
+        )
+        return {"checked": checked, "regressions": regressions,
+                "skipped": skipped}
+    if not isinstance(ratio, (int, float)) or isinstance(ratio, bool):
+        skipped.append("procs.pool_vs_single: ratio missing")
+        return {"checked": checked, "regressions": regressions,
+                "skipped": skipped}
+    rec = {"name": "procs.pool_vs_single", "old": PROCS_RATIO_MIN,
+           "new": round(float(ratio), 3),
+           "delta_pct": round((float(ratio) - PROCS_RATIO_MIN) * 100, 2)}
+    checked.append(rec)
+    if ratio < PROCS_RATIO_MIN:
+        regressions.append(rec)
+    shares_s = [doc.get("gap_share_single"), doc.get("gil_share_single")]
+    shares_p = [doc.get("gap_share_pool"), doc.get("gil_share_pool")]
+    if all(not isinstance(v, (int, float)) for v in shares_s):
+        skipped.append("procs.gap_gil_share: not recorded (profiler off)")
+    else:
+        tot_s = sum(v for v in shares_s if isinstance(v, (int, float)))
+        tot_p = sum(v for v in shares_p if isinstance(v, (int, float)))
+        rec = {"name": "procs.gap_gil_share", "old": round(tot_s, 4),
+               "new": round(tot_p, 4),
+               "delta_pct": round((tot_p - tot_s) * 100, 2)}
+        checked.append(rec)
+        # the plane's whole thesis: the pool must SHRINK the
+        # unattributed-gap + gil_wait share, not just the wall clock
+        if tot_p >= tot_s:
+            regressions.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "skipped": skipped}
+
+
 # --- telemetry-history leg (telemetry/history.py segment store) ------------
 
 #: history series gated as higher-is-better rates; idle (0) samples are
@@ -552,6 +622,19 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
             result = check_autotune(at_doc)
             render("BENCH_AUTOTUNE.json (absolute adaptive-vs-static bars)",
+                   result)
+            total_regressions += len(result["regressions"])
+        pr_path = os.path.join(args.dir, "BENCH_PROCS.json")
+        if os.path.exists(pr_path):
+            try:
+                with open(pr_path) as f:
+                    pr_doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"bench-compare: cannot read BENCH_PROCS JSON: {e}",
+                      file=sys.stderr)
+                return 2
+            result = check_procs(pr_doc)
+            render("BENCH_PROCS.json (absolute pool-vs-single bars)",
                    result)
             total_regressions += len(result["regressions"])
         sv_path = os.path.join(args.dir, "BENCH_SERVE.json")

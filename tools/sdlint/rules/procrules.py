@@ -1,0 +1,201 @@
+"""Process-boundary purity.
+
+SD022  objects shipped to the multi-process execution plane
+       (``parallel/procpool.py``) must be msgpack-plain — no Database
+       handles, SQLite connections, event loops, Node/Library objects,
+       policies, sockets, or callables in a pool submit's payload.
+
+The pool's runtime contract is shared-nothing: ``submit()`` msgpack-
+serializes the payload, so a rich object fails loudly at run time. But
+a run-time failure is the WRONG time to learn the payload was impure —
+the call site then silently rides its inline fallback forever and the
+pool quietly stops earning its keep. SD022 moves the check to review
+time.
+
+Detection keys off the repo's procpool idioms:
+
+- the handle is the module attribute (``procpool.POOL.submit(…)``,
+  ``_procpool.POOL.request(…)``) or a local bound from the accessor
+  (``pool = _procpool.get(); pool.submit(…)`` — same-function
+  dataflow, like SD007's ``peer_label`` sanction);
+- the shipped expression is the second positional argument (after the
+  stage name) or the ``payload`` keyword;
+- one level of same-function dataflow is followed: a payload that is a
+  bare local name resolves to its dict-literal assignment when one
+  exists, so the common ``payload = {...}; pool.submit(stage,
+  payload)`` shape is inspected, not waved through.
+
+Flagged inside the payload expression:
+
+- identifiers whose snake_case components name a non-plain resource
+  (``db``, ``conn``, ``node``, ``loop``, ``sync``, ``sock``) or that
+  contain a resource word (``database``, ``library``, ``connection``,
+  ``policy``, ``session``, ``thread``, ``socket``) — the Database /
+  connection / loop / Node / policy family the worker can never hold;
+- ``self``-rooted attribute chains matching those tokens;
+- lambdas (callables cannot cross a process boundary as data).
+
+Plain locals with neutral names (paths, entry lists, wire rows) pass
+untouched; the runtime msgpack check remains the backstop for what a
+name-based rule cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, dotted_name, rule, walk_shallow
+
+#: pool methods whose call sites ship a payload across the boundary
+_SHIP_METHODS = {"submit", "request", "run"}
+
+#: snake_case components that name a non-plain resource
+_COMPONENT_TOKENS = {"db", "conn", "node", "loop", "sync", "sock"}
+#: whole words matched as substrings (long enough to be unambiguous)
+_SUBSTRING_TOKENS = ("database", "library", "connection", "policy",
+                     "session", "thread", "socket")
+
+
+def _is_pool_module(name: str | None) -> bool:
+    return name is not None and name.rsplit(".", 1)[-1] in (
+        "procpool", "_procpool",
+    )
+
+
+def _is_pool_handle(expr: ast.AST, safe_names: set[str]) -> bool:
+    """``procpool.POOL`` / ``_procpool.POOL`` / bare ``POOL`` / a local
+    bound from ``procpool.get()`` or ``procpool.POOL``."""
+    name = dotted_name(expr)
+    if name is not None:
+        parts = name.split(".")
+        if parts[-1] == "POOL" and (
+            len(parts) == 1 or _is_pool_module(".".join(parts[:-1]))
+        ):
+            return True
+        if isinstance(expr, ast.Name) and expr.id in safe_names:
+            return True
+    return False
+
+
+def _pool_handle_names(ctx: FileContext, scope: ast.AST | None) -> set[str]:
+    """Locals assigned from ``procpool.get()`` / ``procpool.POOL`` in
+    this scope (same-function dataflow only)."""
+    names: set[str] = set()
+    for node in walk_shallow(scope if scope is not None else ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        bound = False
+        if isinstance(value, ast.Call):
+            callee = dotted_name(value.func)
+            if callee is not None and callee.rsplit(".", 1)[-1] == "get" \
+                    and _is_pool_module(callee.rsplit(".", 1)[0]):
+                bound = True
+        else:
+            vname = dotted_name(value)
+            if vname is not None and vname.endswith(".POOL") \
+                    and _is_pool_module(vname.rsplit(".", 1)[0]):
+                bound = True
+        if bound:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def _dict_literal_assignments(scope: ast.AST | None,
+                              tree: ast.AST) -> dict[str, ast.Dict]:
+    """``name = {...}`` dict-literal assignments in the scope — the one
+    level of dataflow the payload inspection follows."""
+    out: dict[str, ast.Dict] = {}
+    for node in walk_shallow(scope if scope is not None else tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value
+    return out
+
+
+def _impure_mention(expr: ast.AST) -> str | None:
+    """The first non-plain thing referenced by a payload expression:
+    a resource-shaped identifier or a lambda. Dict KEYS are labels,
+    not shipped object graphs — only values are scanned."""
+    stack = [expr]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Lambda):
+            return "lambda"
+        ident = None
+        if isinstance(cur, ast.Name):
+            ident = cur.id
+        elif isinstance(cur, ast.Attribute):
+            ident = cur.attr
+        if ident is not None:
+            low = ident.lower()
+            if any(tok in low for tok in _SUBSTRING_TOKENS) or \
+                    _COMPONENT_TOKENS & set(low.split("_")):
+                return ident
+        if isinstance(cur, ast.Dict):
+            stack.extend(v for v in cur.values if v is not None)
+            # a ** expansion rides cur.values with a None key slot and
+            # was already pushed; literal keys stay unscanned
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+    return None
+
+
+@rule(
+    "SD022",
+    "process-boundary-purity",
+    "payloads shipped to the procpool must be msgpack-plain — a "
+    "Database/connection/loop/Node/policy object in a submit call site "
+    "fails serialization at run time and silently demotes the site to "
+    "its inline fallback forever",
+)
+def check_process_boundary_purity(ctx: FileContext) -> Iterator[Finding]:
+    handle_cache: dict[int, set[str]] = {}
+    dict_cache: dict[int, dict[str, ast.Dict]] = {}
+
+    def scoped(node: ast.AST, cache: dict, builder):
+        scope = ctx.enclosing_function(node)
+        key = id(scope)
+        if key not in cache:
+            cache[key] = builder(scope)
+        return cache[key]
+
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SHIP_METHODS
+        ):
+            continue
+        safe = scoped(node, handle_cache,
+                      lambda s: _pool_handle_names(ctx, s))
+        if not _is_pool_handle(node.func.value, safe):
+            continue
+        handle = dotted_name(node.func.value) or "pool"
+        payloads = list(node.args[1:2]) + [
+            kw.value for kw in node.keywords if kw.arg == "payload"
+        ]
+        for payload in payloads:
+            target = payload
+            if isinstance(payload, ast.Name):
+                literal = scoped(
+                    node, dict_cache,
+                    lambda s: _dict_literal_assignments(s, ctx.tree),
+                ).get(payload.id)
+                if literal is not None:
+                    target = literal
+            mention = _impure_mention(target)
+            if mention is not None:
+                yield ctx.finding(
+                    "SD022",
+                    node,
+                    f"payload of `{handle}.{node.func.attr}` references "
+                    f"`{mention}` — only msgpack-plain data "
+                    f"(dicts/lists/str/bytes/numbers) may cross the "
+                    f"process boundary; ship keys/paths/rows, never the "
+                    f"resource itself",
+                )
