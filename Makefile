@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: lint test tier1 trace-smoke slo-smoke profile-smoke debug-bundle \
 	bench-devices bench-check bench-warm bench-autotune bench-mesh \
-	bench-procs bench-serve chaos
+	bench-procs bench-serve bench-semantic search-smoke chaos
 
 # set SDLINT_ANNOTATE=1 in CI for GitHub ::error annotations on the diff
 lint:
@@ -75,6 +75,25 @@ bench-mesh:
 bench-procs:
 	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=procs SD_E2E_FILES=4000 \
 		SD_E2E_REPEATS=3 SD_BENCH_WAIT=0 $(PY) bench_e2e.py
+
+# semantic-plane bench: cold embed files/s (per-stage clocks, so the
+# rest of the media pass doesn't dilute it), the warm journal contract
+# (second pass embeds ZERO unchanged files), planted near-duplicate
+# rank-1, and top-k query p50/p99 at 10k/100k vectors into
+# BENCH_SEMANTIC.json; `make bench-check` re-derives the correctness
+# bars (docs/performance.md "Semantic search")
+bench-semantic:
+	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=semantic SD_E2E_IMAGES=96 \
+		SD_E2E_REPEATS=2 $(PY) bench_e2e.py
+
+# semantic-search smoke: boot the pipeline over a planted-near-dup
+# corpus → embed → index → `search.semantic` returns the plant first
+# among non-self hits, plus the GET /search route + serve-cache leg
+search-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest \
+		"tests/test_semantic_search.py::test_pipeline_embeds_searches_and_warm_skips" \
+		"tests/test_semantic_search.py::test_get_search_route_and_rspc" \
+		-q -p no:cacheprovider
 
 # serving-capacity bench: N simulated HTTP/rspc clients vs one node,
 # clean and with the DB throttled through the db.slow fault point,

@@ -26,7 +26,7 @@ from ..node.notifications import Notifications
 from .cache import normalise, normalise_one
 from .invalidate import install_registry, invalidate_query
 from .router import CoreEventKind, Router, RspcError
-from .search import search_objects, search_paths
+from .search import search_objects, search_paths, search_semantic
 
 VERSION = "0.1.0"
 
@@ -749,6 +749,14 @@ def _search(r: Router) -> None:
     @r.query("search.objects", library=True)
     def objects(node, library, arg):
         return search_objects(library, arg)
+
+    @r.query("search.semantic", library=True)
+    async def semantic(node, library, arg):
+        """Vector-index top-k (probe embed + device matmul) — runs off
+        the event loop like search.duplicates; the serve layer caches
+        the byte result until an embedding write invalidates the
+        library tag."""
+        return await asyncio.to_thread(search_semantic, library, arg)
 
     @r.query("search.duplicates", library=True)
     async def duplicates(node, library, arg):

@@ -196,6 +196,51 @@ def search_objects(library: Any, arg: dict[str, Any] | None) -> dict[str, Any]:
     return out
 
 
+def search_semantic(library: Any, arg: dict[str, Any] | None) -> dict[str, Any]:
+    """`search.semantic` — vector-index cosine top-k over the library's
+    embeddings (object/search/index.py). The query string resolves to a
+    probe vector: an existing image path embeds through the same trunk
+    as the pipeline; anything else matches a stored label name and
+    probes with the labeled objects' centroid. No reference counterpart
+    — the reference stops at label search; this is the paper's device
+    workload sold at query time."""
+    import time
+
+    from ..object.search import index as _index
+    from ..telemetry import metrics as _tm
+
+    arg = arg or {}
+    q = arg.get("query")
+    if not q or not isinstance(q, str):
+        raise RspcError.bad_request("query must be a non-empty string")
+    take = _clamp_take(arg)
+
+    t0 = time.perf_counter()
+    probe = _index.probe_for(library, q)
+    if probe is None:
+        return {"items": [], "nodes": [], "scores": {}, "resolved": False}
+    hits = _index.query(library, probe, k=take)
+    rows: list[dict[str, Any]] = []
+    scores: dict[str, float] = {}
+    for object_id, score in hits:
+        fp = library.db.query_one(
+            "SELECT fp.* FROM file_path fp WHERE fp.object_id = ? "
+            "ORDER BY fp.id LIMIT 1",
+            (object_id,),
+        )
+        if fp is None:
+            continue
+        fp["size_in_bytes"] = blob_u64(fp.pop("size_in_bytes_bytes", None)) or 0
+        fp["score"] = float(score)
+        rows.append(fp)
+        scores[str(fp["id"])] = float(score)
+    out = normalise("file_path", rows)
+    out["scores"] = scores
+    out["resolved"] = True
+    _tm.SEARCH_QUERY_SECONDS.observe(time.perf_counter() - t0)
+    return out
+
+
 def _apply_cursor(
     cursor: Any,
     order_field: str,

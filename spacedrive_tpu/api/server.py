@@ -85,6 +85,7 @@ class ApiServer:
                 self._gated(web.get("/profile", self._profile), BACKGROUND),
                 self._gated(web.get("/health", self._health), CONTROL),
                 self._gated(web.get("/mesh", self._mesh), INTERACTIVE),
+                self._gated(web.get("/search", self._search), INTERACTIVE),
                 self._gated(
                     web.get("/static/{path:.*}", self._static), INTERACTIVE
                 ),
@@ -351,6 +352,58 @@ class ApiServer:
 
     async def _manifest(self, _request: web.Request) -> web.Response:
         return web.json_response(self.router.manifest())
+
+    async def _search(self, request: web.Request) -> web.Response:
+        """`GET /search?library_id=…&q=…[&take=N]` — the semantic-search
+        plane's plain-HTTP face (curl/dashboards; rspc clients use the
+        `search.semantic` procedure). Rides the exact same router
+        procedure and therefore the same serve byte-cache and tag
+        invalidation as the POST transport."""
+        lib_id = request.query.get("library_id")
+        q = request.query.get("q", "")
+        if not lib_id or not q:
+            return web.json_response(
+                {"error": "library_id and q are required"}, status=400
+            )
+        arg: dict[str, Any] = {"query": q}
+        if "take" in request.query:
+            try:
+                arg["take"] = int(request.query["take"])
+            except ValueError:
+                return web.json_response(
+                    {"error": "take must be an integer"}, status=400
+                )
+        try:
+            serve = runtime_for(self.node)
+            if serve is not None:
+                from ..serve import canonical_library_id, query_cache_key
+
+                async def load_bytes() -> bytes:
+                    result = await self.router.exec(
+                        self.node, "search.semantic", arg, lib_id
+                    )
+                    return _dumps({"result": result}).encode()
+
+                lib_key = canonical_library_id(lib_id)
+                res = await serve.queries.get(
+                    ("http",) + query_cache_key("search.semantic", lib_id, arg),
+                    load_bytes,
+                    tags=(("lib", lib_key), ("q", "search.semantic", lib_key)),
+                    stale_ok=serve.gate.in_brownout(),
+                )
+                return web.Response(
+                    body=res.value,
+                    content_type="application/json",
+                    headers={"X-SD-Cache": res.state},
+                )
+            result = await self.router.exec(
+                self.node, "search.semantic", arg, lib_id
+            )
+            return web.json_response({"result": result}, dumps=_dumps)
+        except RspcError as e:
+            return web.json_response(
+                {"error": e.message, "code": e.code}, status=e.code
+            )
 
     # --- rspc ----------------------------------------------------------
 

@@ -214,6 +214,39 @@ async def cmd_duplicates(args: argparse.Namespace) -> int:
         await node.shutdown()
 
 
+async def cmd_search(args: argparse.Namespace) -> int:
+    """Search an indexed library: plain name match by default,
+    `--semantic` scores the query against the vector index (the query
+    is an image path to embed, or a label name whose objects' centroid
+    becomes the probe)."""
+    from .api.search import search_paths, search_semantic
+
+    node = _make_node(args, with_labeler=False)
+    await node.start()
+    try:
+        lib = await _get_or_create_library(node, args.library)
+        if args.semantic:
+            out = search_semantic(
+                lib, {"query": args.query, "take": args.take}
+            )
+            if not out.get("resolved"):
+                print(
+                    "query resolved to no probe vector (not an image "
+                    "path or a stored label name)",
+                    file=sys.stderr,
+                )
+                return 1
+        else:
+            out = search_paths(
+                lib,
+                {"filter": {"search": args.query}, "take": args.take},
+            )
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    finally:
+        await node.shutdown()
+
+
 import contextlib
 
 
@@ -865,6 +898,15 @@ def build_parser() -> argparse.ArgumentParser:
     du.add_argument("--threshold", type=int, default=8)
     du.add_argument("--no-p2p", action="store_true", default=True)
 
+    se = sub.add_parser("search", help="search an indexed library")
+    se.add_argument("query", help="name substring; with --semantic, an "
+                    "image path or stored label name")
+    se.add_argument("--library", default="default")
+    se.add_argument("--semantic", action="store_true",
+                    help="vector-index cosine top-k instead of name match")
+    se.add_argument("--take", type=int, default=10)
+    se.add_argument("--no-p2p", action="store_true", default=True)
+
     pe = sub.add_parser("peers", help="discover and list mesh peers")
     pe.add_argument("--wait", type=float, default=3.0)
 
@@ -1085,6 +1127,8 @@ def main(argv: list[str] | None = None) -> int:
         return asyncio.run(cmd_browse(args))
     if args.cmd == "duplicates":
         return asyncio.run(cmd_duplicates(args))
+    if args.cmd == "search":
+        return asyncio.run(cmd_search(args))
     if args.cmd == "peers":
         return asyncio.run(cmd_peers(args))
     if args.cmd == "pair":

@@ -348,6 +348,24 @@ MIGRATIONS: list[list[str]] = [
         "CREATE INDEX idx_crdt_model_record_ts ON "
         "crdt_operation(model, record_id, timestamp)",
     ],
+    # v4 -> v5: per-object semantic embedding (models/embedder.py) —
+    # the vector column is the EMBED_DIM f32 LE blob the search index
+    # memmaps; identity rides the object FK like media_data, so the
+    # row replicates through the CRDT plane with `object.pub_id` as
+    # its sync id (db/sync_registry.py).
+    [
+        """
+        CREATE TABLE object_embedding (
+            id              INTEGER PRIMARY KEY AUTOINCREMENT,
+            object_id       INTEGER NOT NULL UNIQUE REFERENCES object(id)
+                            ON DELETE CASCADE,
+            vector          BLOB,
+            dim             INTEGER,
+            model           TEXT,
+            date_calculated TEXT
+        )
+        """,
+    ],
 ]
 
 # The version every migrated database reports via PRAGMA user_version.

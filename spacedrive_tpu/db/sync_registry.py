@@ -72,6 +72,10 @@ SYNC_MODELS: dict[str, SyncModel] = {
             "media_data", SyncKind.SHARED,
             id_ref=ForeignRef("object_id", "object"),
         ),
+        SyncModel(
+            "object_embedding", SyncKind.SHARED,
+            id_ref=ForeignRef("object_id", "object"),
+        ),
         SyncModel("tag", SyncKind.SHARED, id_field="pub_id"),
         SyncModel("label", SyncKind.SHARED, id_field="name"),
         SyncModel("preference", SyncKind.SHARED, id_field="key"),

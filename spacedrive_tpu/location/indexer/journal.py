@@ -119,6 +119,7 @@ class JournalEntry:
     thumb: bool = False
     media_digest: str | None = None
     phash: bytes | None = None
+    embed: bool = False
     chunks: ChunkCache | None = None
 
 
@@ -161,6 +162,7 @@ def entry_of_row(row: dict) -> JournalEntry | None:
             thumb=bool(payload.get("thumb")),
             media_digest=media,
             phash=phash,
+            embed=bool(payload.get("embed")),
             chunks=chunks,
         )
     except (TypeError, ValueError):
@@ -479,6 +481,7 @@ class IndexJournal:
                         thumb=bool(plain.get("thumb")),
                         media_digest=plain.get("media"),
                         phash=plain.get("phash"),
+                        embed=bool(plain.get("embed")),
                         chunks=chunks,
                     )
                 if verdict == HIT:
@@ -567,6 +570,8 @@ class IndexJournal:
                     payload["media"] = carry.media_digest
                 if carry.phash is not None:
                     payload["phash"] = carry.phash
+                if carry.embed:
+                    payload["embed"] = True
             rows.append((
                 location_id, mat, name, ext,
                 u64_blob(ident.inode), u64_blob(ident.dev),
@@ -664,6 +669,12 @@ class IndexJournal:
         in the store (crash between store and this write is safe: the
         next pass re-checks the store and re-vouches)."""
         self._amend_payload(location_id, key, cas_id, thumb=True)
+
+    def vouch_embed(self, location_id: int, key: Key, cas_id: str | None) -> None:
+        """Mark the embedding persisted — call ONLY after the
+        object_embedding row (and its sync ops) committed; a crash
+        between commit and this write just re-embeds once."""
+        self._amend_payload(location_id, key, cas_id, embed=True)
 
     def vouch_media(self, location_id: int, key: Key, cas_id: str | None,
                     digest: str) -> None:

@@ -58,7 +58,7 @@ class MediaProcessorJob(StatefulJob):
     """init: {location_id, sub_path?, backend?}"""
 
     NAME = "media_processor"
-    INVALIDATES = ("search.paths", "labels.list")
+    INVALIDATES = ("search.paths", "labels.list", "search.semantic")
     IS_BATCHED = True
 
     async def init_job(self, ctx: JobContext) -> None:
@@ -175,6 +175,42 @@ class MediaProcessorJob(StatefulJob):
                     "vouch": thumb_vouch,
                 }
             )
+        # semantic embedding stage (SD_EMBED=0 ⇒ a true no-op: no
+        # steps, no DB writes, no sync ops — today's pipeline exactly)
+        from ...models import embedder as _embedder
+
+        if _embedder.enabled():
+            from ...parallel import autotune as _autotune
+            from ...parallel import mesh as _mesh
+            from ...telemetry import metrics as _tm
+
+            embed_rows = []
+            for r in rows:
+                if (r["extension"] or "").lower() not in IMAGE_EXTENSIONS:
+                    continue
+                entry = vouched[r["id"]]
+                if entry is not None and entry.embed:
+                    # journal vouched: unchanged bytes are never
+                    # re-read, never re-embedded
+                    journal.bytes_saved(
+                        blob_u64(r["size_in_bytes_bytes"]) or 0,
+                        location_id=loc_id,
+                    )
+                    _tm.EMBED_FILES.inc(result="skipped")
+                    continue
+                embed_rows.append(r)
+            chunk_rows = _autotune.policy("embed").embed_chunk_rows(
+                _mesh.accelerator_count()
+            )
+            for i in range(0, len(embed_rows), chunk_rows):
+                chunk = embed_rows[i:i + chunk_rows]
+                self.steps.append(
+                    {
+                        "kind": "embed",
+                        "ids": [(r["id"], r["object_id"]) for r in chunk],
+                    }
+                )
+
         labeler = getattr(getattr(library, "node", None), "image_labeler", None)
         label_rows = [
             r for r in rows if (r["extension"] or "").lower() in IMAGE_EXTENSIONS
@@ -193,7 +229,7 @@ class MediaProcessorJob(StatefulJob):
 
         self.run_metadata.update(
             media_data_extracted=0, media_data_skipped=0,
-            thumbnails_dispatched=dispatched,
+            thumbnails_dispatched=dispatched, embeddings_written=0,
         )
         ctx.progress(
             message=f"processing media for {len(rows)} files", phase="media"
@@ -203,6 +239,12 @@ class MediaProcessorJob(StatefulJob):
         kind = step["kind"]
         if kind == "extract_media_data":
             return self._extract_media_data(ctx, step)
+        if kind == "embed":
+            import asyncio
+
+            # decode + device forward + commit are all blocking; the
+            # loop keeps serving other jobs meanwhile
+            return await asyncio.to_thread(self._embed_files, ctx, step)
         if kind == "wait_thumbnails":
             return await self._wait_thumbnails(ctx, step)
         if kind == "wait_labels":
@@ -252,6 +294,154 @@ class MediaProcessorJob(StatefulJob):
                 "media_data_skipped": self.run_metadata["media_data_skipped"] + skipped,
             }
         )
+
+    def _embed_files(self, ctx: JobContext, step: dict) -> StepResult:
+        """One embedding chunk: decode (procpool leg when the pool is
+        up, inline otherwise — the EXACT same decode_image body either
+        way) → one padded device forward (ops/embed_jax, DeviceLadder
+        demotion inside) → object_embedding rows + their CRDT ops in
+        ONE transaction via sync.write_ops, so the vectors replicate
+        live like any other shared model. Journal vouches are written
+        strictly AFTER that commit."""
+        import time
+
+        import numpy as np
+
+        from ...db.database import now_iso
+        from ...models import embedder as _embedder
+        from ...ops import embed_jax
+        from ...telemetry import metrics as _tm
+        from ..search import index as _search_index
+
+        library = ctx.library
+        loc_path = self.data["location_path"]
+        loc_id = self.data["location_id"]
+        journal = _journal.IndexJournal(library.db)
+
+        items: list[tuple[dict, int, str]] = []  # (row, object_id, path)
+        errors = 0
+        for fp_id, object_id in step["ids"]:
+            row = library.db.find_one("file_path", id=fp_id)
+            if row is None or object_id is None:
+                errors += 1
+                continue
+            items.append((row, object_id, _full_path(loc_path, row)))
+        if not items:
+            if errors:
+                _tm.EMBED_FILES.inc(errors, result="error")
+            return StepResult()
+
+        t0 = time.perf_counter()
+        planes = self._decode_for_embed([p for _, _, p in items])
+        _tm.EMBED_STAGE_SECONDS.observe(
+            time.perf_counter() - t0, stage="decode")
+
+        batch_rows: list[tuple[dict, int]] = []
+        batch_imgs: list[np.ndarray] = []
+        for (row, object_id, _path), img in zip(items, planes):
+            if img is None:
+                errors += 1
+                continue
+            batch_rows.append((row, object_id))
+            batch_imgs.append(img)
+        if errors:
+            _tm.EMBED_FILES.inc(errors, result="error")
+        if not batch_imgs:
+            return StepResult()
+
+        t0 = time.perf_counter()
+        vectors = embed_jax.embed_batch(np.stack(batch_imgs))
+        _tm.EMBED_STAGE_SECONDS.observe(
+            time.perf_counter() - t0, stage="forward")
+
+        t0 = time.perf_counter()
+        sync = library.sync
+        stamp = now_iso()
+        ops = []
+        writes: list[tuple[int, bytes]] = []
+        for (row, object_id), vec in zip(batch_rows, vectors):
+            obj = library.db.find_one("object", id=object_id)
+            if obj is None:
+                _tm.EMBED_FILES.inc(result="error")
+                continue
+            blob = _embedder.vector_to_blob(vec)
+            writes.append((object_id, blob))
+            ops.extend(sync.shared_create(
+                "object_embedding", obj["pub_id"].hex(),
+                [
+                    ("vector", blob),
+                    ("dim", _embedder.EMBED_DIM),
+                    ("model", _embedder.MODEL_NAME),
+                    ("date_calculated", stamp),
+                ],
+            ))
+
+        def db_writes(conn) -> None:
+            for object_id, blob in writes:
+                conn.execute(
+                    "INSERT INTO object_embedding (object_id, vector, dim, "
+                    "model, date_calculated) VALUES (?,?,?,?,?) "
+                    "ON CONFLICT (object_id) DO UPDATE SET "
+                    "vector=excluded.vector, dim=excluded.dim, "
+                    "model=excluded.model, "
+                    "date_calculated=excluded.date_calculated",
+                    (object_id, blob, _embedder.EMBED_DIM,
+                     _embedder.MODEL_NAME, stamp),
+                )
+
+        if writes:
+            sync.write_ops(ops, db_writes)
+            # vouches ordered after the durable commit: a crash between
+            # commit and vouch re-embeds once, never vouches a phantom
+            for (row, _object_id), _vec in zip(batch_rows, vectors):
+                journal.vouch_embed(
+                    loc_id, _journal.key_of(row), row["cas_id"]
+                )
+            _tm.EMBED_FILES.inc(len(writes), result="embedded")
+            _search_index.refresh(library)
+        _tm.EMBED_STAGE_SECONDS.observe(
+            time.perf_counter() - t0, stage="write")
+        return StepResult(
+            metadata={
+                "embeddings_written":
+                    self.run_metadata.get("embeddings_written", 0)
+                    + len(writes),
+            }
+        )
+
+    def _decode_for_embed(self, paths: list[str]) -> list:
+        """The embedding decode leg: pooled when the multi-process
+        plane is up (stage `embed.decode` — SD022 keeps the payload
+        msgpack-plain), inline fallback otherwise; both run
+        models/embedder.decode_image so the planes are bit-identical."""
+        import numpy as np
+
+        from ...models import embedder as _embedder
+        from ...parallel import procpool as _procpool
+
+        pool = _procpool.get()
+        if pool is not None and len(paths) > 1:
+            try:
+                reply = pool.request(
+                    "embed.decode", {"paths": list(paths)}, rows=len(paths),
+                )
+                planes = reply["planes"]
+                if len(planes) != len(paths):
+                    raise ValueError("plane count mismatch")
+                shape = (_embedder.IMAGE_SIZE, _embedder.IMAGE_SIZE, 3)
+                out = []
+                for raw in planes:
+                    if raw is None:
+                        out.append(None)
+                        continue
+                    arr = np.frombuffer(raw, np.float32)
+                    if arr.size != int(np.prod(shape)):
+                        raise ValueError("plane size mismatch")
+                    out.append(arr.reshape(shape))
+                return out
+            except (_procpool.ProcPoolError, KeyError, TypeError, ValueError):
+                pass  # torn round-trip → the inline leg decodes
+        return [_embedder.decode_image(p) for p in paths]
 
     async def _wait_thumbnails(self, ctx: JobContext, step: dict) -> StepResult:
         """Rendezvous with the thumbnailer actor (ref:job.rs:83-88

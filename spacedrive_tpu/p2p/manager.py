@@ -200,7 +200,7 @@ class P2PManager:
                     logger.debug("sync pull from %s failed: %s", peer.identity, e)
             return [], False
 
-        def on_applied(lib_id=lib.id):
+        def on_applied(lib_id=lib.id, lib=lib):
             # sync-applied ops dirty this library's cached reads: the
             # remote mutation plane can't name query keys, so the whole
             # library tag drops (serve cache read-your-writes, remote
@@ -210,6 +210,13 @@ class P2PManager:
             serve = runtime_for(self.node)
             if serve is not None:
                 serve.invalidate_library(lib_id, source="sync")
+            # replicated object_embedding rows fold into the vector
+            # index here, so a replica answers search.semantic without
+            # ever running the embed stage itself (failure-contained:
+            # the hook must never wedge the ingest actor)
+            from ..object.search import on_embeddings_applied
+
+            on_embeddings_applied(lib)
 
         actor = IngestActor(lib.sync, request_ops, on_applied=on_applied)
         self.ingest_actors[lib.id] = actor

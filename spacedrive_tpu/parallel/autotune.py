@@ -88,6 +88,11 @@ IDENTIFY_CPU_WINDOW = 100
 #: DEVICE_BATCH in object/media/thumbnail/actor.py)
 THUMB_DEVICE_BATCH = 32
 
+#: embedding images per device dispatch per accelerator (the semantic
+#: search forward pass, ops/embed_jax.py — same quantum shape as the
+#: thumbnailer's)
+EMBED_DEVICE_BATCH = 32
+
 #: feeder read-ahead: base depth and hard cap (parallel/feeder.py's
 #: pipeline_depth shape function still derives the device scaling)
 FEEDER_BASE_DEPTH = 3
@@ -127,7 +132,7 @@ OCC_HIGH = 0.9
 #: idle pipeline is not evidence of anything)
 STEP_STREAK = 2
 
-WORKLOADS = ("identify", "thumbnail")
+WORKLOADS = ("identify", "thumbnail", "embed")
 
 
 def enabled() -> bool:
@@ -191,6 +196,14 @@ class PipelinePolicy:
             return base
         return max(1, int(base * self.window_scale))
 
+    def embed_chunk_rows(self, n_accel: int = 1) -> int:
+        """Embedding images per device chunk (the semantic-search
+        forward pass quantum)."""
+        base = EMBED_DEVICE_BATCH * max(1, n_accel)
+        if not enabled():
+            return base
+        return max(1, int(base * self.window_scale))
+
     def procpool_batch_rows(self) -> int:
         """Entries per multi-process-pool round-trip (the execute leg's
         per-stage shipping quantum — parallel/procpool.py). An explicit
@@ -247,7 +260,7 @@ class Sample:
 
 
 #: which occupancy `op` label feeds each workload's rung control
-_OCC_OP = {"identify": "blake3", "thumbnail": "thumbnail"}
+_OCC_OP = {"identify": "blake3", "thumbnail": "thumbnail", "embed": "embed"}
 
 
 class Controller:
@@ -615,11 +628,12 @@ class Controller:
             loop_lag_s=round(s.loop_lag_s, 4),
             demotion_level=s.demotion_level,
         )
-        # inline two-constant conditionals bound the label domains at
-        # the emit site (SD007): WORKLOADS and the action verbs are the
+        # inline bounded conditionals pin the label domains at the
+        # emit site (SD007): WORKLOADS and the action verbs are the
         # entire vocabulary
         _tm.AUTOTUNE_DECISIONS.inc(
-            workload="identify" if workload == "identify" else "thumbnail",
+            workload="identify" if workload == "identify"
+            else ("thumbnail" if workload == "thumbnail" else "embed"),
             action="promote" if action == "promote" else "demote",
         )
         self._export_gauges(workload, pol, knob, new)
@@ -634,17 +648,20 @@ class Controller:
         scale = new if knob == "window_scale" else pol.window_scale
         rung = new if knob == "rung" else pol.rung
         extra = new if knob == "depth_extra" else pol.depth_extra
-        # inline two-constant conditionals bound the label domain at
-        # each emit site (SD007): WORKLOADS is the entire vocabulary
+        # inline bounded conditionals pin the label domain at each
+        # emit site (SD007): WORKLOADS is the entire vocabulary
         _tm.AUTOTUNE_WINDOW_SCALE.set(
             float(scale),
-            workload="identify" if workload == "identify" else "thumbnail")
+            workload="identify" if workload == "identify"
+            else ("thumbnail" if workload == "thumbnail" else "embed"))
         _tm.AUTOTUNE_RUNG.set(
             float(rung),
-            workload="identify" if workload == "identify" else "thumbnail")
+            workload="identify" if workload == "identify"
+            else ("thumbnail" if workload == "thumbnail" else "embed"))
         _tm.AUTOTUNE_DEPTH_EXTRA.set(
             float(extra),
-            workload="identify" if workload == "identify" else "thumbnail")
+            workload="identify" if workload == "identify"
+            else ("thumbnail" if workload == "thumbnail" else "embed"))
 
     def snapshot(self) -> dict[str, Any]:
         """Current knob state — embedded in health.evaluate() so the
@@ -706,6 +723,7 @@ __all__ = [
     "FEEDER_DEPTH_CAP",
     "IDENTIFY_CPU_WINDOW",
     "IDENTIFY_DEVICE_WINDOW",
+    "EMBED_DEVICE_BATCH",
     "PipelinePolicy",
     "Sample",
     "THUMB_DEVICE_BATCH",

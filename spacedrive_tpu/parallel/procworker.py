@@ -178,6 +178,7 @@ def _stage_journal_match(payload: dict) -> dict:
             "thumb": entry.thumb,
             "media": entry.media_digest,
             "phash": entry.phash,
+            "embed": entry.embed,
             # already strictly validated by entry_of_row — the owner
             # reconstructs without re-validating
             "chunks": entry.chunks.to_payload()
@@ -274,6 +275,25 @@ def _stage_phash_gray(payload: dict) -> dict:
     return {"gray": None}
 
 
+def _stage_embed_decode(payload: dict) -> dict:
+    """The embedding stage's decode leg: image file → the embedder's
+    fixed input plane (models/embedder.decode_image — the EXACT code
+    path the inline fallback runs, so pooled and single-process decodes
+    are bit-identical). Undecodable files return None slots; the owner
+    skips them without paying a second guaranteed-to-fail decode.
+
+    payload: {"paths": [str, ...]}
+    result:  {"planes": [bytes | None, ...]}  (f32 S·S·3 planes)
+    """
+    from ..models.embedder import decode_image
+
+    planes: list[bytes | None] = []
+    for path in payload["paths"]:
+        img = decode_image(path)
+        planes.append(None if img is None else img.tobytes())
+    return {"planes": planes}
+
+
 STAGES = {
     "echo": _stage_echo,
     "identify.hash_entries": _stage_hash_entries,
@@ -281,6 +301,7 @@ STAGES = {
     "link.prep": _stage_link_prep,
     "thumb.cpu": _stage_thumb_cpu,
     "phash.gray": _stage_phash_gray,
+    "embed.decode": _stage_embed_decode,
 }
 
 

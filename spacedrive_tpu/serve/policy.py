@@ -206,6 +206,7 @@ def class_for_key(key: str, explicit: str | None = None) -> str:
 CACHEABLE_QUERIES = frozenset({
     "search.paths",
     "search.objects",
+    "search.semantic",
     "tags.list",
     "labels.list",
     "library.statistics",

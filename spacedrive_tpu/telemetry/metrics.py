@@ -105,6 +105,37 @@ THUMB_STAGE_SECONDS = REGISTRY.histogram(
     labels=("stage",),  # decode | device | encode
 )
 
+# --- semantic search (models/embedder.py, object/search/index.py) -----------
+
+EMBED_FILES = REGISTRY.counter(
+    "sd_embed_files_total",
+    "media-pipeline embedding outcomes per file: embedded (vector "
+    "computed and persisted), skipped (journal vouched — unchanged "
+    "bytes), error (undecodable image)",
+    labels=("result",),  # embedded | skipped | error
+)
+EMBED_STAGE_SECONDS = REGISTRY.histogram(
+    "sd_embed_stage_seconds",
+    "per-chunk time split across the embedding stages: host/pool "
+    "decode, device forward, DB+sync write",
+    labels=("stage",),  # decode | forward | write
+)
+SEARCH_QUERIES = REGISTRY.counter(
+    "sd_search_queries_total",
+    "semantic search queries by scoring path (device = jitted matmul "
+    "top-k, host = numpy fallback after a device failure)",
+    labels=("path",),  # device | host
+)
+SEARCH_QUERY_SECONDS = REGISTRY.histogram(
+    "sd_search_query_seconds",
+    "end-to-end semantic query latency: probe embed + index scoring "
+    "+ row hydration",
+)
+SEARCH_INDEX_VECTORS = REGISTRY.gauge(
+    "sd_search_index_vectors",
+    "vectors in the most recently refreshed per-library search index",
+)
+
 # --- udp stream (p2p/udpstream.py) ------------------------------------------
 
 UDP_RETRANSMITS = REGISTRY.counter(
@@ -161,14 +192,14 @@ ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 SHARD_BATCH_ROWS = REGISTRY.histogram(
     "sd_device_shard_batch_rows",
     "rows each device receives in a dp-sharded dispatch",
-    labels=("op",),  # blake3 | thumbnail
+    labels=("op",),  # blake3 | thumbnail | embed
     buckets=ROW_BUCKETS,
 )
 DEVICE_DISPATCH_OCCUPANCY = REGISTRY.histogram(
     "sd_device_dispatch_occupancy",
     "fraction of a device's shard rows holding real (non-pad) work, "
     "one observation per device per sharded dispatch",
-    labels=("op",),  # blake3 | thumbnail
+    labels=("op",),  # blake3 | thumbnail | embed
     buckets=RATIO_BUCKETS,
 )
 CAS_BACKEND_FALLBACK = REGISTRY.counter(

@@ -52,6 +52,16 @@ FAULT_POINTS: dict[str, tuple[str, tuple[str, ...]]] = {
         "thumbnail device resize (ops/thumbnail_jax.resize_batch)",
         ("raise", "xla", "wrong_shape"),
     ),
+    "embed.forward": (
+        "semantic embedding forward pass (ops/embed_jax.embed_batch)",
+        ("raise", "xla", "wrong_shape"),
+    ),
+    "search.query": (
+        "vector-index device scoring (object/search/index.query) — the "
+        "device leg fails, scoring must fall back to the host path with "
+        "an identical ranking",
+        ("raise", "xla"),
+    ),
     "device.probe": (
         "per-device health probe (parallel/mesh.DeviceLadder) — arg "
         "selects the device index that reads as dead",

@@ -32,6 +32,16 @@ from spacedrive_tpu.ops import configure_compilation_cache  # noqa: E402
 
 configure_compilation_cache()
 
+# Preload sklearn's native stack (scipy/openmp) BEFORE test modules pull
+# in torch/cv2/av during collection. train.digits_demo_dataset imports
+# sklearn lazily at call time; with the full suite's native libraries
+# already resident that late dlopen segfaults (static-TLS exhaustion).
+# Loading it first — while TLS slots are still free — is benign.
+try:  # pragma: no cover - environment-dependent
+    import sklearn.datasets  # noqa: E402,F401
+except Exception:
+    pass
+
 # Minimal async-test support (pytest-asyncio isn't in the image):
 # coroutine test functions run under asyncio.run with a fresh loop.
 import asyncio  # noqa: E402

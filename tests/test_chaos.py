@@ -529,6 +529,146 @@ async def test_sync_serve_vanish_closes_stream_before_response(tmp_path):
         await node.shutdown()
 
 
+# --- semantic embedding + search (ISSUE 16) --------------------------------
+
+
+def test_embed_fault_demotes_ladder_and_converges():
+    """Injected device failures mid-embedding demote down the ladder;
+    the surviving pass produces the IDENTICAL vector set (the host path
+    is bit-identical, so chaos never changes an embedding)."""
+    from spacedrive_tpu.ops import embed_jax
+
+    rng = np.random.default_rng(11)
+    imgs = rng.random((10, 32, 32, 3)).astype(np.float32)
+    clean = embed_jax.embed_batch(imgs)
+
+    for mode in ("raise", "xla"):
+        mesh.LADDER.reset()
+        plan = faults.FaultPlan.parse(f"embed.forward:{mode}:times=2", seed=3)
+        with faults.active(plan):
+            out = embed_jax.embed_batch(imgs)
+        assert plan.activations().get("embed.forward", 0) == 2
+        assert np.array_equal(out, clean), mode
+        # two consecutive failures walked the ladder off the full mesh
+        assert mesh.LADDER.level > mesh.LEVEL_MESH, mode
+    mesh.LADDER.reset()
+
+    # wrong_shape: the post-dispatch shape validator trips, and the
+    # retry (fault exhausted) still converges
+    plan = faults.FaultPlan.parse("embed.forward:wrong_shape:times=1", seed=3)
+    mesh.LADDER.reset()
+    with faults.active(plan):
+        out = embed_jax.embed_batch(imgs)
+    assert np.array_equal(out, clean)
+
+
+def test_search_query_fault_host_fallback_ranks_identically():
+    """The `search.query` fault kills the device scoring leg; the host
+    path must return the same ranking (stable tie-break parity)."""
+    import types
+
+    from spacedrive_tpu.db import LibraryDb
+    from spacedrive_tpu.models import embedder
+    from spacedrive_tpu.object.search.index import LibraryIndex
+
+    db = LibraryDb(None, memory=True)
+    lib = types.SimpleNamespace(db=db, id=uuid.uuid4())
+    rng = np.random.default_rng(5)
+    for i in range(40):
+        oid = db.insert("object", pub_id=os.urandom(16), kind=5)
+        vec = rng.standard_normal(embedder.EMBED_DIM).astype(np.float32)
+        db.insert(
+            "object_embedding", object_id=oid,
+            vector=embedder.vector_to_blob(vec), dim=embedder.EMBED_DIM,
+            model=embedder.MODEL_NAME, date_calculated="2026-01-01T00:00:00",
+        )
+    idx = LibraryIndex(lib)
+    idx.refresh()
+    probe = rng.standard_normal(embedder.EMBED_DIM).astype(np.float32)
+
+    device_hits = idx.query(probe, k=10)
+    host0 = counter_value("sd_search_queries_total", path="host")
+    with faults.active(
+        faults.FaultPlan.parse("search.query:raise:times=1", seed=1)
+    ):
+        host_hits = idx.query(probe, k=10)
+    assert counter_value("sd_search_queries_total", path="host") == host0 + 1
+    assert [h[0] for h in host_hits] == [h[0] for h in device_hits]
+    assert np.allclose(
+        [h[1] for h in host_hits], [h[1] for h in device_hits], atol=1e-6
+    )
+
+
+@pytest.mark.asyncio
+async def test_poisoned_embedding_op_rejected_alone():
+    """A sync-applied `object_embedding` op carrying a corrupt vector
+    lands in the DB (LWW applies fields blindly) but is rejected ALONE
+    by index maintenance — the other replicated vectors index fine and
+    queries keep answering."""
+    import types
+
+    from spacedrive_tpu.models import embedder
+    from spacedrive_tpu.object.search.index import LibraryIndex
+
+    a, b = _SyncInstance("a"), _SyncInstance("b")
+    for x, y in ((a, b), (b, a)):
+        from spacedrive_tpu.db.database import now_iso
+
+        now = now_iso()
+        x.db.insert(
+            "instance", pub_id=y.id.bytes, identity=b"", node_id=b"",
+            node_name="", node_platform=0, last_seen=now, date_created=now,
+        )
+    a.peers.append(b)
+
+    rng = np.random.default_rng(17)
+    pubs = [os.urandom(16) for _ in range(3)]
+    vecs = [
+        rng.standard_normal(embedder.EMBED_DIM).astype(np.float32)
+        for _ in range(3)
+    ]
+    for i, (pub, vec) in enumerate(zip(pubs, vecs)):
+        blob = (
+            b"\x01\x02\x03" if i == 1  # the poisoned op: 3-byte vector
+            else embedder.vector_to_blob(vec)
+        )
+        b.sync.write_ops(b.sync.shared_create(
+            "object_embedding", pub.hex(),
+            [("vector", blob), ("dim", embedder.EMBED_DIM),
+             ("model", embedder.MODEL_NAME),
+             ("date_calculated", f"2026-01-0{i + 1}T00:00:00")],
+        ))
+    a.actor.notify()
+    await a.actor.wait_idle()
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM object_embedding"
+    )["n"] == 3
+
+    lib = types.SimpleNamespace(db=a.db, id=a.id)
+    idx = LibraryIndex(lib)
+    n = idx.refresh()  # must not raise
+    assert n == 2  # the poisoned row is skipped ALONE
+    good_oids = {
+        a.db.find_one("object", pub_id=pub)["id"] for pub in (pubs[0], pubs[2])
+    }
+    hits = idx.query(vecs[0], k=2)
+    assert {h[0] for h in hits} == good_oids
+    assert hits[0][1] == pytest.approx(1.0, abs=1e-5)
+
+    # a later repair op for the same row is folded in (LWW overwrite)
+    b.sync.write_ops(b.sync.shared_create(
+        "object_embedding", pubs[1].hex(),
+        [("vector", embedder.vector_to_blob(vecs[1])),
+         ("dim", embedder.EMBED_DIM), ("model", embedder.MODEL_NAME),
+         ("date_calculated", "2026-02-01T00:00:00")],
+    ))
+    a.actor.notify()
+    await a.actor.wait_idle()
+    assert idx.refresh() == 3
+    await a.actor.stop()
+    await b.actor.stop()
+
+
 # --- the soak matrix (make chaos) ------------------------------------------
 
 

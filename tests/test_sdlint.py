@@ -1067,6 +1067,38 @@ def test_sd013_silent_outside_scope_and_in_autotune_itself(tmp_path):
     ) == []
 
 
+def test_sd013_covers_semantic_search_modules(tmp_path):
+    # ISSUE 16: the embed forward + vector-index scoring size through
+    # PipelinePolicy("embed") — a local EMBED_DEVICE_BATCH re-opens the
+    # pre-autotuner world exactly like a thumbnail one would
+    findings = run_scoped(
+        tmp_path,
+        "spacedrive_tpu/ops/embed_jax.py",
+        "EMBED_DEVICE_BATCH = 64\n",
+        ["SD013"],
+    )
+    assert len(findings) == 1
+    assert rules_of(findings) == ["SD013"]
+    findings = run_scoped(
+        tmp_path,
+        "spacedrive_tpu/object/search/index.py",
+        "SCORE_CHUNK_ROWS = 4096\n",
+        ["SD013"],
+    )
+    assert len(findings) == 1
+    # derived-from-policy stays the sanctioned idiom here too
+    assert run_scoped(
+        tmp_path,
+        "spacedrive_tpu/ops/embed_jax.py",
+        """
+        from ..parallel.autotune import EMBED_DEVICE_BATCH
+
+        DEVICE_BATCH = EMBED_DEVICE_BATCH
+        """,
+        ["SD013"],
+    ) == []
+
+
 # --- SD014 p2p-unguarded-request -------------------------------------------
 
 
@@ -2254,3 +2286,35 @@ def test_sd022_silent_on_plain_payloads_and_foreign_submits(tmp_path):
         ["SD022"],
     )
     assert findings == []
+
+
+def test_sd022_covers_embed_decode_leg(tmp_path):
+    # ISSUE 16: the embed stage ships decode work to the pool exactly
+    # like identify/thumb — the same purity bar applies to its payload
+    findings = run_on(
+        tmp_path,
+        """
+        from spacedrive_tpu.parallel import procpool as _procpool
+
+        def decode(self, paths):
+            pool = _procpool.get()
+            pool.request("embed.decode",
+                         {"paths": paths, "lib": self.library})
+        """,
+        ["SD022"],
+    )
+    assert len(findings) == 1
+    assert "library" in findings[0].message
+    # the real leg's plain payload ({"paths": [...]}) stays silent
+    assert run_on(
+        tmp_path,
+        """
+        from spacedrive_tpu.parallel import procpool as _procpool
+
+        def decode(paths):
+            pool = _procpool.get()
+            pool.request("embed.decode", {"paths": list(paths)},
+                         rows=len(paths))
+        """,
+        ["SD022"],
+    ) == []

@@ -1,0 +1,428 @@
+"""Semantic search subsystem (ISSUE 16): JAX-native embedding stage →
+CRDT-synced vector index → `search.semantic` plane, end to end.
+
+Coverage map:
+- tri-path parity: the sharded embedding pass is bit-identical to the
+  single-device and host paths (PR 4's discipline, on the conftest
+  8-device virtual CPU mesh);
+- pipeline stage: per-image `object_embedding` rows + their CRDT ops,
+  journal-vouched warm passes that embed ZERO unchanged bytes, and the
+  1%-mutation contract (one invalidation per changed file — the PR 7
+  warm-pass mirror);
+- `SD_EMBED=0`: a true no-op, golden-identical to the embedding-free
+  pipeline;
+- query plane: `search.semantic` (probe-image + label-centroid
+  resolution), the `GET /search` route, and the serve-cache tags;
+- replication: index a corpus on node A, replicate over the loopback
+  duplex, and node B answers with the planted near-duplicate rank-1
+  from an index maintained purely by the ingest `on_applied` hook.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.telemetry import counter_value
+
+# --- corpus helpers --------------------------------------------------------
+
+
+def _gradient_image(rng, size=48):
+    """Smooth random sinusoid field — photo-like structure, so a q40
+    JPEG re-encode stays a clear nearest neighbour."""
+    yy, xx = np.mgrid[0:size, 0:size] / float(size)
+    a, b, c = rng.uniform(-3, 3, 3)
+    img = np.stack(
+        [np.sin(a * xx + b * yy + c + k) * 0.5 + 0.5 for k in range(3)],
+        axis=-1,
+    )
+    return (img * 255).astype(np.uint8)
+
+
+def _image_corpus(root: str, n: int = 12, seed: int = 0,
+                  dup_of: int = 3) -> tuple[str, str]:
+    """n structured PNGs + a planted near-duplicate (q40 JPEG re-encode
+    of img<dup_of>). Returns (source path, duplicate path)."""
+    from PIL import Image
+
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        Image.fromarray(_gradient_image(rng)).save(
+            os.path.join(root, f"img{i:02d}.png")
+        )
+    src = os.path.join(root, f"img{dup_of:02d}.png")
+    dup = os.path.join(root, "dup.jpg")
+    Image.open(src).save(dup, quality=40)
+    return src, dup
+
+
+# --- pipeline harness (the test_e2e_index stub-node pattern) ---------------
+
+
+async def _scan_chain(library, mgr, loc_path: str):
+    """location create → indexer → identifier → media processor; waits
+    for all three chained jobs of THIS scan to settle."""
+    from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+
+    loc = library.db.find_one("location", path=loc_path)
+    if loc is None:
+        loc = LocationCreateArgs(path=loc_path).create(library)
+    before = library.db.count("job")
+    job_id = await scan_location(library, loc, mgr, backend="cpu")
+    await mgr.wait(job_id)
+    for _ in range(100):
+        await mgr.wait_idle()
+        rows = library.db.query("SELECT status FROM job")
+        if len(rows) >= before + 3 and all(
+            r["status"] in (2, 6) for r in rows
+        ):
+            break
+        await asyncio.sleep(0.05)
+    return loc
+
+
+async def _stub_pipeline(tmp_path, corpus: str):
+    """(node, library, mgr) over a minimal stub node — no p2p, no
+    labeler, real thumbnailer + media pipeline."""
+    from spacedrive_tpu.jobs import JobManager
+    from spacedrive_tpu.node import Libraries
+    from spacedrive_tpu.object.media.thumbnail import Thumbnailer
+    from spacedrive_tpu.tasks import TaskSystem
+
+    class _Node:
+        pass
+
+    node = _Node()
+    node.thumbnailer = Thumbnailer(str(tmp_path / "data"))
+    node.image_labeler = None
+    libs = Libraries(str(tmp_path / "data"), node=node)
+    library = libs.create("semantic")
+    mgr = JobManager(TaskSystem(2))
+    return node, library, mgr
+
+
+def _embedding_count(library) -> int:
+    return library.db.query_one(
+        "SELECT COUNT(*) AS n FROM object_embedding"
+    )["n"]
+
+
+def _name_of_object(library, object_id: int) -> str:
+    row = library.db.query_one(
+        "SELECT name, extension FROM file_path WHERE object_id = ? "
+        "ORDER BY id LIMIT 1",
+        (object_id,),
+    )
+    return f"{row['name']}.{row['extension']}" if row else "?"
+
+
+# --- tri-path parity -------------------------------------------------------
+
+
+def test_embed_tri_path_parity():
+    """Sharded (8-device), single-device, and default-ladder outputs are
+    bit-identical — including a ragged batch that forces pad rows."""
+    import jax
+
+    from spacedrive_tpu.ops import embed_jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest must force 8 virtual devices"
+    rng = np.random.default_rng(42)
+    for n in (9, 16):  # ragged (pads to 16) and exact power of two
+        imgs = rng.random((n, 32, 32, 3)).astype(np.float32)
+        sharded = embed_jax.embed_batch(imgs, devices=devs)
+        single = embed_jax.embed_batch(imgs, devices=devs[:1])
+        ladder = embed_jax.embed_batch(imgs)
+        assert sharded.shape == (n, 128) and sharded.dtype == np.float32
+        assert np.array_equal(sharded, single)
+        assert np.array_equal(sharded, ladder)
+    # empty batch: defined, empty, right shape
+    assert embed_jax.embed_batch(
+        np.zeros((0, 32, 32, 3), np.float32)
+    ).shape == (0, 128)
+
+
+def test_embed_blob_roundtrip_and_strict_decode():
+    from spacedrive_tpu.models import embedder
+
+    vec = np.arange(128, dtype=np.float32) / 128.0
+    back = embedder.blob_to_vector(embedder.vector_to_blob(vec))
+    assert np.array_equal(back, vec)
+    # corrupt shapes/values decode to None — the poison-containment seam
+    assert embedder.blob_to_vector(b"short") is None
+    assert embedder.blob_to_vector(b"\x00" * 64) is None
+    assert embedder.blob_to_vector(
+        np.full(128, np.nan, "<f4").tobytes()
+    ) is None
+    assert embedder.blob_to_vector(None) is None
+
+
+# --- pipeline stage + warm passes + query plane ----------------------------
+
+
+async def test_pipeline_embeds_searches_and_warm_skips(tmp_path):
+    from spacedrive_tpu.api.router import RspcError
+    from spacedrive_tpu.api.search import search_semantic
+    from spacedrive_tpu.object.search import index as search_index
+
+    corpus = str(tmp_path / "corpus")
+    src, dup = _image_corpus(corpus, n=12)
+    node, library, mgr = await _stub_pipeline(tmp_path, corpus)
+    try:
+        await _scan_chain(library, mgr, corpus)
+
+        # one vector per image (12 + the planted dup), replicated ops:
+        # shared_create = 1 create + 4 field updates per row
+        assert _embedding_count(library) == 13
+        n_ops = library.db.query_one(
+            "SELECT COUNT(*) AS n FROM crdt_operation "
+            "WHERE model = 'object_embedding'"
+        )["n"]
+        assert n_ops == 13 * 5
+
+        # probe-image query: rank-1 self, rank-2 the planted near-dup
+        out = search_semantic(library, {"query": src, "take": 3})
+        assert out["resolved"] is True
+        names = [
+            n["name"] + "." + n["extension"] for n in out["nodes"]
+        ]
+        assert names[0] == "img03.png"
+        assert names[1] == "dup.jpg"
+        assert all(s <= 1.0001 for s in out["scores"].values())
+
+        # label-centroid resolution: label two objects, probe by name
+        img0 = library.db.find_one("file_path", name="img00")
+        img1 = library.db.find_one("file_path", name="img01")
+        lid = library.db.insert("label", name="skyline")
+        for fp in (img0, img1):
+            library.db.insert(
+                "label_on_object", label_id=lid, object_id=fp["object_id"]
+            )
+        probe = search_index.probe_for(library, "skyline")
+        assert probe is not None and probe.shape == (128,)
+        hits = search_index.query(library, probe, k=2)
+        assert {h[0] for h in hits} == {img0["object_id"], img1["object_id"]}
+
+        # unresolvable query: clean empty result, not an error
+        out = search_semantic(library, {"query": "no-such-label"})
+        assert out == {"items": [], "nodes": [], "scores": {},
+                       "resolved": False}
+        with pytest.raises(RspcError):
+            search_semantic(library, {"query": ""})
+
+        # warm pass: every unchanged byte journal-vouched, ZERO embeds
+        emb0 = counter_value("sd_embed_files_total", result="embedded")
+        skip0 = counter_value("sd_embed_files_total", result="skipped")
+        await _scan_chain(library, mgr, corpus)
+        assert counter_value("sd_embed_files_total",
+                             result="embedded") == emb0
+        assert counter_value("sd_embed_files_total",
+                             result="skipped") == skip0 + 13
+        assert _embedding_count(library) == 13
+    finally:
+        await node.thumbnailer.shutdown()
+
+
+async def test_warm_pass_one_percent_mutation(tmp_path):
+    """The PR 7 warm-pass contract, mirrored onto embeddings: mutate 1%
+    of a 100-image corpus; the warm pass embeds ONLY the dirty file and
+    the journal counts exactly one invalidation."""
+    from PIL import Image
+
+    corpus = str(tmp_path / "corpus")
+    _image_corpus(corpus, n=99)  # 99 + dup.jpg = 100 image files
+    node, library, mgr = await _stub_pipeline(tmp_path, corpus)
+    try:
+        await _scan_chain(library, mgr, corpus)
+        assert _embedding_count(library) == 100
+
+        # mutate ONE file (1% of the corpus) with new content
+        target = os.path.join(corpus, "img50.png")
+        rng = np.random.default_rng(999)
+        Image.fromarray(_gradient_image(rng)).save(target)
+        os.utime(target)  # ensure a stat-identity change even on
+        # filesystems with coarse mtime granularity
+
+        emb0 = counter_value("sd_embed_files_total", result="embedded")
+        skip0 = counter_value("sd_embed_files_total", result="skipped")
+        inv0 = counter_value("sd_index_journal_ops_total",
+                             result="invalidated")
+        await _scan_chain(library, mgr, corpus)
+        assert counter_value("sd_embed_files_total",
+                             result="embedded") == emb0 + 1
+        assert counter_value("sd_embed_files_total",
+                             result="skipped") == skip0 + 99
+        assert counter_value("sd_index_journal_ops_total",
+                             result="invalidated") == inv0 + 1
+        # every live object has exactly one embedding (the mutated
+        # file's NEW object included; its orphaned predecessor keeps
+        # its row, which is the object-graph's concern, not ours)
+        live = library.db.query_one(
+            "SELECT COUNT(*) AS n FROM object_embedding oe "
+            "WHERE EXISTS (SELECT 1 FROM file_path fp "
+            "WHERE fp.object_id = oe.object_id)"
+        )["n"]
+        assert live == 100
+    finally:
+        await node.thumbnailer.shutdown()
+
+
+async def test_sd_embed_0_true_noop(tmp_path, monkeypatch):
+    """SD_EMBED=0 runs today's pipeline exactly: no embedding rows, no
+    sync ops, no metrics — and the rest of the pipeline output is
+    golden-identical to an enabled run over the same corpus."""
+    corpus = str(tmp_path / "corpus")
+    _image_corpus(corpus, n=6)
+
+    async def run(sub: str, enabled: bool):
+        if not enabled:
+            monkeypatch.setenv("SD_EMBED", "0")
+        else:
+            monkeypatch.delenv("SD_EMBED", raising=False)
+        node, library, mgr = await _stub_pipeline(tmp_path / sub, corpus)
+        try:
+            await _scan_chain(library, mgr, corpus)
+            files = {
+                (r["materialized_path"], r["name"], r["extension"],
+                 r["cas_id"]):
+                    library.db.query_one(
+                        "SELECT COUNT(*) AS n FROM media_data "
+                        "WHERE object_id = ?", (r["object_id"],)
+                    )["n"]
+                for r in library.db.query(
+                    "SELECT * FROM file_path WHERE is_dir = 0"
+                )
+            }
+            return library, files, _embedding_count(library)
+        finally:
+            await node.thumbnailer.shutdown()
+
+    emb0 = counter_value("sd_embed_files_total", result="embedded")
+    lib_off, files_off, n_off = await run("off", enabled=False)
+    assert n_off == 0
+    assert counter_value("sd_embed_files_total", result="embedded") == emb0
+    assert lib_off.db.query_one(
+        "SELECT COUNT(*) AS n FROM crdt_operation "
+        "WHERE model = 'object_embedding'"
+    )["n"] == 0
+
+    _lib_on, files_on, n_on = await run("on", enabled=True)
+    assert n_on == 7
+    # identical observable pipeline output either way
+    assert files_off == files_on
+
+
+# --- HTTP surface ----------------------------------------------------------
+
+
+async def test_get_search_route_and_rspc(tmp_path):
+    aiohttp = pytest.importorskip("aiohttp")
+
+    from spacedrive_tpu.location.locations import LocationCreateArgs, scan_location
+    from spacedrive_tpu.node import Node
+
+    corpus = str(tmp_path / "corpus")
+    src, _dup = _image_corpus(corpus, n=6)
+    node = Node(os.path.join(tmp_path, "node"), use_device=False,
+                with_labeler=False)
+    node.config.config.p2p.enabled = False
+    await node.start()
+    try:
+        lib = await node.create_library("sem-api")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+        await scan_location(lib, loc, node.jobs)
+        await node.jobs.wait_idle()
+        port = await node.start_api()
+        base = f"http://127.0.0.1:{port}"
+        async with aiohttp.ClientSession() as http:
+            # missing params → 400, not a 500
+            async with http.get(f"{base}/search") as resp:
+                assert resp.status == 400
+            params = {"library_id": str(lib.id), "q": src, "take": "3"}
+            async with http.get(f"{base}/search", params=params) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["result"]["resolved"] is True
+                assert body["result"]["nodes"][0]["name"] == "img03"
+                first_state = resp.headers.get("X-SD-Cache")
+            # the route rides the serve byte-cache
+            async with http.get(f"{base}/search", params=params) as resp:
+                assert resp.status == 200
+                if first_state is not None:
+                    assert resp.headers.get("X-SD-Cache") in (
+                        "hit", "fresh", "miss", "stale"
+                    )
+            # same procedure over the rspc transport
+            async with http.post(
+                f"{base}/rspc/search.semantic",
+                json={"library_id": str(lib.id),
+                      "arg": {"query": src, "take": 3}},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["result"]["resolved"] is True
+    finally:
+        await node.shutdown()
+
+
+# --- two-node replication (the acceptance e2e) -----------------------------
+
+
+async def test_replicated_index_answers_semantic_search(tmp_path):
+    """Index on node A; B converges over the loopback duplex; B's index
+    — maintained purely by the ingest on_applied hook — answers the
+    probe query with the planted near-duplicate rank-1."""
+    from spacedrive_tpu.api.search import search_semantic
+    from spacedrive_tpu.object.search import index as search_index
+    from spacedrive_tpu.p2p.loopback import make_mesh_pair
+
+    corpus = str(tmp_path / "corpus")
+    src, _dup = _image_corpus(corpus, n=8)
+    a, b, lib_a, lib_b, _tasks = await make_mesh_pair(tmp_path)
+    try:
+        from spacedrive_tpu.location.locations import (
+            LocationCreateArgs,
+            scan_location,
+        )
+
+        loc = LocationCreateArgs(path=corpus).create(lib_a)
+        await scan_location(lib_a, loc, a.jobs)
+        await a.jobs.wait_idle()
+        n_a = _embedding_count(lib_a)
+        assert n_a == 9  # 8 + planted dup
+
+        # replica converges (ingest actor pulls + applies)
+        deadline = asyncio.get_running_loop().time() + 30.0
+        while asyncio.get_running_loop().time() < deadline:
+            if _embedding_count(lib_b) >= n_a:
+                break
+            actor = getattr(lib_b, "ingest", None)
+            if actor is not None:
+                actor.notify()
+            await asyncio.sleep(0.1)
+        assert _embedding_count(lib_b) == n_a
+
+        # B's index was folded by the on_applied hook — NOT by a query-
+        # time refresh. Give the hook's executor a beat, then look at
+        # the registry WITHOUT refreshing.
+        idx_b = search_index.get_index(lib_b)
+        for _ in range(100):
+            if len(idx_b) >= n_a:
+                break
+            await asyncio.sleep(0.05)
+        assert len(idx_b) == n_a
+
+        out = await asyncio.to_thread(
+            search_semantic, lib_b, {"query": src, "take": 2}
+        )
+        assert out["resolved"] is True
+        names = [n["name"] + "." + n["extension"] for n in out["nodes"]]
+        assert names[0] == "img03.png"   # rank-1: the probe's own image
+        assert names[1] == "dup.jpg"     # the planted near-duplicate
+    finally:
+        await a.shutdown()
+        await b.shutdown()

@@ -47,6 +47,14 @@ gap+gil_wait share shrinking) gate only on recordings taken with ≥2
 cores and ≥2 workers — a 1-core recording is an honest floor, not the
 design's scaling (the config_mesh precedent).
 
+BENCH_SEMANTIC leg: when ``BENCH_SEMANTIC.json`` exists (``make
+bench-semantic``), the semantic plane's correctness bars gate on every
+rig: the warm pass must embed ZERO files (the journal vouch), the
+planted near-duplicate must rank first among non-self hits, and the
+warm media pass must beat cold by the recorded floor. Query latencies
+ride the artifact ungated — absolute milliseconds on an unknown CI box
+measure the box, not the index.
+
 Usage:
     python tools/bench_compare.py [--dir .] [--threshold 0.15] [old new]
 Exit codes: 0 ok / nothing to compare, 1 regression, 2 bad invocation.
@@ -419,6 +427,60 @@ def check_procs(doc: dict[str, Any]) -> dict[str, Any]:
             "skipped": skipped}
 
 
+# bench_e2e config_semantic's absolute bars (mirrored there; this gate
+# re-derives the verdict from the recorded figures). All three bars are
+# correctness-shaped, so they gate on every rig: a warm pass that
+# embeds ANY unchanged file broke the journal vouch, a planted
+# near-duplicate that isn't the top non-self hit broke the
+# embed→index→score chain, and a warm media pass slower than the floor
+# means the skip path stopped skipping. Query latencies are recorded,
+# not gated — absolute milliseconds on an unknown rig measure the rig.
+SEMANTIC_WARM_SPEEDUP_MIN = 1.2
+
+
+def check_semantic(doc: dict[str, Any]) -> dict[str, Any]:
+    """Gate a BENCH_SEMANTIC document (same result shape as compare())."""
+    checked: list[dict[str, Any]] = []
+    regressions: list[dict[str, Any]] = []
+    skipped: list[str] = []
+
+    warm = doc.get("files_embedded_warm")
+    if not isinstance(warm, int) or isinstance(warm, bool):
+        skipped.append("semantic.warm_zero_embeds: count missing")
+    else:
+        rec = {"name": "semantic.files_embedded_warm", "old": 0,
+               "new": warm, "delta_pct": 0.0 if warm == 0 else -100.0}
+        checked.append(rec)
+        if warm != 0:
+            regressions.append(rec)
+
+    rank1 = doc.get("neardup_rank1")
+    if not isinstance(rank1, bool):
+        skipped.append("semantic.neardup_rank1: verdict missing")
+    else:
+        rec = {"name": "semantic.neardup_rank1", "old": 1,
+               "new": 1 if rank1 else 0,
+               "delta_pct": 0.0 if rank1 else -100.0}
+        checked.append(rec)
+        if not rank1:
+            regressions.append(rec)
+
+    speedup = doc.get("warm_media_speedup")
+    if not isinstance(speedup, (int, float)) or isinstance(speedup, bool):
+        skipped.append("semantic.warm_media_speedup: ratio missing")
+    else:
+        rec = {"name": "semantic.warm_media_speedup",
+               "old": SEMANTIC_WARM_SPEEDUP_MIN,
+               "new": round(float(speedup), 2),
+               "delta_pct": round(
+                   (float(speedup) - SEMANTIC_WARM_SPEEDUP_MIN) * 100, 2)}
+        checked.append(rec)
+        if speedup < SEMANTIC_WARM_SPEEDUP_MIN:
+            regressions.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "skipped": skipped}
+
+
 # --- telemetry-history leg (telemetry/history.py segment store) ------------
 
 #: history series gated as higher-is-better rates; idle (0) samples are
@@ -635,6 +697,19 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
             result = check_procs(pr_doc)
             render("BENCH_PROCS.json (absolute pool-vs-single bars)",
+                   result)
+            total_regressions += len(result["regressions"])
+        sm_path = os.path.join(args.dir, "BENCH_SEMANTIC.json")
+        if os.path.exists(sm_path):
+            try:
+                with open(sm_path) as f:
+                    sm_doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"bench-compare: cannot read BENCH_SEMANTIC JSON: {e}",
+                      file=sys.stderr)
+                return 2
+            result = check_semantic(sm_doc)
+            render("BENCH_SEMANTIC.json (absolute semantic-plane bars)",
                    result)
             total_regressions += len(result["regressions"])
         sv_path = os.path.join(args.dir, "BENCH_SERVE.json")

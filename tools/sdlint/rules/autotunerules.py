@@ -42,6 +42,9 @@ SCOPED_FRAGMENTS = (
     "object/file_identifier/",
     "object/media/thumbnail/actor.py",
     "parallel/feeder.py",
+    # the semantic-search device legs size through PipelinePolicy too
+    "ops/embed_jax.py",
+    "object/search/",
 )
 
 #: the policy module owns the real constants
