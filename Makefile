@@ -3,13 +3,21 @@
 
 PY ?= python
 
-.PHONY: lint test tier1 trace-smoke slo-smoke profile-smoke debug-bundle \
-	bench-devices bench-check bench-warm bench-autotune bench-mesh \
-	bench-procs bench-serve bench-semantic search-smoke chaos
+.PHONY: lint lint-changed test tier1 trace-smoke slo-smoke profile-smoke \
+	debug-bundle bench-devices bench-check bench-warm bench-autotune \
+	bench-mesh bench-procs bench-serve bench-semantic search-smoke chaos
 
-# set SDLINT_ANNOTATE=1 in CI for GitHub ::error annotations on the diff
+# set SDLINT_ANNOTATE=1 in CI for GitHub ::error annotations on the diff.
+# The selftest proves every rule still fires on its own fixture corpus
+# before the (cold, authoritative) whole-tree pass.
 lint:
+	$(PY) -m tools.sdlint --selftest
 	$(PY) -m tools.sdlint spacedrive_tpu --format=json
+
+# developer fast path: re-analyze only changed files + their dependency
+# closure (cache under .sdlint_cache/); CI and tier-1 stay on `lint`
+lint-changed:
+	$(PY) -m tools.sdlint spacedrive_tpu --changed
 
 test: tier1
 
@@ -108,9 +116,10 @@ bench-serve:
 # AND (when BENCH_E2E_prev.json exists) the previous → current
 # BENCH_E2E per-config rates incl. the warm-pass metrics; fail on a
 # >15% regression in any comparable throughput series (link-bound e2e
-# rates are excused on blocked/congested runs). Depends on `lint` so
-# perf gating and lint gating ride one CI target.
-bench-check: lint
+# rates are excused on blocked/congested runs). Rides the incremental
+# lint path so the repeated local bench loop doesn't pay a cold lint
+# every round; CI's `lint` target stays cold and authoritative.
+bench-check: lint-changed
 	$(PY) tools/bench_compare.py --dir .
 
 # observability smoke: boot a node, index, assert /metrics + /trace +

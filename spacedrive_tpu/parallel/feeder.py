@@ -139,8 +139,12 @@ class WindowPipeline(Generic[T]):
         except BaseException as e:
             if self._restart(key, e):
                 return
-            # restart budget spent: surfaced to the consumer on take()
-            self._error = e
+            # restart budget spent: surfaced to the consumer on take().
+            # Published under the condition BEFORE the sentinel is
+            # parked, so the consumer that pops the sentinel (under the
+            # same condition) always observes the error with it.
+            with self._cond:
+                self._error = e
             self._put(None)
 
     def _restart(self, key: Any, exc: BaseException) -> bool:
@@ -157,11 +161,16 @@ class WindowPipeline(Generic[T]):
         RESILIENCE_EVENTS.emit(
             "feeder_restart", error=str(exc)[:200],
         )
-        self._thread = threading.Thread(
+        replacement = threading.Thread(
             target=self._run, args=(key,), name="sd-window-pipeline",
             daemon=True,
         )
-        self._thread.start()
+        # the handle swap races close()'s join of the old thread: both
+        # sides go through the pipeline condition so close() always
+        # joins the replacement, never a corpse
+        with self._cond:
+            self._thread = replacement
+        replacement.start()
         return True
 
     def _depth_now(self) -> int:
@@ -205,8 +214,10 @@ class WindowPipeline(Generic[T]):
         an extra take() (steps outnumbering windows, e.g. the orphan set
         shrank mid-run) would spin forever."""
         if self._done:
-            if self._error is not None:
-                raise self._error
+            with self._cond:
+                err = self._error
+            if err is not None:
+                raise err
             return None
         t0 = time.perf_counter()
         with _span("feeder.wait"):
@@ -219,6 +230,9 @@ class WindowPipeline(Generic[T]):
                 else:  # closed: wake immediately, no sentinel needed
                     window = None
                 inflight = len(self._buf)
+                # producer publishes _error under this condition before
+                # parking the sentinel — capture it under the same lock
+                err = self._error
         waited = time.perf_counter() - t0
         hit = waited < 0.002
         with self.stats._lock:
@@ -231,8 +245,8 @@ class WindowPipeline(Generic[T]):
         _tm.FEEDER_INFLIGHT.set(inflight)
         if window is None:
             self._done = True
-            if self._error is not None:
-                raise self._error
+            if err is not None:
+                raise err
         return window
 
     def close(self) -> None:
@@ -241,4 +255,7 @@ class WindowPipeline(Generic[T]):
             # one notify wakes BOTH sides instantly: a producer blocked
             # on a full buffer and a consumer blocked on an empty one
             self._cond.notify_all()
-        self._thread.join(timeout=5)
+            # snapshot under the condition: _restart() swaps the handle
+            # under the same lock, so this is the live producer
+            producer = self._thread
+        producer.join(timeout=5)

@@ -245,7 +245,10 @@ class ProcPool:
         _tm.PROCPOOL_WORKERS.set(0)
 
     def running(self) -> bool:
-        return self._running
+        # start()/stop() flip this under _lock from the loop; readers
+        # include the watchdog thread — read under the same lock
+        with self._lock:
+            return self._running
 
     def worker_count(self) -> int:
         with self._lock:

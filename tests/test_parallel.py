@@ -257,3 +257,49 @@ def test_window_pipeline_take_after_exhaustion_returns_none_fast():
         with pytest.raises(RuntimeError, match="boom"):
             pipe.take()
     pipe.close()
+
+
+def test_window_pipeline_error_raised_on_every_take_after_crash():
+    """Regression (ISSUE 17, SD023): the producer publishes _error
+    under the condition before parking the sentinel, and take() reads
+    it under the same condition — both the sentinel-pop path and the
+    post-done latch path must surface the error, every time."""
+    from spacedrive_tpu.parallel import WindowPipeline
+
+    def bad_fetch(k):
+        raise RuntimeError("flaky volume")
+
+    # the built-in restart budget (1) is spent by the second crash
+    pipe = WindowPipeline(bad_fetch, 0, depth=2)
+    for _ in range(3):  # the latch path must keep raising too
+        with pytest.raises(RuntimeError, match="flaky volume"):
+            pipe.take()
+    pipe.close()
+
+
+def test_window_pipeline_close_joins_restarted_producer():
+    """Regression (ISSUE 17, SD023): _restart() swaps the thread
+    handle from inside the dying producer while close() joins it —
+    the swap and the join now synchronize on the pipeline condition,
+    so close() must join the REPLACEMENT thread, not the corpse."""
+    from spacedrive_tpu.parallel import WindowPipeline
+
+    crashed = threading.Event()
+
+    def fetch(k):
+        if k == 1 and not crashed.is_set():
+            crashed.set()
+            raise RuntimeError("one-shot crash")
+        if k >= 3:
+            return None
+        return k + 1, k
+
+    pipe = WindowPipeline(fetch, 0, depth=1)
+    first = pipe._thread
+    got = []
+    while (w := pipe.take()) is not None:
+        got.append(w)
+    assert got == [0, 1, 2]  # restart resumed at the failed cursor
+    assert pipe._thread is not first, "restart never swapped the handle"
+    pipe.close()
+    assert not pipe._thread.is_alive()

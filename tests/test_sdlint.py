@@ -1303,6 +1303,65 @@ def test_sd015_out_of_scope_modules_ignored(tmp_path):
     assert findings == []
 
 
+# --- SARIF export ----------------------------------------------------------
+
+
+def test_sarif_round_trip_preserves_every_finding_field(tmp_path):
+    """to_sarif -> from_sarif must reconstruct the findings exactly —
+    including the ordinal a duplicate snippet carries — so nothing the
+    baseline or a diff tool needs gets dropped from the log."""
+    from tools.sdlint.sarif import from_sarif, to_sarif
+
+    findings = run_on(
+        tmp_path,
+        """
+        import time
+
+        async def one():
+            time.sleep(1)
+
+        async def two():
+            time.sleep(1)
+        """,
+        ["SD001"],
+    )
+    assert len(findings) == 2 and findings[1].ordinal == 1
+    entries = {findings[0].key: "grandfathered fixture entry"}
+    doc = to_sarif([findings[1]], [findings[0]], entries)
+    # the document must survive JSON serialization (what the CLI emits)
+    doc = json.loads(json.dumps(doc))
+
+    unbaselined, suppressed = from_sarif(doc)
+    assert unbaselined == [findings[1]]
+    assert suppressed == [findings[0]]
+    result = doc["runs"][0]["results"][1]
+    assert result["suppressions"][0]["justification"] == (
+        "grandfathered fixture entry"
+    )
+    # the catalog rides along: every registered rule, indexed
+    rules = doc["runs"][0]["tool"]["driver"]["rules"]
+    assert [r["id"] for r in rules] == sorted(
+        r["id"] for r in rules
+    ) and len(rules) >= 26
+    assert result["ruleId"] == rules[result["ruleIndex"]]["id"]
+
+
+def test_sarif_cli_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    proc = _run_cli(str(bad), "--no-baseline", "--format=sarif")
+    assert proc.returncode == 1  # exit semantics unchanged by format
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert len(results) == 1 and results[0]["ruleId"] == "SD001"
+    assert not results[0].get("suppressions")
+    region = results[0]["locations"][0]["physicalLocation"]["region"]
+    assert region["startLine"] == 3 and region["startColumn"] >= 1
+    assert results[0]["partialFingerprints"]["sdlintKey/v1"].startswith(
+        "SD001:")
+
+
 # --- the gate (same entry point as `make lint` / CI) -----------------------
 
 
@@ -2318,3 +2377,322 @@ def test_sd022_covers_embed_decode_leg(tmp_path):
         """,
         ["SD022"],
     ) == []
+
+
+# --- SD023 cross-context-race ----------------------------------------------
+
+
+def test_sd023_flags_history_tail_deque_race(tmp_path):
+    """The PR 12 bug class: the sampler thread appends to a deque that
+    the loop snapshots with no common lock — the exact history-tail
+    race the rule exists to catch."""
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+        from collections import deque
+
+        class Sampler:
+            def __init__(self):
+                self._hist = deque(maxlen=512)
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(
+                    target=self._run, name="sd-profiler-1", daemon=True,
+                )
+                self._thread.start()
+
+            def _run(self):
+                while True:
+                    self._hist.append(1)
+
+        SAMPLER = Sampler()
+
+        async def snapshot():
+            return list(SAMPLER._hist)
+        """,
+        ["SD023"],
+    )
+    assert rules_of(findings) == ["SD023"]
+    msgs = " ".join(f.message for f in findings)
+    assert "_hist" in msgs and "sampler" in msgs and "loop" in msgs
+
+
+def test_sd023_silent_on_sanctioned_seams(tmp_path):
+    """Queue hand-off, a common lock, contextvars, and the process
+    boundary are the sanctioned ways across contexts — none may fire."""
+    findings = run_on(
+        tmp_path,
+        """
+        import contextvars
+        import queue
+        import threading
+
+        # seam 1: queue hand-off
+        class Pump:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self._q.put(1)
+
+        PUMP = Pump()
+
+        async def drain():
+            return PUMP._q.get()
+
+        # seam 2: one lock guards both sides
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self._lock:
+                    self._items["x"] = 1
+
+            def snapshot(self):
+                with self._lock:
+                    return dict(self._items)
+
+        REG = Registry()
+
+        async def read_items():
+            return REG.snapshot()
+
+        # seam 3: contextvars
+        _current = contextvars.ContextVar("cur")
+
+        def set_worker():
+            _current.set("worker")
+
+        def spawn_tracer():
+            threading.Thread(target=set_worker, daemon=True).start()
+
+        async def who():
+            return _current.get()
+
+        # seam 4: the process boundary (msgpack'd payloads, no shared
+        # address space) — a STAGES handler writing a worker-local
+        # global does not race loop-side readers of the host's copy
+        _CACHE = {}
+
+        def match(payload):
+            _CACHE[payload["k"]] = payload
+            return payload
+
+        STAGES = {"journal.match": match}
+
+        async def peek(k):
+            return _CACHE.get(k)
+        """,
+        ["SD023"],
+    )
+    assert findings == []
+
+
+def test_sd023_init_and_single_context_state_silent(tmp_path):
+    """Pre-publication writes in __init__ and state only ever touched
+    from one context must not pair."""
+    findings = run_on(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.tally = 0  # pre-publication write
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self.tally += 1  # only the helper thread ever touches it
+
+        W = Worker()
+        """,
+        ["SD023"],
+    )
+    assert findings == []
+
+
+# --- SD024 loop-affinity-violation ------------------------------------------
+
+
+def test_sd024_flags_loop_calls_from_thread(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio
+        import threading
+
+        class Notifier:
+            def __init__(self, loop):
+                self.loop = loop
+
+            def start(self):
+                threading.Thread(target=self._watch, daemon=True).start()
+
+            def _watch(self):
+                self.loop.call_soon(print)
+                asyncio.create_task(noop())
+
+        async def noop():
+            pass
+        """,
+        ["SD024"],
+    )
+    assert len(findings) == 2
+    assert all("thread" in f.message for f in findings)
+    assert "call_soon_threadsafe" in findings[0].message
+
+
+def test_sd024_silent_on_threadsafe_entry_points_and_loop_context(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import asyncio
+        import threading
+
+        class Notifier:
+            def __init__(self, loop):
+                self.loop = loop
+
+            def start(self):
+                threading.Thread(target=self._watch, daemon=True).start()
+
+            def _watch(self):
+                # the threadsafe entry points exist for exactly this
+                self.loop.call_soon_threadsafe(print)
+                asyncio.run_coroutine_threadsafe(noop(), self.loop)
+
+        async def noop():
+            # loop context may drive the loop machinery freely
+            asyncio.get_event_loop().call_soon(print)
+
+        async def kick():
+            t = asyncio.create_task(noop())
+            await t
+        """,
+        ["SD024"],
+    )
+    assert findings == []
+
+
+# --- SD025 post-submit-aliasing ---------------------------------------------
+
+
+def test_sd025_flags_mutation_after_pool_submit_and_queue_put(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        from spacedrive_tpu.parallel import procpool as _procpool
+
+        def ship(rows, q):
+            payload = {"rows": rows}
+            pool = _procpool.get()
+            pool.submit("identify.hash", payload, rows=len(rows))
+            payload["rows"] = []          # races the worker's view
+
+            batch = [1, 2]
+            q.put(batch)
+            batch.append(3)               # races the consumer's view
+        """,
+        ["SD025"],
+    )
+    assert len(findings) == 2
+    assert "payload" in findings[0].message
+    assert "batch" in findings[1].message
+
+
+def test_sd025_silent_on_rebind_and_pre_submit_mutation(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        from spacedrive_tpu.parallel import procpool as _procpool
+
+        def ship(rows, q):
+            payload = {"rows": rows}
+            payload["extra"] = 1          # before the hand-off: fine
+            pool = _procpool.get()
+            pool.submit("identify.hash", payload, rows=len(rows))
+            payload = {"rows": []}        # rebind severs the alias
+            payload["rows"] = rows
+
+            batch = [1, 2]
+            q.put(list(batch))            # defensive copy shipped
+            batch.append(3)
+        """,
+        ["SD025"],
+    )
+    assert findings == []
+
+
+# --- SD026 hot-thread-blocking ----------------------------------------------
+
+
+def test_sd026_flags_unbounded_blocking_on_hot_threads(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import subprocess
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._evt = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._run, name="sd-window-pipeline",
+                    daemon=True,
+                )
+
+            def _run(self):
+                self._evt.wait()
+                subprocess.run(["sync"])
+        """,
+        ["SD026"],
+    )
+    assert len(findings) == 2
+    assert "feeder" in findings[0].message
+    assert "starves the device" in findings[0].message
+
+
+def test_sd026_silent_on_bounded_waits_and_cold_threads(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        import subprocess
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._evt = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._run, name="sd-window-pipeline",
+                    daemon=True,
+                )
+
+            def _run(self):
+                self._evt.wait(0.5)
+                subprocess.run(["sync"], timeout=5)
+
+        class Background:
+            def start(self):
+                threading.Thread(target=self._run, name="helper",
+                                 daemon=True).start()
+
+            def _run(self):
+                # a plain helper thread may block; only the sampler and
+                # feeder hot loops are cadence-critical
+                threading.Event().wait()
+        """,
+        ["SD026"],
+    )
+    assert findings == []

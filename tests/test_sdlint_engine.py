@@ -506,3 +506,345 @@ def test_cfg_module_body_and_class_body_build():
     cfg = build_cfg(tree)
     lines = {n.line for n in cfg.nodes if n.kind == "stmt"}
     assert {2, 4, 5, 7, 10} <= lines  # incl. the class-body assignment
+
+
+# --- execution-context inference --------------------------------------------
+
+
+def _ctxs(project, path, qual):
+    from tools.sdlint.contexts import ContextMap
+
+    return set(ContextMap.of(project).contexts_of(path, qual))
+
+
+def test_context_seeding_at_each_spawn_seam(tmp_path):
+    project = _project(tmp_path, {
+        "m.py": """
+        import asyncio
+        import multiprocessing
+        import threading
+
+        async def on_loop():
+            await asyncio.to_thread(helper)
+            loop = asyncio.get_event_loop()
+            loop.run_in_executor(None, exec_helper)
+            loop.call_soon(cb)
+            loop.call_later(1.0, later_cb)
+
+        def helper(): pass
+        def exec_helper(): pass
+        def cb(): pass
+        def later_cb(): pass
+
+        def sampler_loop(): pass
+        def feeder_loop(): pass
+        def plain_loop(): pass
+        def worker_main(): pass
+        def stage_handler(payload): return payload
+
+        def spawn():
+            threading.Thread(
+                target=sampler_loop, name="sd-profiler-7").start()
+            threading.Thread(
+                target=feeder_loop, name="sd-window-pipeline").start()
+            threading.Thread(target=plain_loop).start()
+            multiprocessing.Process(target=worker_main).start()
+
+        STAGES = {"stage.x": stage_handler}
+        """,
+    })
+    assert _ctxs(project, "m.py", "on_loop") == {"loop"}
+    assert _ctxs(project, "m.py", "helper") == {"thread"}
+    assert _ctxs(project, "m.py", "exec_helper") == {"thread"}
+    assert _ctxs(project, "m.py", "cb") == {"loop"}
+    assert _ctxs(project, "m.py", "later_cb") == {"loop"}
+    assert _ctxs(project, "m.py", "sampler_loop") == {"sampler"}
+    assert _ctxs(project, "m.py", "feeder_loop") == {"feeder"}
+    assert _ctxs(project, "m.py", "plain_loop") == {"thread"}
+    assert _ctxs(project, "m.py", "worker_main") == {"proc"}
+    assert _ctxs(project, "m.py", "stage_handler") == {"proc"}
+    # no seam reaches spawn itself: unknown, not safe
+    assert _ctxs(project, "m.py", "spawn") == set()
+
+
+def test_context_propagation_multi_context_and_cycle_termination(tmp_path):
+    project = _project(tmp_path, {
+        "m.py": """
+        import threading
+
+        def shared():
+            ping()
+
+        def ping():
+            pong()
+
+        def pong():
+            ping()
+
+        async def from_loop():
+            shared()
+
+        def spawn():
+            threading.Thread(target=shared).start()
+        """,
+    })
+    # reached from both an async body and a thread target
+    assert _ctxs(project, "m.py", "shared") == {"loop", "thread"}
+    # the ping/pong cycle reaches the same fixpoint and terminates
+    assert _ctxs(project, "m.py", "ping") == {"loop", "thread"}
+    assert _ctxs(project, "m.py", "pong") == {"loop", "thread"}
+
+
+def test_context_does_not_flow_into_async_callees(tmp_path):
+    project = _project(tmp_path, {
+        "m.py": """
+        import threading
+
+        async def coro():
+            pass
+
+        def runner():
+            return coro()  # creates the coroutine, does not run it
+
+        def spawn():
+            threading.Thread(target=runner).start()
+        """,
+    })
+    assert _ctxs(project, "m.py", "runner") == {"thread"}
+    assert _ctxs(project, "m.py", "coro") == {"loop"}
+
+
+def test_context_seeds_resolve_instance_method_targets(tmp_path):
+    # the production idiom: Thread(target=self._run) on a singleton,
+    # and to_thread(self._pipeline.take) through a typed attribute
+    project = _project(tmp_path, {
+        "m.py": """
+        import asyncio
+        import threading
+
+        class Pipe:
+            def take(self):
+                pass
+
+        class Job:
+            def __init__(self):
+                self._pipeline = Pipe()
+
+            async def step(self):
+                await asyncio.to_thread(self._pipeline.take)
+
+        class Sampler:
+            def start(self):
+                threading.Thread(
+                    target=self._run, name="sd-profiler").start()
+
+            def _run(self):
+                pass
+
+        SAMPLER = Sampler()
+        """,
+    })
+    assert _ctxs(project, "m.py", "Pipe.take") == {"thread"}
+    assert _ctxs(project, "m.py", "Sampler._run") == {"sampler"}
+
+
+# --- shared-state effect summaries ------------------------------------------
+
+
+def _summary(project, path, qual):
+    from tools.sdlint.effects import effect_summaries
+
+    summary_of = effect_summaries(project)
+    graph = CallGraph.of(project)
+    info = graph.functions[(path, qual)]
+    return summary_of(graph.modules[path], info)
+
+
+def test_effects_attr_and_global_keying_with_guards(tmp_path):
+    project = _project(tmp_path, {
+        "m.py": """
+        import threading
+
+        COUNT = 0
+        TABLE = {}
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+                self.n = 0
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+                self.n = self.n + 1
+
+        def bump(k):
+            global COUNT
+            COUNT += 1
+            TABLE[k] = COUNT
+        """,
+    })
+    accs = _summary(project, "m.py", "Box.add")
+    by = {(a.key, a.kind): a for a in accs}
+    assert by[(("attr", "m.py::Box", "_items"), "write")].guards == frozenset(
+        {"m.py::Box._lock"}
+    )
+    assert by[(("attr", "m.py::Box", "n"), "write")].guards == frozenset()
+    assert (("attr", "m.py::Box", "n"), "read") in by
+    # the lock attribute itself is a synchronizer, never state
+    assert not any(a.key[2] == "_lock" for a in accs)
+    # __init__ accesses carry the pre-publication marker
+    init_accs = _summary(project, "m.py", "Box.__init__")
+    assert init_accs and all(a.init for a in init_accs)
+
+    kinds = {(a.key, a.kind) for a in _summary(project, "m.py", "bump")}
+    assert (("global", "m.py", "COUNT"), "write") in kinds
+    assert (("global", "m.py", "COUNT"), "read") in kinds
+    assert (("global", "m.py", "TABLE"), "write") in kinds
+
+
+def test_effects_compose_caller_locks_onto_callee_accesses(tmp_path):
+    project = _project(tmp_path, {
+        "m.py": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def _drain(self):
+                self._items.clear()
+
+            def flush(self):
+                with self._lock:
+                    self._drain()
+
+            def leak(self):
+                self._drain()
+        """,
+    })
+    flush = _summary(project, "m.py", "Box.flush")
+    w = next(a for a in flush if a.kind == "write")
+    assert w.key == ("attr", "m.py::Box", "_items")
+    assert "m.py::Box._lock" in w.guards
+    # the same callee access reached without the lock stays unguarded
+    leak = _summary(project, "m.py", "Box.leak")
+    w = next(a for a in leak if a.kind == "write")
+    assert w.guards == frozenset()
+
+
+def test_effects_typed_deep_store_keys_to_final_owner(tmp_path):
+    project = _project(tmp_path, {
+        "m.py": """
+        class Stats:
+            def __init__(self):
+                self.read_time = 0.0
+
+        class Pipe:
+            def __init__(self):
+                self.stats = Stats()
+
+            def tick(self, s):
+                self.stats.read_time += s
+
+            def opaque(self, other):
+                other.field = 1
+        """,
+    })
+    keys = {(a.key, a.kind) for a in _summary(project, "m.py", "Pipe.tick")}
+    # the store lands on the typed final owner, not the reference
+    assert (("attr", "m.py::Stats", "read_time"), "write") in keys
+    assert (("attr", "m.py::Pipe", "stats"), "read") in keys
+    assert (("attr", "m.py::Pipe", "stats"), "write") not in keys
+    # an untyped receiver records no phantom write
+    assert not any(
+        a.kind == "write"
+        for a in _summary(project, "m.py", "Pipe.opaque")
+    )
+
+
+def test_effects_safe_factories_are_not_state(tmp_path):
+    project = _project(tmp_path, {
+        "m.py": """
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._evt = threading.Event()
+
+            def feed(self, x):
+                self._q.put(x)
+                self._evt.set()
+        """,
+    })
+    assert _summary(project, "m.py", "Pump.feed") == frozenset()
+
+
+# --- instance resolver ------------------------------------------------------
+
+
+def test_instance_resolver_singletons_attrs_and_facade_reexports(tmp_path):
+    from tools.sdlint.summaries import InstanceResolver
+
+    project = _project(tmp_path, {
+        "pkg/__init__.py": """
+        from .impl import Engine, ENGINE
+        """,
+        "pkg/impl.py": """
+        class Engine:
+            def __init__(self):
+                pass
+
+            def start(self):
+                pass
+
+        ENGINE = Engine()
+        """,
+        "app.py": """
+        from pkg import ENGINE, Engine
+
+        class Holder:
+            def __init__(self):
+                self._eng = Engine()
+
+            def kick(self):
+                self._eng.start()
+
+        def poke():
+            ENGINE.start()
+
+        def local_use():
+            e = Engine()
+            e.start()
+
+        def construct():
+            return Engine()
+        """,
+    })
+    r = InstanceResolver.of(project)
+    actx = next(c for c in project.files if c.path == "app.py")
+
+    def resolved_of(qual):
+        info = next(i for i in actx.functions if i.qualname == qual)
+        return {
+            res[1].qualname
+            for _call, res in r.calls_in(actx, info)
+            if res is not None
+        }
+
+    # typed self-attr through the package facade re-export
+    assert "Engine.start" in resolved_of("Holder.kick")
+    # module singleton imported through the facade
+    assert "Engine.start" in resolved_of("poke")
+    # typed local
+    assert "Engine.start" in resolved_of("local_use")
+    # constructor call resolves to __init__
+    assert "Engine.__init__" in resolved_of("construct")
+    # the typing tables name the defining module, not the facade
+    assert r.attr_types[("app.py", "Holder", "_eng")] == (
+        "pkg/impl.py", "Engine",
+    )

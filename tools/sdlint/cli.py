@@ -31,7 +31,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("paths", nargs="*", help="files or directories to analyze")
     p.add_argument(
-        "--format", choices=("text", "json"), default="text", dest="fmt"
+        "--format", choices=("text", "json", "sarif"), default="text",
+        dest="fmt",
     )
     p.add_argument(
         "--baseline",
@@ -66,7 +67,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated rule ids to run (default: all)",
     )
     p.add_argument(
+        "--changed",
+        action="store_true",
+        help="incremental mode: re-analyze only files whose content "
+        "changed plus their dependency closure (cache under "
+        ".sdlint_cache/); the developer fast path — CI runs cold",
+    )
+    p.add_argument(
+        "--cache-dir",
+        default=None,
+        help="cache directory for --changed (default: .sdlint_cache)",
+    )
+    p.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    p.add_argument(
+        "--selftest",
+        action="store_true",
+        help="run every registered rule over its minimal positive "
+        "fixture (selftest.CORPUS) and fail if any rule no longer "
+        "fires — `make lint` runs this before the whole-tree pass",
     )
     return p
 
@@ -81,6 +101,11 @@ def main(argv: list[str] | None = None) -> int:
             r = RULES[rid]
             print(f"{rid}  {r.name}\n      {r.summary}")
         return 0
+
+    if args.selftest:
+        from .selftest import run_selftest
+
+        return run_selftest()
 
     if not args.paths:
         print("error: no paths given (try: python -m tools.sdlint "
@@ -98,7 +123,23 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
 
-    findings, errors = analyze_paths(args.paths, rule_ids)
+    cache_stats = None
+    if args.changed:
+        if args.prune_baseline or args.write_baseline:
+            # baseline hygiene needs an authoritative whole-tree
+            # analysis; a warm run's sub-project pass can under-report
+            # closure-scope findings, which would read as "stale" and
+            # prune (or drop from a rewrite) entries that still fire
+            print("error: --prune-baseline/--write-baseline require a "
+                  "cold run (drop --changed)", file=sys.stderr)
+            return 2
+        from .cache import CACHE_DIR, analyze_paths_cached
+
+        findings, errors, cache_stats = analyze_paths_cached(
+            args.paths, rule_ids, cache_dir=args.cache_dir or CACHE_DIR,
+        )
+    else:
+        findings, errors = analyze_paths(args.paths, rule_ids)
     if errors:
         for err in errors:
             print(f"error: {err}", file=sys.stderr)
@@ -164,6 +205,7 @@ def main(argv: list[str] | None = None) -> int:
                   f"the gate passes")
         return 0
 
+    baseline = None
     if args.no_baseline:
         unbaselined, suppressed, stale = findings, [], []
     else:
@@ -173,6 +215,18 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: {exc}", file=sys.stderr)
             return 2
         unbaselined, suppressed, stale = baseline.split(findings)
+
+    # Staleness ("this baseline entry no longer matches any finding") is
+    # only decidable on an authoritative whole-tree analysis. A warm
+    # incremental run analyzes a sub-project, and closure-scope rules
+    # can under-report there by design (their influence seeds may live
+    # outside the dirty closure — misses only, never inventions), so a
+    # baseline entry "missing" on a warm run is usually an artifact of
+    # the sub-analysis, not a fixed bug. Defer stale reporting to cold
+    # runs — CI's `make lint` (--prune-baseline/--write-baseline refuse
+    # --changed outright, above).
+    if cache_stats is not None and not cache_stats.cold:
+        stale = []
 
     if args.annotate or os.environ.get("SDLINT_ANNOTATE") == "1":
         for f in unbaselined:
@@ -185,7 +239,15 @@ def main(argv: list[str] | None = None) -> int:
                   f"col={f.col + 1},title=sdlint {f.rule}::{msg}",
                   file=sys.stderr)
 
-    if args.fmt == "json":
+    if args.fmt == "sarif":
+        from .sarif import to_sarif
+
+        doc = to_sarif(
+            unbaselined, suppressed,
+            baseline.entries if baseline is not None else {},
+        )
+        print(json.dumps(doc, indent=2))
+    elif args.fmt == "json":
         doc = {
             "findings": [f.to_dict() for f in unbaselined],
             "suppressed": [f.to_dict() for f in suppressed],
@@ -197,6 +259,13 @@ def main(argv: list[str] | None = None) -> int:
             },
             "ok": not unbaselined,
         }
+        if cache_stats is not None:
+            doc["incremental"] = {
+                "cold": cache_stats.cold,
+                "changed": cache_stats.changed,
+                "analyzed": len(cache_stats.analyzed),
+                "reused": cache_stats.reused,
+            }
         print(json.dumps(doc, indent=2))
     else:
         for f in unbaselined:
@@ -206,5 +275,7 @@ def main(argv: list[str] | None = None) -> int:
         n, s = len(unbaselined), len(suppressed)
         print(f"sdlint: {n} finding{'s' if n != 1 else ''}"
               f" ({s} baselined{', ' + str(len(stale)) + ' stale' if stale else ''})")
+        if cache_stats is not None:
+            print(f"sdlint: {cache_stats.describe()}")
 
     return 1 if unbaselined else 0

@@ -68,24 +68,47 @@ class Rule:
     summary: str
     check_file: Callable[["FileContext"], Iterable[Finding]] | None = None
     check_project: Callable[["ProjectContext"], Iterable[Finding]] | None = None
+    #: how far a single file edit can move this rule's verdicts — the
+    #: incremental cache (tools/sdlint/cache.py) keys its warm-run
+    #: strategy off this:
+    #:   "file"     verdict depends only on the file itself; cached
+    #:              per file, recomputed only when that file changes
+    #:   "closure"  influence travels call/import edges (context
+    #:              seeding, effect composition); recomputed over the
+    #:              changed files' dependency closure
+    #:   "tree"     verdict reads global coverage (a policy map, a docs
+    #:              catalog, the full caller set); recomputed over the
+    #:              whole project on every changed run
+    scope: str = "file"
 
 
 #: rule id -> Rule; populated by the ``@rule`` decorator at import time
 RULES: dict[str, Rule] = {}
 
 
-def rule(rule_id: str, name: str, summary: str, *, project: bool = False):
-    """Register a checker. ``project=True`` marks a whole-tree rule."""
+def rule(rule_id: str, name: str, summary: str, *, project: bool = False,
+         scope: str | None = None):
+    """Register a checker. ``project=True`` marks a whole-tree rule;
+    ``scope`` ("file" | "closure" | "tree") tells the incremental cache
+    how far one file edit can move the rule's verdicts (defaults:
+    file rules "file", project rules "tree" — the conservative choice)."""
 
     def wrap(fn):
         if rule_id in RULES:
             raise ValueError(f"duplicate rule id {rule_id}")
+        resolved = scope if scope is not None else (
+            "tree" if project else "file")
+        if resolved not in ("file", "closure", "tree"):
+            raise ValueError(f"bad scope {resolved!r} for {rule_id}")
+        if not project and resolved != "file":
+            raise ValueError(f"file rule {rule_id} must have scope='file'")
         RULES[rule_id] = Rule(
             id=rule_id,
             name=name,
             summary=summary,
             check_file=None if project else fn,
             check_project=fn if project else None,
+            scope=resolved,
         )
         return fn
 
@@ -361,29 +384,17 @@ def iter_python_files(path: Path) -> Iterator[Path]:
         yield sub
 
 
-def analyze_paths(
+def load_project(
     paths: Iterable[str | Path],
-    rule_ids: Iterable[str] | None = None,
-) -> tuple[list[Finding], list[str]]:
-    """Parse every .py under ``paths`` and run the selected rules.
+) -> tuple[ProjectContext, list[str]]:
+    """Parse every .py under ``paths`` into one :class:`ProjectContext`.
 
-    Returns ``(findings, errors)`` — errors are human-readable parse
+    Returns ``(project, errors)`` — errors are human-readable parse
     failures; the CLI treats any as fatal so a syntax error can't
     silently shrink coverage.
     """
-    # rule modules self-register on import; imported here (not at module
-    # top) to dodge the rules->core->rules import cycle
-    from . import rules as _rules  # noqa: F401
-
-    selected = [
-        RULES[rid]
-        for rid in sorted(RULES)
-        if rule_ids is None or rid in set(rule_ids)
-    ]
     project = ProjectContext()
-    findings: list[Finding] = []
     errors: list[str] = []
-
     for root in paths:
         root = Path(root)
         for file in iter_python_files(root):
@@ -395,7 +406,24 @@ def analyze_paths(
                 errors.append(f"{rel}: {exc}")
                 continue
             project.files.append(FileContext(rel, source, tree))
+    return project, errors
 
+
+def analyze_project(
+    project: ProjectContext,
+    rule_ids: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Run the selected rules over an already-parsed project."""
+    # rule modules self-register on import; imported here (not at module
+    # top) to dodge the rules->core->rules import cycle
+    from . import rules as _rules  # noqa: F401
+
+    selected = [
+        RULES[rid]
+        for rid in sorted(RULES)
+        if rule_ids is None or rid in set(rule_ids)
+    ]
+    findings: list[Finding] = []
     for ctx in project.files:
         for r in selected:
             if r.check_file is not None:
@@ -414,4 +442,13 @@ def analyze_paths(
             group[f.line] = len(group)
         if group[f.line]:
             findings[i] = replace(f, ordinal=group[f.line])
-    return findings, errors
+    return findings
+
+
+def analyze_paths(
+    paths: Iterable[str | Path],
+    rule_ids: Iterable[str] | None = None,
+) -> tuple[list[Finding], list[str]]:
+    """Parse every .py under ``paths`` and run the selected rules."""
+    project, errors = load_project(paths)
+    return analyze_project(project, rule_ids), errors

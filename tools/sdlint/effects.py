@@ -1,0 +1,448 @@
+"""Per-function shared-state effect summaries.
+
+For every function this module answers: *which shared state does it
+read or write, and under which locks?* Shared state is keyed two ways:
+
+- ``("attr", "<path>::<Class>", "<name>")`` — ``self.<name>`` on a
+  class (instance state reachable from any context holding the
+  object);
+- ``("global", "<path>", "<name>")`` — a module-level binding
+  (registries, caches, counters).
+
+Guards come from the same lock-held-region machinery SD002/SD004 use:
+a CFG forward dataflow (:func:`tools.sdlint.cfg.solve_forward`)
+replays ``with lock:`` blocks and manual ``acquire()``/``release()``
+protocols, so an access records the set of sync primitives held at its
+site. ``threading.Condition`` is a lock factory in
+:mod:`tools.sdlint.core`, so condition-guarded hand-offs compose for
+free.
+
+Summaries compose bottom-up over the project call graph
+(:meth:`~tools.sdlint.summaries.CallGraph.summarize`): a callee's
+accesses join the caller's summary with the caller's held-at-call-site
+locks added to their guards — ``with self._lock: self._drain()`` makes
+every access inside ``_drain`` lock-guarded from that path. Recursion
+returns the empty summary for the in-progress function (the SD004
+cycle discipline).
+
+What is deliberately *not* shared state (the sanctioned seams):
+
+- sync primitives themselves (the lock is the synchronizer);
+- attributes/globals built by safe hand-off factories —
+  ``queue.Queue`` and friends, ``threading.Event``,
+  ``contextvars.ContextVar``, ``asyncio.Queue`` — their whole purpose
+  is cross-context traffic;
+- accesses inside ``__init__``/``__post_init__`` are marked
+  ``init=True``: the object is pre-publication, rules must not pair
+  them as races.
+
+Deep receivers (``self.stats.read_time``) are typed through
+:class:`~tools.sdlint.summaries.InstanceResolver`: when every link of
+the receiver chain has a known class, the store keys to the *final*
+owner (``PipelineStats.read_time``) — mutating a field through a
+reference is not a write of the reference. An untyped link degrades
+the store to a read of the base attribute (conservatively quiet).
+Module-global writes require a ``global`` declaration or an in-place
+mutation (subscript store / mutator method).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, replace
+from typing import Callable
+
+from .core import (
+    FileContext,
+    FunctionInfo,
+    ProjectContext,
+    call_name,
+    dotted_name,
+    walk_shallow,
+)
+from .summaries import CallGraph, InstanceResolver
+
+READ = "read"
+WRITE = "write"
+
+#: hand-off primitives safe to touch from any context
+SAFE_FACTORIES = {
+    "threading.Event",
+    "asyncio.Event",
+    "queue.Queue",
+    "queue.SimpleQueue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "asyncio.Queue",
+    "contextvars.ContextVar",
+}
+
+#: method names that mutate their receiver in place
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "add", "update",
+    "clear", "pop", "popleft", "popitem", "remove", "discard",
+    "insert", "setdefault", "sort", "reverse", "rotate",
+}
+
+_INIT_NAMES = {"__init__", "__post_init__"}
+
+
+@dataclass(frozen=True)
+class Access:
+    """One shared-state touch at a concrete source site."""
+
+    key: tuple[str, str, str]
+    kind: str  # READ | WRITE
+    guards: frozenset[str]  # lock ids held at the site
+    path: str
+    line: int
+    col: int
+    init: bool = False  # inside __init__: object not yet published
+
+
+def _lock_id(ctx: FileContext, lock) -> str:
+    owner = lock.owner or "<module>"
+    return f"{ctx.path}::{owner}.{lock.attr}"
+
+
+def _name_root(expr: ast.AST) -> str | None:
+    """The ``g`` in ``g``, ``g[k]``, ``g[k].x`` — for module globals."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _receiver_chain(expr: ast.AST) -> tuple[str | None, list[str]]:
+    """Decompose the object an operation targets into ``(base, attrs)``
+    — base ``"self"`` or a bare name, attrs walked outward. Traversing
+    a subscript drops the attrs collected *outside* it: mutating
+    ``self.x[k].y`` mutates an element of the container ``x``, so the
+    container is the state that changed."""
+    chain: list[str] = []
+    cur = expr
+    while True:
+        if isinstance(cur, ast.Attribute):
+            chain.insert(0, cur.attr)
+            cur = cur.value
+        elif isinstance(cur, ast.Subscript):
+            chain = []
+            cur = cur.value
+        elif isinstance(cur, ast.Name):
+            return cur.id, chain
+        else:
+            return None, chain
+
+
+class FileEffects:
+    """Per-module machinery: shared-state classification + per-function
+    direct access extraction with held-lock guards."""
+
+    def __init__(self, ctx: FileContext, resolver: InstanceResolver | None = None):
+        self.ctx = ctx
+        self.resolver = resolver
+        # lock attributes are synchronizers, not shared state
+        self.lock_attrs: set[str] = {lk.attr for lk in ctx.sync_locks}
+        self.lock_attrs |= {a for _, a in (ctx._async_lock_attrs or set())}
+        self.safe_names: set[str] = set()  # attrs and globals alike
+        self.globals: set[str] = set()
+        self._classify()
+        self._cache: dict[str, tuple[tuple[Access, ...],
+                                     tuple[tuple[ast.Call, frozenset], ...]]] = {}
+
+    def _classify(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            targets: list[ast.AST] = []
+            value: ast.AST | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None or not isinstance(value, ast.Call):
+                continue
+            if call_name(value) not in SAFE_FACTORIES:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute):
+                    self.safe_names.add(tgt.attr)
+                elif isinstance(tgt, ast.Name):
+                    self.safe_names.add(tgt.id)
+        # module-level bindings (imports/defs/classes are not Assigns)
+        for stmt in self.ctx.tree.body:
+            tgts: list[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                tgts = stmt.targets
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                tgts = [stmt.target]
+            for tgt in tgts:
+                if isinstance(tgt, ast.Name):
+                    self.globals.add(tgt.id)
+                elif isinstance(tgt, ast.Tuple):
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            self.globals.add(el.id)
+
+    # -- held-lock replay (the SD004 region machinery, sans edges) ---------
+
+    def _held_states(self, info: FunctionInfo):
+        from .cfg import STMT, WITH_CLEANUP, WITH_EXIT, solve_forward
+        from .rules.flowrules import walk_shallow_stmt
+
+        ctx = self.ctx
+        cfg = ctx.cfg(info.node)
+
+        def transfer(node, state: frozenset) -> frozenset:
+            held = set(state)
+            a = node.ast
+            if node.kind in (WITH_EXIT, WITH_CLEANUP):
+                for item in a.items:
+                    lock = ctx.lock_for_expr(item.context_expr, at=a)
+                    if lock is not None:
+                        held.discard(_lock_id(ctx, lock))
+                return frozenset(held)
+            if a is None or node.kind != STMT:
+                return frozenset(held)
+            if isinstance(a, (ast.With, ast.AsyncWith)):
+                for item in a.items:
+                    lock = ctx.lock_for_expr(item.context_expr, at=a)
+                    if lock is not None:
+                        held.add(_lock_id(ctx, lock))
+            else:
+                for sub in walk_shallow_stmt(a):
+                    if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute
+                    ):
+                        if sub.func.attr == "acquire":
+                            lock = ctx.lock_for_expr(sub.func.value, at=sub)
+                            if lock is not None:
+                                held.add(_lock_id(ctx, lock))
+                        elif sub.func.attr == "release":
+                            lock = ctx.lock_for_expr(sub.func.value, at=sub)
+                            if lock is not None:
+                                held.discard(_lock_id(ctx, lock))
+            return frozenset(held)
+
+        return cfg, solve_forward(cfg, frozenset(), transfer)
+
+    # -- access extraction -------------------------------------------------
+
+    def analyze(
+        self, info: FunctionInfo
+    ) -> tuple[tuple[Access, ...], tuple[tuple[ast.Call, frozenset], ...]]:
+        """-> (direct accesses, resolvable-call sites with held locks).
+
+        The call list carries *every* call expression with the locks
+        held at its statement; the composition driver resolves them.
+        """
+        got = self._cache.get(info.qualname)
+        if got is not None:
+            return got
+        from .cfg import STMT
+        from .rules.flowrules import walk_shallow_stmt
+
+        ctx = self.ctx
+        owner = info.owner
+        init = info.node.name in _INIT_NAMES
+        # local bindings shadow module globals unless declared global
+        declared: set[str] = set()
+        local: set[str] = set()
+        args = info.node.args
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            local.add(a.arg)
+        for node in walk_shallow(info.node):
+            if isinstance(node, ast.Global):
+                declared.update(node.names)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                local.add(node.id)
+        local -= declared
+
+        accesses: list[Access] = []
+        calls: list[tuple[ast.Call, frozenset]] = []
+        seen: set[tuple] = set()
+
+        def attr_key(attr: str) -> tuple[str, str, str] | None:
+            if owner is None:
+                return None
+            if attr in self.lock_attrs or attr in self.safe_names:
+                return None
+            return ("attr", f"{ctx.path}::{owner}", attr)
+
+        def global_key(name: str) -> tuple[str, str, str] | None:
+            if name not in self.globals or name in local:
+                return None
+            if name in self.safe_names or name in self.lock_attrs:
+                return None
+            return ("global", ctx.path, name)
+
+        def record(key, kind, guards, node):
+            if key is None:
+                return
+            acc = Access(
+                key=key, kind=kind, guards=guards, path=ctx.path,
+                line=getattr(node, "lineno", info.node.lineno),
+                col=getattr(node, "col_offset", 0), init=init,
+            )
+            dedup = (key, kind, guards, acc.line)
+            if dedup not in seen:
+                seen.add(dedup)
+                accesses.append(acc)
+
+        resolver = self.resolver
+
+        def typed_chain_key(
+            base_cls: tuple[str, str], chain: list[str]
+        ) -> tuple[str, str, str] | None:
+            """Key for state named by an attr chain from a known class:
+            traverse ``chain[:-1]`` through ``attr_types``; the final
+            link is the mutated/read slot on the last typed owner.
+            None when any link is untyped."""
+            cls = base_cls
+            for name in chain[:-1]:
+                nxt = (
+                    resolver.attr_types.get((cls[0], cls[1], name))
+                    if resolver is not None else None
+                )
+                if nxt is None:
+                    return None
+                cls = nxt
+            slot = chain[-1]
+            if slot in self.lock_attrs or slot in self.safe_names:
+                return None
+            return ("attr", f"{cls[0]}::{cls[1]}", slot)
+
+        def record_mutation(base, chain, guards, node) -> None:
+            """Mutation of the state ``base.<chain>`` — direct slot
+            store (chain length 1 on self), in-place global mutation
+            (name base, empty chain), or a typed deep store. The
+            traversal reads of intermediate references fall out of the
+            Load passes below."""
+            if base == "self":
+                if owner is not None and chain:
+                    record(
+                        typed_chain_key((ctx.path, owner), chain),
+                        WRITE, guards, node,
+                    )
+            elif base is not None:
+                if not chain:
+                    record(global_key(base), WRITE, guards, node)
+                elif global_key(base) is not None and resolver is not None:
+                    typ = resolver.global_instances.get((ctx.path, base))
+                    if typ is not None:
+                        record(
+                            typed_chain_key(typ, chain), WRITE, guards, node
+                        )
+
+        def visit_stmt(stmt: ast.AST, guards: frozenset) -> None:
+            consumed: set[int] = set()
+            for sub in walk_shallow_stmt(stmt):
+                if isinstance(sub, ast.Call):
+                    calls.append((sub, guards))
+                    fn = sub.func
+                    if isinstance(fn, ast.Attribute):
+                        # the callee reference itself is not state —
+                        # composition folds the callee's effects in
+                        consumed.add(id(fn))
+                        if fn.attr in MUTATORS:
+                            base, chain = _receiver_chain(fn.value)
+                            record_mutation(base, chain, guards, sub)
+                elif isinstance(sub, ast.Attribute) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)
+                ):
+                    base, chain = _receiver_chain(sub.value)
+                    record_mutation(base, chain + [sub.attr], guards, sub)
+                elif isinstance(sub, ast.Subscript) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)
+                ):
+                    base, chain = _receiver_chain(sub)
+                    record_mutation(base, chain, guards, sub)
+                elif isinstance(sub, ast.Name):
+                    if isinstance(sub.ctx, ast.Load):
+                        record(global_key(sub.id), READ, guards, sub)
+                    elif sub.id in declared:
+                        record(global_key(sub.id), WRITE, guards, sub)
+            # `self.X` / typed deep loads (skipping method-call funcs)
+            for sub in walk_shallow_stmt(stmt):
+                if (
+                    not isinstance(sub, ast.Attribute)
+                    or not isinstance(sub.ctx, ast.Load)
+                    or id(sub) in consumed
+                ):
+                    continue
+                base, chain = _receiver_chain(sub.value)
+                if base == "self" and owner is not None:
+                    if not chain:
+                        record(attr_key(sub.attr), READ, guards, sub)
+                    else:
+                        record(
+                            typed_chain_key(
+                                (ctx.path, owner), chain + [sub.attr]
+                            ),
+                            READ, guards, sub,
+                        )
+                elif (
+                    base is not None and not chain
+                    and resolver is not None
+                    and global_key(base) is not None
+                ):
+                    typ = resolver.global_instances.get((ctx.path, base))
+                    if typ is not None:
+                        record(
+                            typed_chain_key(typ, [sub.attr]),
+                            READ, guards, sub,
+                        )
+
+        cfg, in_states = self._held_states(info)
+        for node in cfg.nodes:
+            if node.kind != STMT or node.ast is None:
+                continue
+            visit_stmt(node.ast, in_states[node.idx])
+
+        out = (tuple(accesses), tuple(calls))
+        self._cache[info.qualname] = out
+        return out
+
+
+def effect_summaries(
+    project: ProjectContext,
+) -> Callable[[FileContext, FunctionInfo], frozenset]:
+    """Memoized composed-summary driver: ``summary_of(ctx, info)`` is
+    the function's transitive :class:`Access` set, callee accesses
+    carrying the locks held at their call sites."""
+    cached = getattr(project, "_effect_summaries", None)
+    if cached is not None:
+        return cached
+    graph = CallGraph.of(project)
+    resolver = InstanceResolver.of(project)
+    file_fx: dict[str, FileEffects] = {}
+
+    def fx_of(ctx: FileContext) -> FileEffects:
+        fx = file_fx.get(ctx.path)
+        if fx is None:
+            fx = file_fx[ctx.path] = FileEffects(ctx, resolver)
+        return fx
+
+    def compute(ctx, info, summary_of):
+        accesses, calls = fx_of(ctx).analyze(info)
+        out = set(accesses)
+        for call, guards in calls:
+            resolved = resolver.resolve(ctx, call, call)
+            if resolved is None:
+                continue
+            cctx, cinfo = resolved
+            for acc in summary_of(cctx, cinfo):
+                out.add(
+                    replace(acc, guards=acc.guards | guards)
+                    if guards else acc
+                )
+        return frozenset(out)
+
+    summary_of = graph.summarize(compute, default=frozenset())
+    project._effect_summaries = summary_of  # type: ignore[attr-defined]
+    return summary_of

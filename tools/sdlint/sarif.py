@@ -1,0 +1,133 @@
+"""SARIF 2.1.0 export — the interchange half of the gate.
+
+``--format=sarif`` emits the findings as a Static Analysis Results
+Interchange Format log so the gate plugs into anything that already
+speaks SARIF (GitHub code scanning, VS Code's SARIF viewer, result
+diffing tools) without a bespoke adapter per consumer.
+
+Mapping choices:
+
+- every registered rule rides ``tool.driver.rules`` (not just the ones
+  that fired) so a consumer can render the full catalog and stable
+  ``ruleIndex`` references;
+- the baseline key goes into ``partialFingerprints`` under
+  ``sdlintKey/v1`` — it is already the line-move-stable identity the
+  baseline uses, which is exactly what SARIF fingerprints are for;
+- baselined findings are emitted as suppressed results (``suppressions``
+  with the justification) rather than dropped — the log then carries
+  the same information as the JSON document, and SARIF consumers hide
+  suppressed results by default.
+"""
+
+from __future__ import annotations
+
+from .core import RULES, Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://docs.oasis-open.org/sarif/sarif/v2.1.0/errata01/os/schemas/"
+    "sarif-schema-2.1.0.json"
+)
+
+
+def _result(f: Finding, rule_index: dict[str, int],
+            justification: str | None) -> dict:
+    result = {
+        "ruleId": f.rule,
+        "ruleIndex": rule_index[f.rule],
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {
+                    "startLine": f.line,
+                    # SARIF columns are 1-based; Finding.col is the
+                    # 0-based AST offset (same shift as --annotate)
+                    "startColumn": f.col + 1,
+                },
+            },
+        }],
+        "partialFingerprints": {"sdlintKey/v1": f.key},
+    }
+    if justification is not None:
+        result["suppressions"] = [{
+            "kind": "external",
+            "justification": justification,
+        }]
+    return result
+
+
+def to_sarif(unbaselined: list[Finding], suppressed: list[Finding],
+             baseline_entries: dict[str, str] | None = None) -> dict:
+    """Build the SARIF log document (a plain dict, json.dumps-ready)."""
+    entries = baseline_entries or {}
+    rule_ids = sorted(RULES)
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = [_result(f, rule_index, None) for f in unbaselined]
+    results += [
+        _result(f, rule_index, entries.get(f.key, "baselined"))
+        for f in suppressed
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "sdlint",
+                    "informationUri":
+                        "https://github.com/spacedriveapp/spacedrive",
+                    "rules": [
+                        {
+                            "id": rid,
+                            "name": RULES[rid].name,
+                            "shortDescription": {
+                                "text": RULES[rid].summary,
+                            },
+                        }
+                        for rid in rule_ids
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def from_sarif(doc: dict) -> tuple[list[Finding], list[Finding]]:
+    """Inverse of :func:`to_sarif` — (unbaselined, suppressed).
+
+    Re-derives each Finding from its location + fingerprint; the
+    round-trip test pins the export against silent field drops (a
+    consumer can only use what actually landed in the log).
+    """
+    unbaselined: list[Finding] = []
+    suppressed: list[Finding] = []
+    for run in doc["runs"]:
+        for result in run["results"]:
+            loc = result["locations"][0]["physicalLocation"]
+            key = result["partialFingerprints"]["sdlintKey/v1"]
+            # key = rule:path:snippet[#ordinal] — path may contain ':'
+            # only on platforms we don't support; snippet may, so split
+            # from the left and peel the ordinal off the right
+            _, _, tail = key.split(":", 2)
+            ordinal = 0
+            if "#" in tail:
+                head, _, suffix = tail.rpartition("#")
+                if suffix.isdigit():
+                    tail, ordinal = head, int(suffix) - 1
+            f = Finding(
+                rule=result["ruleId"],
+                path=loc["artifactLocation"]["uri"],
+                line=loc["region"]["startLine"],
+                col=loc["region"]["startColumn"] - 1,
+                message=result["message"]["text"],
+                snippet=tail,
+                ordinal=ordinal,
+            )
+            if result.get("suppressions"):
+                suppressed.append(f)
+            else:
+                unbaselined.append(f)
+    return unbaselined, suppressed

@@ -51,6 +51,10 @@ class CallGraph:
                 self.functions[(ctx.path, qual)] = info
         self._imports: dict[str, dict[str, tuple[str, str | None]]] = {}
         self._callers: dict[tuple[str, str], list[tuple[str, str, ast.Call]]] | None = None
+        self._calls_cache: dict[
+            tuple[str, str],
+            list[tuple[ast.Call, tuple[FileContext, FunctionInfo] | None]],
+        ] = {}
 
     @classmethod
     def of(cls, project: ProjectContext) -> "CallGraph":
@@ -65,9 +69,12 @@ class CallGraph:
     # -- import resolution -------------------------------------------------
 
     def _module_for(self, dotted: str) -> str | None:
-        """Map a dotted module name onto an analyzed file path."""
+        """Map a dotted module name onto an analyzed file path. Also
+        probes with a leading slash — analyzing by absolute path (the
+        fixture trees under /tmp) loses it in the dotted round-trip."""
         base = dotted.replace(".", "/")
-        for cand in (f"{base}.py", f"{base}/__init__.py"):
+        for cand in (f"{base}.py", f"{base}/__init__.py",
+                     f"/{base}.py", f"/{base}/__init__.py"):
             if cand in self.modules:
                 return cand
         return None
@@ -165,14 +172,24 @@ class CallGraph:
 
     def calls_in(
         self, ctx: FileContext, info: FunctionInfo
-    ) -> Iterator[tuple[ast.Call, tuple[FileContext, FunctionInfo] | None]]:
+    ) -> list[tuple[ast.Call, tuple[FileContext, FunctionInfo] | None]]:
         """Every call expression in ``info``'s body (not descending into
-        nested defs) with its resolution."""
+        nested defs) with its resolution. Memoized per function — the
+        fixpoint passes (context propagation, effect composition) revisit
+        the same function many times and the AST walk dominates their
+        cost."""
         from .core import walk_shallow
 
-        for node in walk_shallow(info.node):
-            if isinstance(node, ast.Call):
-                yield node, self.resolve(ctx, node, node)
+        key = (ctx.path, info.qualname)
+        hit = self._calls_cache.get(key)
+        if hit is None:
+            hit = [
+                (node, self.resolve(ctx, node, node))
+                for node in walk_shallow(info.node)
+                if isinstance(node, ast.Call)
+            ]
+            self._calls_cache[key] = hit
+        return hit
 
     def callers_of(
         self, ctx: FileContext, info: FunctionInfo
@@ -226,3 +243,257 @@ class CallGraph:
             return result
 
         return summary_of
+
+
+class InstanceResolver:
+    """Call resolution through lightweight instance typing.
+
+    :class:`CallGraph` resolves names (``self.m()``, ``mod.f()``); the
+    concurrency passes also need the instance-handle idioms this repo
+    drives its long-lived machinery through:
+
+    - module-level singletons — ``SAMPLER = Sampler()`` then
+      ``_sampler.SAMPLER.reset()`` from another module;
+    - typed self-attributes — ``self._pipeline = WindowPipeline(...)``
+      then ``self._pipeline.take`` (including as a bare reference
+      handed to ``asyncio.to_thread``);
+    - typed locals — ``pool = ProcPool(); pool.submit(...)``;
+    - constructor calls — ``WindowPipeline(...)`` resolves to
+      ``WindowPipeline.__init__`` so spawn-context seeds reach
+      initializers.
+
+    Typing is first-assignment-wins and deliberately shallow: a name
+    is typed only when assigned directly from a resolvable class
+    constructor. Anything else stays untyped and resolution returns
+    None — the same opacity contract as :class:`CallGraph`. Kept
+    separate from CallGraph so the established rules (SD004/SD017)
+    keep their original, narrower edge set.
+    """
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self._calls_cache: dict[
+            tuple[str, str],
+            list[tuple[ast.Call, tuple[FileContext, FunctionInfo] | None]],
+        ] = {}
+        #: (path, ClassName) present in the analyzed tree
+        self.classes: set[tuple[str, str]] = set()
+        #: path -> {local class name}
+        self._classes_by_module: dict[str, set[str]] = {}
+        for ctx in graph.project.files:
+            names = {
+                stmt.name
+                for stmt in ctx.tree.body
+                if isinstance(stmt, ast.ClassDef)
+            }
+            self._classes_by_module[ctx.path] = names
+            self.classes |= {(ctx.path, n) for n in names}
+        #: (path, global name) -> (class path, ClassName)
+        self.global_instances: dict[tuple[str, str], tuple[str, str]] = {}
+        #: (path, Owner, attr) -> (class path, ClassName)
+        self.attr_types: dict[tuple[str, str, str], tuple[str, str]] = {}
+        for ctx in graph.project.files:
+            self._index_file(ctx)
+        self._local_types: dict[tuple[str, int], dict[str, tuple[str, str]]] = {}
+
+    @classmethod
+    def of(cls, project: ProjectContext) -> "InstanceResolver":
+        got = getattr(project, "_instance_resolver", None)
+        if got is None:
+            got = cls(CallGraph.of(project))
+            project._instance_resolver = got  # type: ignore[attr-defined]
+        return got
+
+    # -- typing ------------------------------------------------------------
+
+    def _class_of_call(
+        self, ctx: FileContext, value: ast.AST
+    ) -> tuple[str, str] | None:
+        """``<ClassRef>(...)`` -> (path, ClassName), else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        name = call_name(value)
+        if name is None:
+            return None
+        return self._resolve_class(ctx, name)
+
+    def _export(self, mod: str, name: str) -> tuple[str, str]:
+        """Chase ``from .x import N`` re-export chains (package
+        ``__init__`` facades) toward the defining module."""
+        for _ in range(4):
+            if (mod, name) in self.classes or (
+                (mod, name) in self.global_instances
+            ):
+                return mod, name
+            mctx = self.graph.modules.get(mod)
+            if mctx is None:
+                return mod, name
+            imp = self.graph.imports_of(mctx).get(name)
+            if imp is None or imp[1] is None:
+                return mod, name
+            mod, name = imp
+        return mod, name
+
+    def _resolve_class(
+        self, ctx: FileContext, name: str
+    ) -> tuple[str, str] | None:
+        parts = name.split(".")
+        if len(parts) == 1:
+            if parts[0] in self._classes_by_module.get(ctx.path, ()):
+                return ctx.path, parts[0]
+            imp = self.graph.imports_of(ctx).get(parts[0])
+            if imp is not None and imp[1] is not None:
+                mod, name = self._export(imp[0], imp[1])
+                if (mod, name) in self.classes:
+                    return mod, name
+            return None
+        imports = self.graph.imports_of(ctx)
+        for cut in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:cut])
+            if prefix in imports:
+                mod, attr = imports[prefix]
+                tail = parts[cut:]
+                if attr is not None:
+                    tail = [attr] + tail
+                if len(tail) == 1:
+                    mod, name = self._export(mod, tail[0])
+                    if (mod, name) in self.classes:
+                        return mod, name
+                return None
+        return None
+
+    def _index_file(self, ctx: FileContext) -> None:
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                typ = self._class_of_call(ctx, stmt.value)
+                if typ is None:
+                    continue
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.global_instances[(ctx.path, tgt.id)] = typ
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            typ = self._class_of_call(ctx, node.value)
+            if typ is None:
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    owner = ctx.enclosing_class(node)
+                    if owner is not None:
+                        self.attr_types.setdefault(
+                            (ctx.path, owner, tgt.attr), typ
+                        )
+
+    def _locals_of(
+        self, ctx: FileContext, fn: ast.AST
+    ) -> dict[str, tuple[str, str]]:
+        from .core import walk_shallow
+
+        key = (ctx.path, id(fn))
+        got = self._local_types.get(key)
+        if got is not None:
+            return got
+        table: dict[str, tuple[str, str]] = {}
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.Assign):
+                typ = self._class_of_call(ctx, node.value)
+                if typ is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        table.setdefault(tgt.id, typ)
+        self._local_types[key] = table
+        return table
+
+    # -- resolution --------------------------------------------------------
+
+    def _method(
+        self, typ: tuple[str, str], name: str
+    ) -> tuple[FileContext, FunctionInfo] | None:
+        info = self.graph.functions.get((typ[0], f"{typ[1]}.{name}"))
+        if info is None:
+            return None
+        return self.graph.modules[typ[0]], info
+
+    def resolve_name(
+        self, ctx: FileContext, name: str, site: ast.AST | None = None
+    ) -> tuple[FileContext, FunctionInfo] | None:
+        got = self.graph.resolve_name(ctx, name, site)
+        if got is not None:
+            return got
+        parts = name.split(".")
+        # ClassName(...) -> __init__
+        cls = self._resolve_class(ctx, name)
+        if cls is not None:
+            return self._method(cls, "__init__")
+        if len(parts) < 2:
+            return None
+        typ: tuple[str, str] | None = None
+        rest: list[str] = []
+        if parts[0] == "self" and site is not None:
+            owner = ctx.enclosing_class(site)
+            if owner is None:
+                return None
+            typ, rest = (ctx.path, owner), parts[1:]
+        elif (ctx.path, parts[0]) in self.global_instances:
+            typ, rest = self.global_instances[(ctx.path, parts[0])], parts[1:]
+        else:
+            if site is not None:
+                fn = ctx.enclosing_function(site)
+                if fn is not None:
+                    typ = self._locals_of(ctx, fn).get(parts[0])
+                    if typ is not None:
+                        rest = parts[1:]
+            if typ is None:
+                imports = self.graph.imports_of(ctx)
+                for cut in range(len(parts) - 1, 0, -1):
+                    prefix = ".".join(parts[:cut])
+                    if prefix in imports:
+                        mod, attr = imports[prefix]
+                        tail = parts[cut:]
+                        if attr is not None:
+                            tail = [attr] + tail
+                        if len(tail) >= 2:
+                            inst = self._export(mod, tail[0])
+                            if inst in self.global_instances:
+                                typ = self.global_instances[inst]
+                                rest = tail[1:]
+                        break
+        if typ is None or not rest:
+            return None
+        # descend typed attributes: NAME.pipeline.take
+        while len(rest) > 1:
+            nxt = self.attr_types.get((typ[0], typ[1], rest[0]))
+            if nxt is None:
+                return None
+            typ, rest = nxt, rest[1:]
+        return self._method(typ, rest[0])
+
+    def resolve(
+        self, ctx: FileContext, call: ast.Call, site: ast.AST
+    ) -> tuple[FileContext, FunctionInfo] | None:
+        name = call_name(call)
+        if name is None:
+            return None
+        return self.resolve_name(ctx, name, site)
+
+    def calls_in(
+        self, ctx: FileContext, info: FunctionInfo
+    ) -> list[tuple[ast.Call, tuple[FileContext, FunctionInfo] | None]]:
+        from .core import walk_shallow
+
+        key = (ctx.path, info.qualname)
+        hit = self._calls_cache.get(key)
+        if hit is None:
+            hit = [
+                (node, self.resolve(ctx, node, node))
+                for node in walk_shallow(info.node)
+                if isinstance(node, ast.Call)
+            ]
+            self._calls_cache[key] = hit
+        return hit
