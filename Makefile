@@ -5,7 +5,8 @@ PY ?= python
 
 .PHONY: lint lint-changed test tier1 trace-smoke slo-smoke profile-smoke \
 	debug-bundle bench-devices bench-check bench-warm bench-autotune \
-	bench-mesh bench-procs bench-serve bench-semantic search-smoke chaos
+	bench-mesh bench-procs bench-serve bench-semantic bench-scale \
+	search-smoke soak-smoke chaos
 
 # set SDLINT_ANNOTATE=1 in CI for GitHub ::error annotations on the diff.
 # The selftest proves every rule still fires on its own fixture corpus
@@ -111,6 +112,27 @@ search-smoke:
 # (docs/robustness.md "Serving under overload").
 bench-serve:
 	env JAX_PLATFORMS=cpu $(PY) bench_serve.py > /dev/null
+
+# million-file churn soak: sparse corpus + seed-deterministic churn
+# (touch/rename/reindex/reads/orphan storms) through the real planes
+# while the resource sampler watches RSS/fd/journal growth; writes
+# BENCH_SCALE.json, `make bench-check` re-derives the verdict. Full
+# lane — budget SD_SOAK_SECONDS (default 120 s at 20k files; raise
+# both for the overnight million-file run on a real rig; the trend
+# SLOs then gate at the real 64 MB/h / 50 fd/h production bars).
+bench-scale:
+	env JAX_PLATFORMS=cpu $(PY) bench_scale.py
+
+# soak smoke (tier-1): a compressed bench_scale lane — small corpus,
+# accelerated sampler/history cadence, warmup-scaled trend bars — plus
+# the planted-leak test proving a breach flips health and captures one
+# profile, and the prune/backfill bounded-batch units. The smoke's RSS
+# bar is generous by design: a 15 s run extrapolates absurd per-hour
+# slopes from JAX/aiohttp warmup allocation; the full `bench-scale`
+# lane owns the real bars.
+soak-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py \
+		tests/test_resources.py -q -m 'not slow' -p no:cacheprovider
 
 # perf trajectory gate: diff the two most recent BENCH_r*.json rounds
 # AND (when BENCH_E2E_prev.json exists) the previous → current

@@ -869,21 +869,51 @@ class IndexJournal:
         return out
 
 
-def prune_orphans(db: Any) -> int:
+#: orphan-prune delete batch: small enough that one DELETE holds the
+#: write lock for milliseconds even against a million-row journal,
+#: large enough that a typical prune is one round trip
+PRUNE_BATCH = 2048
+
+
+def prune_orphans(db: Any, batch: int = PRUNE_BATCH) -> int:
     """Drop journal rows whose file_path row vanished — the journal's
     share of the orphan-remover pass (object/orphan_remover.py). Uses
-    the DB as the liveness source instead of re-stat'ing paths on disk."""
+    the DB as the liveness source instead of re-stat'ing paths on disk.
+
+    Deletes in bounded rowid batches: one unbounded DELETE against a
+    million-row journal holds SQLite's write lock (and whichever thread
+    issued it) for the whole scan. Callers on the event loop should use
+    the async wrapper in object/orphan_remover.py, which yields between
+    batches."""
+    total = 0
+    while True:
+        n = prune_orphans_step(db, batch)
+        total += n
+        if n < max(1, batch):
+            break
+    return total
+
+
+def prune_orphans_step(db: Any, batch: int = PRUNE_BATCH) -> int:
+    """One bounded prune batch; a return < ``batch`` means the journal
+    is clean. The orphan-remover actor's async path calls this between
+    event-loop yields so a million-row prune can't freeze the loop."""
+    batch = max(1, batch)
     try:
         n = db.execute(
-            "DELETE FROM index_journal WHERE NOT EXISTS ("
+            "DELETE FROM index_journal WHERE rowid IN ("
+            "SELECT ij.rowid FROM index_journal ij "
+            "WHERE NOT EXISTS ("
             "SELECT 1 FROM file_path fp WHERE "
-            "fp.location_id = index_journal.location_id AND "
-            "fp.materialized_path = index_journal.materialized_path AND "
-            "fp.name = index_journal.name AND "
-            "fp.extension = index_journal.extension)"
+            "fp.location_id = ij.location_id AND "
+            "fp.materialized_path = ij.materialized_path AND "
+            "fp.name = ij.name AND "
+            "fp.extension = ij.extension) LIMIT ?)",
+            (batch,),
         ).rowcount
     except sqlite3.Error:
         return 0
+    n = max(0, n)
     if n:
         _tm.INDEX_JOURNAL_OPS.inc(n, result="invalidated")
     return n

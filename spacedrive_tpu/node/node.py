@@ -120,6 +120,15 @@ class Node:
 
         self.procpool = _procpool.POOL
         self._procpool_started = False
+        # the process-wide resource-growth sampler (telemetry/resources):
+        # refcounted like the profiler; SD_RESOURCES=0 starts nothing
+        # (true no-op). Inventory providers that need node state
+        # (journal/oplog rows, serve caches, history bytes) register at
+        # start and unregister at shutdown.
+        from ..telemetry import resources as _resources
+
+        self.resources = _resources.SAMPLER
+        self._resources_started = False
         self._started = False
 
     # --- identity ------------------------------------------------------
@@ -169,6 +178,13 @@ class Node:
         # worker processes up before any job runs, so the first shard's
         # pool batches never pay spawn latency inside a measured pass
         self._procpool_started = self.procpool.start()
+        # resource growth surfaces: node-state inventories registered
+        # before the sampler's hold so the first tick reads them all
+        from ..telemetry import resources as _resources
+
+        for name, fn in _resources.node_providers(self).items():
+            self.resources.register_provider(name, fn)
+        self._resources_started = self.resources.start()
         # bind the thumbnailer to THIS loop up front: enqueues arrive
         # from worker threads (non-indexed walker) and can only wake the
         # actor thread-safely once it knows its owning loop
@@ -300,6 +316,18 @@ class Node:
         if self._procpool_started:
             self.procpool.stop()
             self._procpool_started = False
+        if self._resources_started:
+            self.resources.stop()
+            self._resources_started = False
+        if not self.resources.running():
+            # last hold released (or sampling disabled): drop the
+            # node-state closures so a dead node can't be read. While a
+            # sibling in-process node still holds the sampler, its own
+            # registrations (last-wins) stay live instead.
+            from ..telemetry import resources as _resources
+
+            for name in _resources.node_providers(self):
+                self.resources.unregister_provider(name)
         await self.thumbnailer.shutdown()
         if self.image_labeler is not None:
             await self.image_labeler.shutdown()

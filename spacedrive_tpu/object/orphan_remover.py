@@ -49,6 +49,47 @@ def process_clean_up(db) -> int:
         logger.debug("removed %d orphaned objects", len(ids))
 
 
+async def process_clean_up_async(db) -> int:
+    """The actor's clean-up pass: same work as :func:`process_clean_up`
+    but yielding to the event loop between every delete batch — the PR 9
+    ingest lesson applied to maintenance: a million-row clean-up is
+    thousands of short lock holds with scheduling points between them,
+    never one loop-freezing scan."""
+    from ..location.indexer.journal import (
+        PRUNE_BATCH,
+        prune_orphans_step,
+    )
+
+    removed = 0
+    while True:
+        rows = db.query(
+            "SELECT o.id FROM object o WHERE NOT EXISTS "
+            "(SELECT 1 FROM file_path fp WHERE fp.object_id = o.id) LIMIT ?",
+            (BATCH,),
+        )
+        if not rows:
+            break
+        ids = [r["id"] for r in rows]
+        qmarks = ",".join("?" for _ in ids)
+        with db.transaction() as conn:
+            conn.execute(f"DELETE FROM tag_on_object WHERE object_id IN ({qmarks})", ids)
+            conn.execute(f"DELETE FROM label_on_object WHERE object_id IN ({qmarks})", ids)
+            conn.execute(f"DELETE FROM object WHERE id IN ({qmarks})", ids)
+        removed += len(ids)
+        logger.debug("removed %d orphaned objects", len(ids))
+        await asyncio.sleep(0)
+    pruned = 0
+    while True:
+        n = prune_orphans_step(db, PRUNE_BATCH)
+        pruned += n
+        if n < PRUNE_BATCH:
+            break
+        await asyncio.sleep(0)
+    if pruned:
+        logger.debug("pruned %d orphaned journal rows", pruned)
+    return removed
+
+
 class OrphanRemoverActor:
     def __init__(self, db, tick_interval: float = TICK_INTERVAL, debounce: float = DEBOUNCE):
         self.db = db
@@ -91,7 +132,7 @@ class OrphanRemoverActor:
             self._wake.clear()
             if time.monotonic() - self._last_checked > self.debounce:
                 try:
-                    process_clean_up(self.db)
+                    await process_clean_up_async(self.db)
                 except Exception:  # noqa: BLE001 - actor must survive
                     logger.exception("orphan clean-up failed")
                 self._last_checked = time.monotonic()

@@ -485,39 +485,80 @@ class IngestActor:
 
 # --- backfill (ref:core/crates/sync/src/backfill.rs) ---------------------
 
+#: rows examined (and ops flushed) per backfill batch — the whole pass
+#: is bounded-memory at any table size: one batch of rows, one covered
+#: membership probe, one write_ops flush, repeat
+BACKFILL_BATCH = 1024
+
+
 def backfill_operations(sync: SyncManager) -> int:
     """Emit create+update ops for every syncable row that has no op log
     yet (a library that predates sync, or was seeded directly). Returns
-    the number of ops written."""
+    the number of ops written.
+
+    Bounded-memory by construction: rows stream through a rowid cursor
+    in :data:`BACKFILL_BATCH` chunks, coverage is probed per chunk with
+    an ``IN (...)`` membership query (never a full DISTINCT set — a
+    million-row op log must not materialize in Python), and ops flush
+    per chunk. Callers run this off the event loop (``to_thread``); the
+    cursor shape keeps each SQLite lock hold short either way."""
     from ..db.sync_registry import SYNC_MODELS, SyncKind
 
-    ops: list[CRDTOperation] = []
+    written = 0
     for model in SYNC_MODELS.values():
         if model.kind is SyncKind.LOCAL:
             continue
-        # one query per model, not one per row — backfill runs on every
-        # pairing accept, so the no-op case must stay O(models)
-        covered = {
-            r["record_id"]
-            for r in sync.db.query(
-                "SELECT DISTINCT record_id FROM crdt_operation WHERE model = ?",
-                (model.name,),
+        last_rowid = -1
+        while True:
+            rows = sync.db.query(
+                f"SELECT rowid AS _backfill_rid, * FROM {model.name} "
+                "WHERE rowid > ? ORDER BY rowid LIMIT ?",
+                (last_rowid, BACKFILL_BATCH),
             )
-        }
-        for row in sync.db.query(f"SELECT * FROM {model.name}"):
-            record_id = _row_sync_id(sync, model, row)
-            if record_id is None:
+            if not rows:
+                break
+            last_rowid = rows[-1]["_backfill_rid"]
+            pending: list[tuple[Any, Any, dict]] = []
+            for row in rows:
+                row = {k: v for k, v in row.items()
+                       if k != "_backfill_rid"}
+                record_id = _row_sync_id(sync, model, row)
+                if record_id is None:
+                    continue
+                pending.append((_record_id_blob(record_id), record_id,
+                                row))
+            if not pending:
                 continue
-            if _record_id_blob(record_id) in covered:
-                continue
-            values = _row_sync_values(sync, model, row)
-            if model.kind is SyncKind.SHARED:
-                ops.extend(sync.shared_create(model.name, record_id, values))
-            else:
-                ops.extend(sync.relation_create(model.name, record_id, values))
-    if ops:
-        sync.write_ops(ops)
-    return len(ops)
+            # membership probe scoped to THIS chunk's ids — the no-op
+            # case (backfill on every pairing accept) stays O(rows
+            # scanned), with nothing accumulated across chunks
+            qmarks = ",".join("?" for _ in pending)
+            covered = {
+                r["record_id"]
+                for r in sync.db.query(
+                    "SELECT record_id FROM crdt_operation "
+                    f"WHERE model = ? AND record_id IN ({qmarks})",
+                    (model.name, *[blob for blob, _, _ in pending]),
+                )
+            }
+            ops: list[CRDTOperation] = []
+            for blob, record_id, row in pending:
+                if blob in covered:
+                    continue
+                values = _row_sync_values(sync, model, row)
+                if model.kind is SyncKind.SHARED:
+                    ops.extend(
+                        sync.shared_create(model.name, record_id, values))
+                else:
+                    ops.extend(
+                        sync.relation_create(model.name, record_id,
+                                             values))
+            if ops:
+                sync.write_ops(ops)
+                written += len(ops)
+            if len(rows) < BACKFILL_BATCH:
+                break
+    return written
 
 
 def _row_sync_id(sync: SyncManager, model, row) -> Any:

@@ -29,6 +29,7 @@ from . import (
     health,
     history,
     metrics,
+    resources,
     sampler,
     slo,
     trace,
@@ -58,7 +59,8 @@ def reset() -> None:
     span ring, the trace ring, every flight-recorder ring, the
     attribution report cache + pass markers, SLO evaluation state, the
     host profiler's accumulators + capture-window ring + trigger
-    state, and every history writer's in-memory tail (durable history
+    state, the resource sampler's last-sample state + planted test
+    leaks, and every history writer's in-memory tail (durable history
     segments are data-dir state and deliberately survive)."""
     REGISTRY.reset()
     clear_recent()
@@ -67,6 +69,7 @@ def reset() -> None:
     attrib.reset()
     slo.reset()
     sampler.reset()
+    resources.reset()
     history.reset_tails()
     # the index journal's per-location runtime counters + stats cache
     # live like registry series (lazy import: journal imports metrics)
@@ -129,4 +132,5 @@ __all__ = [
     "counter_value", "render", "counter", "gauge", "histogram",
     "trace", "events", "reset", "trace_export", "debug_bundle",
     "health", "federation", "attrib", "history", "slo", "sampler",
+    "resources",
 ]

@@ -28,7 +28,10 @@ Subsystems and their signals:
 - ``resilience`` — open circuit breakers (utils/resilience) and the
   device degradation-ladder level: a node fast-failing a dead relay or
   hashing on a chip subset still works, but reads degraded until the
-  half-open probe / ladder re-arm succeeds.
+  half-open probe / ladder re-arm succeeds;
+- ``resources`` — the resource sampler's growth posture: trend-SLO
+  verdicts over RSS/fd slopes (a sustained leak is unhealthy long
+  before the OOM) plus the last sampled inventory as signals.
 
 Thresholds are module constants, deliberately lenient: a health
 verdict that cries wolf gets ignored.
@@ -326,6 +329,53 @@ def _slo(node: Any = None) -> dict[str, Any]:
     return _verdict(HEALTHY, **signals)
 
 
+def _resources() -> dict[str, Any]:
+    """Resource-growth posture (telemetry/resources.py + the trend SLO
+    class). The verdict keys off the trend SLOs' verdicts from the
+    evaluation the ``slo`` subsystem just ran — a sustained RSS/fd
+    growth slope past its bar is UNHEALTHY (the node is leaking toward
+    an OOM, on a schedule), a flattened-but-regressed window is
+    DEGRADED. Disabled sampling (SD_RESOURCES=0) or no samples yet
+    reads UNKNOWN and never worsens the rollup."""
+    from . import resources as _res
+    from . import slo as _slo_mod
+
+    if not _res.enabled():
+        return _verdict(UNKNOWN, "resource sampling disabled")
+    summary = _res.SAMPLER.summary()
+    if not summary.get("last"):
+        return _verdict(UNKNOWN, "no resource samples yet",
+                        running=summary.get("running", False))
+    trend_names = {s.name for s in _slo_mod.REGISTRY.all()
+                   if s.kind == "trend"}
+    evaluation = _slo_mod.REGISTRY.last_evaluation or {}
+    trends = {s["name"]: s for s in evaluation.get("slos", ())
+              if s["name"] in trend_names}
+    breached = sorted(n for n, s in trends.items()
+                      if s["status"] == _slo_mod.BREACH)
+    warned = sorted(n for n, s in trends.items()
+                    if s["status"] == _slo_mod.WARN)
+    signals = {
+        "last": summary["last"],
+        "samples": summary["samples"],
+        "trends": {
+            n: {"status": s["status"],
+                **(s.get("windows", {}).get("trend") or {})}
+            for n, s in trends.items()
+        },
+    }
+    if breached:
+        return _verdict(
+            UNHEALTHY,
+            f"resource growth past its slope bar: {', '.join(breached)}",
+            **signals)
+    if warned:
+        return _verdict(
+            DEGRADED,
+            f"resource growth regressed: {', '.join(warned)}", **signals)
+    return _verdict(HEALTHY, **signals)
+
+
 def evaluate(node: Any = None) -> dict[str, Any]:
     """The full health rollup: per-subsystem verdicts plus the overall
     status (worst subsystem; ``unknown`` counts as healthy)."""
@@ -338,6 +388,9 @@ def evaluate(node: Any = None) -> dict[str, Any]:
         "resilience": _resilience(),
         "serve": _serve(node),
         "slo": _slo(node),
+        # MUST come after "slo": the trend verdicts it reads are the
+        # ones _slo just computed into REGISTRY.last_evaluation
+        "resources": _resources(),
     }
     overall = HEALTHY
     for v in subsystems.values():

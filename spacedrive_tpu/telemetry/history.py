@@ -142,6 +142,25 @@ def default_samplers() -> dict[str, Callable[[], float]]:
         "autotune_batch_rung": lambda: gauge_value(
             "sd_autotune_batch_rung", workload="identify"),
     })
+    from . import resources as _resources
+
+    if _resources.enabled():
+        # growth surfaces for the trend SLO class — gated so
+        # SD_RESOURCES=0 leaves the sampled allowlist (and every
+        # history record) byte-identical to a pre-resources node
+        samplers.update({
+            "resource_rss_mb": lambda: gauge_value(
+                "sd_resource_rss_bytes") / 1e6,
+            "resource_fds": lambda: gauge_value("sd_resource_fds"),
+            "resource_threads": lambda: gauge_value(
+                "sd_resource_threads"),
+            "resource_journal_rows": lambda: gauge_value(
+                "sd_resource_inventory", kind="journal_rows"),
+            "resource_oplog_rows": lambda: gauge_value(
+                "sd_resource_inventory", kind="oplog_rows"),
+            "resource_history_bytes": lambda: gauge_value(
+                "sd_resource_inventory", kind="history_bytes"),
+        })
     return samplers
 
 

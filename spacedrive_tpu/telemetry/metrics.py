@@ -555,6 +555,39 @@ PROFILE_OVERHEAD = REGISTRY.gauge(
     "always-on witness",
 )
 
+# --- resource-growth sampler (telemetry/resources.py) -----------------------
+# Process-level growth surfaces sampled at low rate; the history store
+# turns these gauges into resource_* series and the trend SLO class
+# judges their slopes (leaks show up as gated regressions, not OOMs).
+
+RESOURCE_RSS = REGISTRY.gauge(
+    "sd_resource_rss_bytes",
+    "resident set size of this process from /proc/self/status (VmRSS); "
+    "the rss_growth trend SLO bounds its slope in MB/h after warmup",
+)
+RESOURCE_FDS = REGISTRY.gauge(
+    "sd_resource_fds",
+    "open file descriptors in this process (/proc/self/fd count); the "
+    "fd_growth trend SLO expects this flat at steady state",
+)
+RESOURCE_THREADS = REGISTRY.gauge(
+    "sd_resource_threads",
+    "OS threads in this process (/proc/self/status Threads:)",
+)
+RESOURCE_PROCPOOL_RSS = REGISTRY.gauge(
+    "sd_resource_procpool_rss_bytes",
+    "summed resident set size of live procpool workers "
+    "(/proc/<pid>/statm over the multi-process plane; 0 with SD_PROCS=0)",
+)
+RESOURCE_INVENTORY = REGISTRY.gauge(
+    "sd_resource_inventory",
+    "in-process inventory sizes over a fixed kind vocabulary: "
+    "journal_rows, oplog_rows (summed over open libraries), "
+    "serve_cache_entries, serve_cache_bytes, history_bytes, ring_drops "
+    "— journal/oplog rows should track corpus size, not pass count",
+    labels=("kind",),
+)
+
 # --- event loop health (telemetry/events.py LoopLagMonitor) -----------------
 
 EVENT_LOOP_LAG = REGISTRY.gauge(

@@ -20,6 +20,17 @@ semantics instead: ANY increase of the cumulative counter within the
 fast window is an immediate breach — a protected-class shed is a
 serve-layer bug, not budget spend.
 
+Growth-shaped SLOs (``kind="trend"``) bound a *slope*, not a level:
+the objective is the maximum allowed least-squares slope in
+units-per-hour over a sliding ``trend_window_s`` of the series,
+excluding a ``warmup_s`` prefix (caches filling and JIT warmup look
+like leaks for the first minutes of any process). A breach requires
+the full-window slope AND the recent-half slope to exceed the
+objective with an absolute ``min_delta`` actually accumulated — a
+leak must be ongoing and material, not a historical step or float
+noise on a flat line. This is how the resource sampler's RSS/fd
+series (``telemetry/resources.py``) become gated regressions.
+
 The registry is declarative and process-global (:data:`REGISTRY`,
 seeded with :func:`default_slos`); evaluation state (last verdicts,
 for delta-free reads) is cached per process and cleared by
@@ -43,6 +54,12 @@ SLOW_WINDOW_S = 3600.0
 FAST_BURN = 14.4
 SLOW_BURN = 6.0
 
+#: trend-class defaults: slope judged over a sliding 30 min window,
+#: first 2 min excluded as warmup, at least 8 post-warmup samples
+TREND_WINDOW_S = 1800.0
+TREND_WARMUP_S = 120.0
+TREND_MIN_SAMPLES = 8
+
 OK = "ok"
 WARN = "warn"
 BREACH = "breach"
@@ -60,13 +77,18 @@ class SLO:
     - ``lower``: good while ``value >= objective`` — with
       ``ignore_zero`` (pass throughput) samples at 0 are idle, not bad;
     - ``zero_tolerance``: the series is a cumulative counter; ANY
-      increase inside the fast window breaches.
+      increase inside the fast window breaches;
+    - ``trend``: ``objective`` is the max allowed growth slope in
+      series-units **per hour** over ``trend_window_s`` (samples inside
+      the first ``warmup_s`` of the window are excluded); breach needs
+      both the full-window and recent-half slopes over the objective
+      AND a total accumulated delta ≥ ``min_delta``.
     """
 
     name: str
     series: str
     objective: float
-    kind: str = "upper"  # upper | lower | zero_tolerance
+    kind: str = "upper"  # upper | lower | zero_tolerance | trend
     target: float = 0.99
     description: str = ""
     ignore_zero: bool = False
@@ -74,6 +96,10 @@ class SLO:
     slow_window_s: float = SLOW_WINDOW_S
     fast_burn: float = FAST_BURN
     slow_burn: float = SLOW_BURN
+    trend_window_s: float = TREND_WINDOW_S
+    warmup_s: float = TREND_WARMUP_S
+    min_samples: int = TREND_MIN_SAMPLES
+    min_delta: float = 0.0
 
     def is_good(self, value: float) -> bool | None:
         """None = the sample doesn't count (idle)."""
@@ -85,9 +111,11 @@ class SLO:
 
 
 def default_slos() -> list[SLO]:
+    from . import resources as _resources
+
     objective = float(os.environ.get("SD_SLO_INTERACTIVE_P99_MS", "250"))
     throughput = float(os.environ.get("SD_SLO_FILES_PER_S", "50"))
-    return [
+    slos = [
         SLO("interactive_p99", series="interactive_p99_ms",
             objective=objective, kind="upper", target=0.99,
             description="serve-layer interactive request p99 under "
@@ -106,6 +134,34 @@ def default_slos() -> list[SLO]:
             description="control/sync-class sheds are contractually zero "
                         "— any increase is an immediate breach"),
     ]
+    if _resources.enabled():
+        # gated on the sampler knob so SD_RESOURCES=0 stays a true
+        # no-op: no resource_* series, no trend SLOs over them, no new
+        # sd_slo_status labels — the pass output is golden-identical
+        rss_mb_h = float(os.environ.get("SD_SLO_RSS_MB_PER_H", "64"))
+        fd_h = float(os.environ.get("SD_SLO_FD_PER_H", "50"))
+        window = float(os.environ.get("SD_RESOURCE_TREND_WINDOW_S",
+                                      str(TREND_WINDOW_S)))
+        warmup = float(os.environ.get("SD_RESOURCE_WARMUP_S",
+                                      str(TREND_WARMUP_S)))
+        slos += [
+            SLO("rss_growth", series="resource_rss_mb",
+                objective=rss_mb_h, kind="trend",
+                trend_window_s=window, warmup_s=warmup,
+                min_delta=rss_mb_h / 4.0,
+                description="process RSS growth slope bounded to "
+                            f"{rss_mb_h:g} MB/h after warmup — a "
+                            "steeper sustained slope is a leak, not "
+                            "load"),
+            SLO("fd_growth", series="resource_fds",
+                objective=fd_h, kind="trend",
+                trend_window_s=window, warmup_s=warmup,
+                min_delta=max(8.0, fd_h / 4.0),
+                description="open-fd count flat at steady state "
+                            f"(slope ≤ {fd_h:g} fds/h) — growth means "
+                            "descriptors are being stranded"),
+        ]
+    return slos
 
 
 class SloRegistry:
@@ -180,11 +236,80 @@ def _counter_increase(samples: list[tuple[float, float]]) -> float:
     return inc
 
 
+def _slope_per_h(samples: list[tuple[float, float]]) -> float:
+    """Least-squares slope of (ts, value) in units per hour."""
+    n = len(samples)
+    if n < 2:
+        return 0.0
+    t0 = samples[0][0]
+    xs = [t - t0 for t, _ in samples]
+    ys = [v for _, v in samples]
+    mean_x = sum(xs) / n
+    mean_y = sum(ys) / n
+    denom = sum((x - mean_x) ** 2 for x in xs)
+    if denom <= 0:
+        return 0.0
+    slope = sum((x - mean_x) * (y - mean_y)
+                for x, y in zip(xs, ys)) / denom
+    return slope * 3600.0
+
+
+def _evaluate_trend(slo: SLO,
+                    samples: list[tuple[float, float]]) -> dict[str, Any]:
+    """Trend verdict over one window of post-warmup samples."""
+    kept = samples
+    if samples:
+        cutoff = samples[0][0] + slo.warmup_s
+        kept = [(t, v) for t, v in samples if t >= cutoff]
+    doc: dict[str, Any] = {
+        "seconds": slo.trend_window_s,
+        "samples": len(kept),
+        "warmup_excluded": len(samples) - len(kept),
+        "min_delta": slo.min_delta,
+    }
+    if len(kept) < max(2, slo.min_samples):
+        doc.update(slope_per_h=0.0, recent_slope_per_h=0.0, delta=0.0,
+                   status=NO_DATA)
+        return doc
+    slope = _slope_per_h(kept)
+    recent = _slope_per_h(kept[len(kept) // 2:])
+    delta = kept[-1][1] - kept[0][1]
+    doc.update(slope_per_h=round(slope, 3),
+               recent_slope_per_h=round(recent, 3),
+               delta=round(delta, 3))
+    material = delta >= slo.min_delta
+    if slope > slo.objective and recent > slo.objective and material:
+        doc["status"] = BREACH
+    elif slope > slo.objective and material:
+        # the full window regressed but the recent half flattened —
+        # the growth stopped (a filled cache, a completed pass), so
+        # surface it without flipping health
+        doc["status"] = WARN
+    else:
+        doc["status"] = OK
+    return doc
+
+
 def evaluate_slo(slo: SLO, samples_for: Callable[[float],
                                                  list[tuple[float, float]]],
                  now: float | None = None) -> dict[str, Any]:
     """One SLO against a window-reader ``samples_for(seconds) ->
     [(ts, value)]``."""
+    if slo.kind == "trend":
+        window = samples_for(slo.trend_window_s)
+        trend = _evaluate_trend(slo, window)
+        return {
+            "name": slo.name,
+            "series": slo.series,
+            "kind": slo.kind,
+            "objective": slo.objective,
+            "target": slo.target,
+            "description": slo.description,
+            "current": window[-1][1] if window else None,
+            "windows": {"trend": {k: v for k, v in trend.items()
+                                  if k != "status"}},
+            "status": trend["status"],
+        }
     fast = samples_for(slo.fast_window_s)
     slow = samples_for(slo.slow_window_s)
     current = fast[-1][1] if fast else (slow[-1][1] if slow else None)

@@ -199,5 +199,5 @@ async def test_slo_smoke_attribution_and_slo_surfaces(tmp_path, corpus,
     # healthy 5-file pass
     names = {s["name"] for s in slo_doc["slos"]}
     assert names == {"interactive_p99", "sync_lag", "pass_throughput",
-                     "protected_sheds"}
+                     "protected_sheds", "rss_growth", "fd_growth"}
     assert slo_doc["status"] in ("ok", "no_data"), slo_doc

@@ -1,0 +1,211 @@
+"""Resource-growth observability (telemetry/resources.py + the trend
+SLO class) — the ISSUE 18 leak-detection plane.
+
+The acceptance bars proven here:
+
+- the refcounted sampler reads real /proc figures and publishes the
+  ``sd_resource_*`` gauge families, with provider-fed inventories;
+- ``telemetry.reset()`` clears resource state (planted test leaks
+  released, last sample cleared) like every other telemetry plane;
+- a **planted leak** — a monotone fd series past the trend SLO's slope
+  bar — flips the ``resources`` health subsystem to unhealthy and opens
+  exactly ONE host-profiler capture window (hysteresis absorbs the
+  repeat evaluations);
+- ``SD_RESOURCES=0`` is a true no-op: no sampler thread, no trend
+  SLOs, no resource history series, health reads unknown.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from spacedrive_tpu import telemetry
+from spacedrive_tpu.telemetry import health, history, resources
+from spacedrive_tpu.telemetry import sampler as profiler
+from spacedrive_tpu.telemetry import slo
+
+
+def _writer(tmp_path, **kw) -> history.HistoryWriter:
+    return history.HistoryWriter(os.path.join(tmp_path, "hist"), **kw)
+
+
+# --- the sampler -----------------------------------------------------------
+
+
+def test_sample_once_reads_real_process_figures():
+    telemetry.reset()
+    vals = resources.SAMPLER.sample_once()
+    assert vals["rss_bytes"] > 0
+    assert vals["fds"] > 0
+    assert vals["threads"] >= 1
+    # every inventory kind is present (zero when no provider feeds it)
+    for kind in resources.INVENTORY_KINDS:
+        assert kind in vals
+    # published to the gauge families the federation compactor ships
+    assert telemetry.gauge_value("sd_resource_rss_bytes") == vals["rss_bytes"]
+    assert telemetry.gauge_value("sd_resource_fds") == vals["fds"]
+    assert resources.SAMPLER.last() == vals
+    assert resources.SAMPLER.sample_count() >= 1
+    telemetry.reset()
+
+
+def test_provider_registration_feeds_inventory_and_rejects_unknown():
+    telemetry.reset()
+    resources.SAMPLER.register_provider("journal_rows", lambda: 1234.0)
+    try:
+        vals = resources.SAMPLER.sample_once()
+        assert vals["journal_rows"] == 1234.0
+        assert telemetry.gauge_value(
+            "sd_resource_inventory", kind="journal_rows") == 1234.0
+    finally:
+        resources.SAMPLER.unregister_provider("journal_rows")
+    with pytest.raises(ValueError):
+        resources.SAMPLER.register_provider("not_a_kind", lambda: 0.0)
+    # a provider that raises must not poison the sample
+    resources.SAMPLER.register_provider(
+        "oplog_rows", lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    try:
+        vals = resources.SAMPLER.sample_once()
+        assert vals["rss_bytes"] > 0
+    finally:
+        resources.SAMPLER.unregister_provider("oplog_rows")
+    telemetry.reset()
+
+
+def test_refcounted_start_stop_spawns_one_thread():
+    telemetry.reset()
+    before = {t.name for t in threading.enumerate()}
+    assert "sd-resources" not in before
+    assert resources.SAMPLER.start() is True
+    assert resources.SAMPLER.start() is True  # second ref, same thread
+    try:
+        names = [t.name for t in threading.enumerate()]
+        assert names.count("sd-resources") == 1
+        resources.SAMPLER.stop()  # first deref: still running
+        assert resources.SAMPLER.running()
+    finally:
+        resources.SAMPLER.stop()
+    assert not resources.SAMPLER.running()
+    assert "sd-resources" not in {t.name for t in threading.enumerate()}
+    telemetry.reset()
+
+
+# --- telemetry.reset() clears the plane ------------------------------------
+
+
+def test_reset_releases_planted_leaks_and_clears_state():
+    telemetry.reset()
+    baseline = resources.fd_count()
+    resources.SAMPLER.leak_for_test(fds=8, mb=1)
+    assert resources.fd_count() >= baseline + 8
+    resources.SAMPLER.sample_once()
+    assert resources.SAMPLER.last()
+    telemetry.reset()
+    assert resources.fd_count() <= baseline + 1
+    assert resources.SAMPLER.last() == {}
+    assert resources.SAMPLER.last_ts() is None
+    assert resources.SAMPLER.sample_count() == 0
+
+
+# --- the planted leak ------------------------------------------------------
+
+
+def _plant_fd_leak(tmp_path, slope_per_h: float = 300.0):
+    """A history whose resource_fds series climbs at ``slope_per_h``:
+    16 samples over 15 min, past the 2 min warmup, well above the
+    50 fd/h default bar."""
+    w = _writer(tmp_path, samplers=None)
+    now = time.time()
+    per_sample = slope_per_h / 60.0  # one sample per simulated minute
+    for i in range(16):
+        fds = 100.0 + per_sample * i
+        w._samplers = {"resource_fds": (lambda v=fds: v),
+                       "resource_rss_mb": (lambda: 200.0)}
+        w.sample(now=now - 900 + i * 60)
+    return w
+
+
+def test_planted_leak_breaches_trend_slo(tmp_path):
+    telemetry.reset()
+    w = _plant_fd_leak(tmp_path)
+    evaluation = slo.evaluate(w)
+    docs = {s["name"]: s for s in evaluation["slos"]}
+    assert docs["fd_growth"]["status"] == slo.BREACH
+    trend = docs["fd_growth"]["windows"]["trend"]
+    assert trend["slope_per_h"] > 50.0
+    assert trend["warmup_excluded"] >= 1
+    # the flat RSS series stays quiet: growth bars fire on slopes,
+    # not on absolute footprint
+    assert docs["rss_growth"]["status"] == slo.OK
+    telemetry.reset()
+
+
+def test_planted_leak_flips_health_and_captures_once(tmp_path, monkeypatch):
+    """The acceptance bar: a trend breach → ``resources`` unhealthy →
+    exactly one profile capture, no matter how often health re-polls."""
+    telemetry.reset()
+    monkeypatch.setenv("SD_PROFILE_CAPTURE_S", "0.2")
+    monkeypatch.setenv("SD_PROFILE_COOLDOWN_S", "3600")
+    w = _plant_fd_leak(tmp_path)
+
+    class FakeNode:
+        history = w
+
+    profiler.SAMPLER.start()
+    try:
+        profiler.SAMPLER.reset()
+        resources.SAMPLER.sample_once()  # health wants a live sample
+        for _ in range(3):  # flapping health polls
+            health._slo(FakeNode)
+        verdict = health._resources()
+        assert verdict["status"] == health.UNHEALTHY
+        assert "fd_growth" in verdict["reason"]
+        full = health.evaluate(FakeNode)
+        assert full["subsystems"]["resources"]["status"] == health.UNHEALTHY
+        assert full["status"] == health.UNHEALTHY
+        assert telemetry.counter_value("sd_profile_captures_total") == 1
+        caps = profiler.SAMPLER.captures_snapshot()
+        assert len(caps) == 1 and caps[0]["reason"] == "slo_breach"
+    finally:
+        profiler.SAMPLER.stop()
+    telemetry.reset()
+
+
+def test_flat_series_stays_healthy(tmp_path):
+    telemetry.reset()
+    w = _writer(tmp_path, samplers={
+        "resource_fds": (lambda: 100.0), "resource_rss_mb": (lambda: 200.0)})
+    now = time.time()
+    for i in range(16):
+        w.sample(now=now - 900 + i * 60)
+
+    class FakeNode:
+        history = w
+
+    resources.SAMPLER.sample_once()
+    health._slo(FakeNode)
+    verdict = health._resources()
+    assert verdict["status"] == health.HEALTHY
+    assert verdict["signals"]["trends"]["fd_growth"]["status"] == slo.OK
+    telemetry.reset()
+
+
+# --- the kill knob ---------------------------------------------------------
+
+
+def test_sd_resources_zero_is_a_true_noop(monkeypatch):
+    telemetry.reset()
+    monkeypatch.setenv("SD_RESOURCES", "0")
+    assert not resources.enabled()
+    assert resources.SAMPLER.start() is False
+    assert not resources.SAMPLER.running()
+    assert "sd-resources" not in {t.name for t in threading.enumerate()}
+    assert {s.name for s in slo.default_slos()}.isdisjoint(
+        {"rss_growth", "fd_growth"})
+    assert not any(n.startswith("resource_")
+                   for n in history.default_samplers())
+    assert health._resources()["status"] == health.UNKNOWN
+    assert resources.SAMPLER.summary() == {"enabled": False}
+    telemetry.reset()

@@ -355,6 +355,9 @@ def test_all_ok_rolls_up_ok_not_no_data(tmp_path):
     for i in range(6):
         w.sample(now=now - 6 + i)
     doc = slo.evaluate(w, now=now)
-    assert all(s["status"] == slo.OK for s in doc["slos"])
+    # the resource trend SLOs have no series here and read no_data —
+    # rank 0, so they must not drag the rollup back down either
+    assert all(s["status"] == slo.OK for s in doc["slos"]
+               if s["kind"] != "trend")
     assert doc["status"] == slo.OK
     telemetry.reset()

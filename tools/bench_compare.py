@@ -55,6 +55,14 @@ warm media pass must beat cold by the recorded floor. Query latencies
 ride the artifact ungated — absolute milliseconds on an unknown CI box
 measure the box, not the index.
 
+BENCH_SCALE leg: when ``BENCH_SCALE.json`` exists (``make bench-scale``
+or ``make soak-smoke``), the churn-soak bars gate on every rig: zero
+trend-SLO breaches, zero protected-class sheds, bounded fd/RSS drift,
+and warm-pass throughput flatness — the gate re-derives the verdict
+from the recorded figures rather than trusting the artifact's own. The
+``--history`` leg additionally gates a least-squares growth slope over
+the continuous ``resource_rss_mb``/``resource_fds`` history series.
+
 Usage:
     python tools/bench_compare.py [--dir .] [--threshold 0.15] [old new]
 Exit codes: 0 ok / nothing to compare, 1 regression, 2 bad invocation.
@@ -481,6 +489,68 @@ def check_semantic(doc: dict[str, Any]) -> dict[str, Any]:
             "skipped": skipped}
 
 
+# bench-scale absolute bars — mirrored in bench_scale.py. The artifact
+# records its own verdict, but the gate re-derives it from the recorded
+# figures so a bench_scale.py bug can't silently wave a bad run through.
+SCALE_FD_DELTA_MAX = 32
+SCALE_RSS_DELTA_MAX_MB = 512.0
+SCALE_FLATNESS_MIN = 0.5
+
+
+def check_scale(doc: dict[str, Any]) -> dict[str, Any]:
+    """Gate a BENCH_SCALE document (same result shape as compare()).
+    Re-derives the soak verdict: zero trend-SLO breaches, zero
+    protected-class sheds, bounded fd/RSS drift over the run, and
+    warm-pass throughput flatness above the floor."""
+    checked: list[dict[str, Any]] = []
+    regressions: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    res = doc.get("resources") or {}
+
+    breaches = (doc.get("slo") or {}).get("breaches")
+    if not isinstance(breaches, list):
+        skipped.append("scale.slo_breaches: not recorded")
+    else:
+        rec = {"name": "scale.slo_breaches", "old": 0, "new": len(breaches),
+               "delta_pct": -100.0 if breaches else 0.0}
+        checked.append(rec)
+        if breaches:
+            regressions.append(rec)
+
+    sheds = doc.get("protected_sheds")
+    if not isinstance(sheds, int) or isinstance(sheds, bool):
+        skipped.append("scale.protected_sheds: not recorded")
+    else:
+        rec = {"name": "scale.protected_sheds", "old": 0, "new": sheds,
+               "delta_pct": -100.0 if sheds else 0.0}
+        checked.append(rec)
+        if sheds:
+            regressions.append(rec)
+
+    bars = [
+        # (name, value, bar, higher_is_better)
+        ("fd_delta", res.get("fd_delta"), SCALE_FD_DELTA_MAX, False),
+        ("rss_delta_mb", res.get("rss_delta_mb"),
+         SCALE_RSS_DELTA_MAX_MB, False),
+        ("flatness", (doc.get("throughput") or {}).get("flatness"),
+         SCALE_FLATNESS_MIN, True),
+    ]
+    for name, value, bar, higher in bars:
+        full = f"scale.{name}"
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            skipped.append(f"{full}: not recorded")
+            continue
+        value = abs(float(value)) if name == "fd_delta" else float(value)
+        margin = (value - bar) if higher else (bar - value)
+        rec = {"name": full, "old": bar, "new": round(value, 3),
+               "delta_pct": round(margin * 100, 2)}
+        checked.append(rec)
+        if margin < 0:
+            regressions.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "skipped": skipped}
+
+
 # --- telemetry-history leg (telemetry/history.py segment store) ------------
 
 #: history series gated as higher-is-better rates; idle (0) samples are
@@ -540,8 +610,52 @@ def check_history(directory: str,
             regressions.append(rec)
     _check_history_profile_shares(_history, directory, checked,
                                   regressions, skipped)
+    _check_history_growth(_history, directory, checked,
+                          regressions, skipped)
     return {"checked": checked, "regressions": regressions,
             "skipped": skipped}
+
+
+# resource-growth series (telemetry/resources.py sampler → history):
+# gated as a bounded least-squares slope over the CONTINUOUS record,
+# mirroring the trend-SLO bars (SD_SLO_RSS_MB_PER_H / SD_SLO_FD_PER_H
+# defaults) — a leak that lands between bench rounds still fails here.
+_HISTORY_GROWTH_SERIES = (
+    ("resource_rss_mb", 64.0),  # MB per hour
+    ("resource_fds", 50.0),     # descriptors per hour
+)
+
+
+def _check_history_growth(_history, directory: str,
+                          checked: list, regressions: list,
+                          skipped: list) -> None:
+    from spacedrive_tpu.telemetry.slo import _slope_per_h
+
+    for name, bar in _HISTORY_GROWTH_SERIES:
+        pts = _history.series(directory, name)
+        full = f"history.{name}.slope_per_h"
+        if len(pts) < HISTORY_MIN_SAMPLES:
+            skipped.append(
+                f"{full}: {len(pts)} samples "
+                f"(< {HISTORY_MIN_SAMPLES}) — nothing to gate"
+            )
+            continue
+        span_h = (pts[-1][0] - pts[0][0]) / 3600.0
+        if span_h < 0.25:
+            # a slope extrapolated from a few minutes of warmup is
+            # noise, not a leak — the trend SLO's warmup exclusion,
+            # applied to the offline record
+            skipped.append(
+                f"{full}: {span_h * 60:.1f} min span (< 15 min) — "
+                f"too short to extrapolate a per-hour slope"
+            )
+            continue
+        slope = _slope_per_h(pts)
+        rec = {"name": full, "old": bar, "new": round(slope, 3),
+               "delta_pct": round((bar - slope) / bar * 100, 2)}
+        checked.append(rec)
+        if slope > bar:
+            regressions.append(rec)
 
 
 # host-profiler frame-group shares (history `profile_share_*` series,
@@ -723,6 +837,19 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
             result = check_serve(sv_doc)
             render("BENCH_SERVE.json (absolute graceful-degradation bars)",
+                   result)
+            total_regressions += len(result["regressions"])
+        sc_path = os.path.join(args.dir, "BENCH_SCALE.json")
+        if os.path.exists(sc_path):
+            try:
+                with open(sc_path) as f:
+                    sc_doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"bench-compare: cannot read BENCH_SCALE JSON: {e}",
+                      file=sys.stderr)
+                return 2
+            result = check_scale(sc_doc)
+            render("BENCH_SCALE.json (absolute resource-growth bars)",
                    result)
             total_regressions += len(result["regressions"])
 
