@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: lint lint-changed test tier1 trace-smoke slo-smoke profile-smoke \
 	debug-bundle bench-devices bench-check bench-warm bench-autotune \
 	bench-mesh bench-procs bench-serve bench-semantic bench-scale \
-	search-smoke soak-smoke chaos
+	bench-continuum search-smoke soak-smoke chaos
 
 # set SDLINT_ANNOTATE=1 in CI for GitHub ::error annotations on the diff.
 # The selftest proves every rule still fires on its own fixture corpus
@@ -84,6 +84,18 @@ bench-mesh:
 bench-procs:
 	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=procs SD_E2E_FILES=4000 \
 		SD_E2E_REPEATS=3 SD_BENCH_WAIT=0 $(PY) bench_e2e.py
+
+# stage-typed execution continuum A/B: the SAME image corpus runs its
+# post-identify stages (thumbnail + embed) through the unified
+# scheduler purely local vs across 2 loopback nodes, procpool live in
+# BOTH arms, interleaved. Records per-stage files/s, scaling
+# efficiency, gap + gil_wait shares, and the live controller outputs
+# (per-stage rate EWMAs, lease targets, pool quantum) into
+# BENCH_CONTINUUM.json; `make bench-check` gates bit-identity
+# everywhere and the efficiency floor on ≥2-core rigs.
+bench-continuum:
+	env JAX_PLATFORMS=cpu SD_E2E_CONFIGS=continuum SD_E2E_IMAGES=64 \
+		SD_E2E_REPEATS=2 SD_BENCH_WAIT=0 $(PY) bench_e2e.py
 
 # semantic-plane bench: cold embed files/s (per-stage clocks, so the
 # rest of the media pass doesn't dilute it), the warm journal contract

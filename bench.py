@@ -37,6 +37,13 @@ import time
 
 import numpy as np
 
+
+def _procpool_procs() -> int:
+    """Live SD_PROCS pool size for the artifact's rig stamp."""
+    from spacedrive_tpu.parallel.procpool import procs
+
+    return procs()
+
 V5E_HBM_GBPS = 819.0  # v5e HBM roofline; device compute can't beat this
 CPU_BASELINE_CORES = 16  # the north star's CPU host (BASELINE.json)
 
@@ -385,6 +392,8 @@ def main() -> None:
             "cpu_1core_files_per_s": round(cpu1_fps, 1) if cpu1_fps else None,
             "cpu_16core_projected_files_per_s": round(cpu16_fps, 1) if cpu16_fps else None,
             "host_cores": host_cores,
+            "cpu_count": host_cores,
+            "procpool_procs": _procpool_procs(),
             "roofline_clamped": not roofline_ok,
             "regression_note": regression_note,
             # per-device-count throughput + scaling efficiency

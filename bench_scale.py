@@ -337,6 +337,14 @@ async def _boot(data_dir: str, corpus: str):
     return node, lib, loc["id"], port, time.monotonic() - t0
 
 
+def _rig_stamp() -> dict:
+    """cpu_count + live procpool size, stamped into the artifact so
+    comparators can tell honest-floor single-core recordings apart."""
+    from spacedrive_tpu.parallel.procpool import rig_stamp
+
+    return rig_stamp()
+
+
 def _flatness(passes: list[dict[str, float]]) -> float:
     """Last-half median files/s over first-half median: 1.0 is flat,
     below :data:`FLATNESS_MIN` means warm passes are getting slower —
@@ -448,7 +456,7 @@ async def run_soak(files: int | None = None, seconds: float | None = None,
             "schema": SCHEMA,
             "ts": time.time(),
             "host": {"platform": platform.platform(),
-                     "cpus": os.cpu_count()},
+                     "cpus": os.cpu_count(), **_rig_stamp()},
             "params": {"files": files, "seconds": seconds, "seed": seed,
                        "mix": mix, "p2p": p2p_on, "faults": faults_on,
                        "rounds": rounds,

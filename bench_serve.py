@@ -66,6 +66,14 @@ SHED_P99_MAX_S = 1.0
 WORKERS = 2
 
 
+def _rig_stamp() -> dict:
+    """cpu_count + live procpool size, stamped into the artifact so
+    comparators can tell honest-floor single-core recordings apart."""
+    from spacedrive_tpu.parallel.procpool import rig_stamp
+
+    return rig_stamp()
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -486,7 +494,7 @@ async def run() -> dict:
         doc = {
             "ts": time.time(),
             "host": {"platform": platform.platform(),
-                     "cpus": os.cpu_count()},
+                     "cpus": os.cpu_count(), **_rig_stamp()},
             "params": {"files": files, "seconds": seconds,
                        "slow_ms": slow_ms,
                        "capacity_clients": clients_capacity},

@@ -322,14 +322,13 @@ def _pool_hash(pool: Any, loc_path: str, jobs: list[tuple],
         time.perf_counter() - t_hash, stage="hash")
 
 
-async def execute_shard(node: Any, library: Any, location_pub: str | None,
-                        entries: list[dict], backend: str | None = None) \
-        -> list[dict]:
-    """Execute one shard against this node's replica. The location row
-    must exist here (it syncs like any row); a replica that has not
-    ingested it yet nudges its ingest actor and waits briefly — a still
-    -missing location raises, the caller skips, and the lease expires
-    back to the pool."""
+async def resolve_location(library: Any, location_pub: str | None) -> dict:
+    """Wait for a session's location row to exist on this replica. The
+    row syncs like any other; a replica that has not ingested it yet
+    nudges its ingest actor and waits briefly — a still-missing
+    location raises, the caller skips, and the lease expires back to
+    the pool. Shared by every stage executor (identify here, the rest
+    in ``stages.py``)."""
     location = None
     loc_pub_bytes = bytes.fromhex(location_pub) if location_pub else None
     for attempt in range(20):
@@ -344,6 +343,15 @@ async def execute_shard(node: Any, library: Any, location_pub: str | None,
         await asyncio.sleep(0.05)
     if location is None or not location.get("path"):
         raise RuntimeError(f"location {location_pub} not replicated here yet")
+    return location
+
+
+async def execute_shard(node: Any, library: Any, location_pub: str | None,
+                        entries: list[dict], backend: str | None = None) \
+        -> list[dict]:
+    """Execute one identify shard against this node's replica (the
+    stage-generic entry point is ``stages.execute_stage_shard``)."""
+    location = await resolve_location(library, location_pub)
     if backend is None:
         backend = "auto" if getattr(node, "use_device", False) else "cpu"
     return await asyncio.to_thread(
@@ -354,24 +362,29 @@ async def execute_shard(node: Any, library: Any, location_pub: str | None,
 class ShardTask(Task):
     """Local shard execution as a task-system unit: the coordinator's
     self-steal loop dispatches these so queue-wait/occupancy telemetry
-    and priority preemption cover mesh work like any other work."""
+    and priority preemption cover mesh work like any other work. Stage-
+    typed: the task routes to its shard's execution leg."""
 
     def __init__(self, node: Any, library: Any, location_pub: str,
-                 entries: list[dict], backend: str | None = None):
+                 entries: list[dict], backend: str | None = None,
+                 stage: str = "identify.hash"):
         super().__init__()
         self.node = node
         self.library = library
         self.location_pub = location_pub
         self.entries = entries
         self.backend = backend
+        self.stage = stage
         self.output: list[dict] | None = None
 
     async def run(self, interrupter: Interrupter) -> ExecStatus:
         if interrupter.check() is not None:
             return ExecStatus.CANCELED
-        self.output = await execute_shard(
-            self.node, self.library, self.location_pub, self.entries,
-            self.backend,
+        from .stages import execute_stage_shard
+
+        self.output = await execute_stage_shard(
+            self.node, self.library, self.location_pub, self.stage,
+            self.entries, self.backend,
         )
         return ExecStatus.DONE
 
@@ -467,9 +480,62 @@ async def distribute_location_index(
     session = make_session(
         library, location, shard_files=shard_files, lease_max_s=lease_max_s
     )
+    return await _drive_session(
+        node, library, session, backend=backend, deadline_s=deadline_s,
+        t0=t0,
+    )
+
+
+async def distribute_location_stages(
+    node: Any,
+    library: Any,
+    location_id: int,
+    stage_ids: list[str],
+    *,
+    shard_files: int | None = None,
+    lease_max_s: float | None = None,
+    backend: str | None = None,
+    deadline_s: float = 600.0,
+) -> dict[str, Any]:
+    """Distribute any set of post-identify pipeline stages for one
+    location as ONE multi-stage session (stage ids from
+    ``parallel/scheduler.py``). The stage drivers' distribute paths
+    (thumbnail actor, media processor, duplicates pHash, embed) are
+    thin wrappers over this. Degrades exactly like
+    ``distribute_location_index``: with no P2P runtime every stage
+    shard runs inline here, which IS today's pure-local pass in shard
+    clothing."""
+    from .stages import make_stage_session
+
+    t0 = time.perf_counter()
+    location = library.db.find_one("location", id=location_id)
+    if location is None or not location.get("path"):
+        raise ValueError(f"location {location_id} not found")
+    session = make_stage_session(
+        library, location, stage_ids,
+        shard_files=shard_files, lease_max_s=lease_max_s,
+    )
+    return await _drive_session(
+        node, library, session, backend=backend, deadline_s=deadline_s,
+        t0=t0,
+    )
+
+
+async def _drive_session(
+    node: Any, library: Any, session: Any, *,
+    backend: str | None, deadline_s: float, t0: float,
+) -> dict[str, Any]:
+    """Drive a published-ready session to completion: publish →
+    announce → self-steal through the task system → retire. Shared by
+    the identify pass and the stage-typed distribute paths."""
+    from .stages import execute_stage_shard
+
     manager = getattr(node, "p2p", None)
     plane = getattr(manager, "work", None)
     total_files = sum(len(s.entries) for s in session.shards.values())
+    by_stage: dict[str, int] = {}
+    for sh in session.shards.values():
+        by_stage[sh.stage] = by_stage.get(sh.stage, 0) + 1
     # with the multi-process plane live, the coordinator keeps several
     # shards in flight at once: one shard's owner-side SQL commit
     # overlaps another's worker-side hashing. SD_PROCS=0 keeps today's
@@ -479,15 +545,15 @@ async def distribute_location_index(
     width = _procpool.procs() if _procpool.get() is not None else 1
     if plane is None:
         # no P2P runtime: run every shard inline (still shard-shaped so
-        # the journal/link path is identical)
+        # the journal/link/vouch path is identical)
         if width > 1:
             sem = asyncio.Semaphore(width)
 
             async def _one_inline(shard: Any) -> None:
                 async with sem:
-                    await execute_shard(
+                    await execute_stage_shard(
                         node, library, session.location_pub,
-                        shard.entries, backend,
+                        shard.stage, shard.entries, backend,
                     )
 
             await asyncio.gather(*(
@@ -495,14 +561,14 @@ async def distribute_location_index(
             ))
         else:
             for shard in session.shards.values():
-                await execute_shard(
-                    node, library, session.location_pub, shard.entries,
-                    backend,
+                await execute_stage_shard(
+                    node, library, session.location_pub, shard.stage,
+                    shard.entries, backend,
                 )
         return {
             "session": session.id, "shards": len(session.shards),
             "files": total_files, "local_shards": len(session.shards),
-            "remote_shards": 0, "peers": {},
+            "remote_shards": 0, "peers": {}, "stages": by_stage,
             "seconds": round(time.perf_counter() - t0, 3),
         }
 
@@ -517,7 +583,7 @@ async def distribute_location_index(
         while not session.all_done():
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"mesh index session {session.id} incomplete after "
+                    f"mesh session {session.id} incomplete after "
                     f"{deadline_s}s ({session.pending()} shards pending)"
                 )
             _session, grant, _lease = plane.board.claim(
@@ -536,7 +602,7 @@ async def distribute_location_index(
             handles = [
                 (shard, node.task_system.dispatch(ShardTask(
                     node, library, session.location_pub, shard.entries,
-                    backend,
+                    backend, stage=shard.stage,
                 )))
                 for shard in grant
             ]
@@ -569,6 +635,7 @@ async def distribute_location_index(
         "local_shards": local_shards,
         "remote_shards": len(session.shards) - local_shards,
         "peers": by_peer,
+        "stages": by_stage,
         "seconds": round(time.perf_counter() - t0, 3),
     }
     WORK_EVENTS.emit(

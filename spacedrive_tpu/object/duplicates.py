@@ -302,3 +302,21 @@ def find_duplicates(library: Any, threshold: int = 8) -> list[dict[str, Any]]:
     for g in out:
         g["files"] = [f for oid in g["object_ids"] for f in by_object.get(oid, [])]
     return out
+
+
+async def distribute_phash(
+    node: Any, library: Any, location_id: int, **kwargs: Any,
+) -> dict[str, Any]:
+    """Distribute one location's duplicates-pHash pass as stage-typed
+    WORK shards (parallel/scheduler.py STAGE_PHASH): executors reuse
+    journal-vouched hashes, gray-decode through their own procpool, DCT
+    in one device batch, and ship the 8-byte hashes back — the
+    local-only ``object.phash`` column converges via the shipped
+    results. With no P2P runtime this IS a local pass in shard
+    clothing."""
+    from ..location.indexer.mesh import distribute_location_stages
+    from ..parallel import scheduler as _scheduler
+
+    return await distribute_location_stages(
+        node, library, location_id, [_scheduler.STAGE_PHASH], **kwargs
+    )

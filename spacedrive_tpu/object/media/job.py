@@ -476,3 +476,36 @@ class MediaProcessorJob(StatefulJob):
     async def finalize(self, ctx: JobContext) -> Any:
         ctx.progress(message="media processing complete", phase="done")
         return dict(self.run_metadata)
+
+
+async def distribute_media(
+    node: Any, library: Any, location_id: int, **kwargs: Any,
+) -> dict[str, Any]:
+    """Distribute one location's media-metadata extraction as
+    stage-typed WORK shards (parallel/scheduler.py STAGE_MEDIA). The
+    ``media_data`` table is node-local, so the shipped column results
+    are the convergence carrier; each node recomputes its journal
+    digest against its own object_id exactly like a local pass."""
+    from ...location.indexer.mesh import distribute_location_stages
+    from ...parallel import scheduler as _scheduler
+
+    return await distribute_location_stages(
+        node, library, location_id, [_scheduler.STAGE_MEDIA], **kwargs
+    )
+
+
+async def distribute_embeddings(
+    node: Any, library: Any, location_id: int, **kwargs: Any,
+) -> dict[str, Any]:
+    """Distribute one location's semantic-embedding pass as stage-typed
+    WORK shards (parallel/scheduler.py STAGE_EMBED): executors decode
+    through their own procpool, run the seed-deterministic forward in
+    one device batch, mint the same CRDT ops a local pass would, and
+    ship the vector blobs back for direct apply. No-op session when
+    SD_EMBED is disabled."""
+    from ...location.indexer.mesh import distribute_location_stages
+    from ...parallel import scheduler as _scheduler
+
+    return await distribute_location_stages(
+        node, library, location_id, [_scheduler.STAGE_EMBED], **kwargs
+    )

@@ -668,3 +668,20 @@ class Thumbnailer:
                     ),
                 }
             )
+
+
+async def distribute_thumbnails(
+    node: Any, library: Any, location_id: int, **kwargs: Any,
+) -> dict[str, Any]:
+    """Distribute one location's thumbnail pass across library peers as
+    stage-typed WORK shards (parallel/scheduler.py STAGE_THUMB): every
+    executor consults its own journal + store first, encodes through
+    its own procpool, and ships the webp bytes back so the
+    coordinator's store converges bit-identical. With no P2P runtime
+    this IS a local pass in shard clothing."""
+    from ....location.indexer.mesh import distribute_location_stages
+    from ....parallel import scheduler as _scheduler
+
+    return await distribute_location_stages(
+        node, library, location_id, [_scheduler.STAGE_THUMB], **kwargs
+    )

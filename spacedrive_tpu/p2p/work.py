@@ -112,10 +112,15 @@ WORK_POLICY = ResiliencePolicy(
 
 @dataclass
 class WorkShard:
-    """One leased unit: a batch of journal-keyed file entries."""
+    """One leased unit: a batch of journal-keyed file entries, typed by
+    the pipeline stage that executes it (``parallel/scheduler.py`` is
+    the stage vocabulary — identify.hash, thumb, media.extract, phash,
+    embed). Pre-continuum shards carried no stage; the default keeps
+    old wire bodies and tests meaning what they always meant."""
 
     id: str
-    entries: list[dict]  # {pub_id, mat, name, ext, size, identity}
+    entries: list[dict]  # {pub_id, mat, name, ext, ...} (stage-shaped)
+    stage: str = "identify.hash"
     state: str = AVAILABLE
     assignee: str | None = None
     lease_deadline: float = 0.0
@@ -125,7 +130,7 @@ class WorkShard:
     granted_to: set = field(default_factory=set)
 
     def to_wire(self) -> dict:
-        return {"id": self.id, "entries": self.entries}
+        return {"id": self.id, "stage": self.stage, "entries": self.entries}
 
 
 @dataclass
@@ -162,10 +167,24 @@ class WorkBoard:
 
     def publish(self, session: WorkSession) -> None:
         self.sessions[session.id] = session
-        _tm.WORK_SHARDS.inc(len(session.shards), result="published")
+        by_stage: dict[str, int] = {}
+        for sh in session.shards.values():
+            by_stage[sh.stage] = by_stage.get(sh.stage, 0) + 1
+        for st, n in by_stage.items():
+            # inline bounded conditional pins the stage label domain at
+            # the emit site (SD007): the scheduler registry is the
+            # entire vocabulary
+            _tm.WORK_SHARDS.inc(
+                n, result="published",
+                stage="identify.hash" if st == "identify.hash" else (
+                    "thumb" if st == "thumb" else (
+                        "media.extract" if st == "media.extract" else (
+                            "phash" if st == "phash" else (
+                                "embed" if st == "embed" else "other")))),
+            )
         WORK_EVENTS.emit(
             "publish", session=session.id, shards=len(session.shards),
-            library=str(session.library_id),
+            stages=sorted(by_stage), library=str(session.library_id),
         )
 
     def get(self, session_id: str) -> WorkSession | None:
@@ -178,6 +197,7 @@ class WorkBoard:
             return 0
         now = time.monotonic()
         n = 0
+        expired_by_stage: dict[str, int] = {}
         for shard in session.shards.values():
             if shard.assignee == "local":
                 # the coordinator's own in-flight execution: "peer
@@ -192,9 +212,18 @@ class WorkBoard:
                     peer=peer_label(shard.assignee or "?"),
                 )
                 shard.assignee = None
+                expired_by_stage[shard.stage] = (
+                    expired_by_stage.get(shard.stage, 0) + 1)
                 n += 1
-        if n:
-            _tm.WORK_SHARDS.inc(n, result="expired")
+        for st, cnt in expired_by_stage.items():
+            _tm.WORK_SHARDS.inc(
+                cnt, result="expired",
+                stage="identify.hash" if st == "identify.hash" else (
+                    "thumb" if st == "thumb" else (
+                        "media.extract" if st == "media.extract" else (
+                            "phash" if st == "phash" else (
+                                "embed" if st == "embed" else "other")))),
+            )
         return n
 
     def claim(
@@ -205,41 +234,63 @@ class WorkBoard:
         library_id: uuid.UUID | None = None,
         max_shards: int = 1,
         files_per_s: float = 0.0,
+        rates: dict | None = None,
         verdict: str = "unknown",
         local: bool = False,
     ) -> tuple[WorkSession | None, list[WorkShard], float]:
         """Lease up to ``max_shards`` to ``peer_id``. With no session id
-        the most recent open session FOR ``library_id`` is used (idle
-        peers steal without knowing session ids). A claimer is scoped
-        to the library its WORK header named — membership in library X
-        must never lease (or even reveal) library Y's shards. Returns
-        ``(session, shards, lease_seconds)`` — an empty grant with a
-        session means "drained or gated", with ``None`` "no work at
-        all"."""
+        the NEWEST open session FOR ``library_id`` that still has an
+        available shard is used (idle peers steal without knowing
+        session ids — and a newer fully-leased session must not mask an
+        older session's unclaimed shards). A claimer is scoped to the
+        library its WORK header named — membership in library X must
+        never lease (or even reveal) library Y's shards. ``rates`` is
+        the claimer's per-stage files/s self-report: grants prefer the
+        stages the claimer is fastest at, and each stage's lease
+        contribution is sized from its own rate (heterogeneous-fleet
+        scheduling); ``files_per_s`` stays as the stage-blind fallback.
+        Returns ``(session, shards, lease_seconds)`` — an empty grant
+        with a session means "drained or gated", with ``None`` "no work
+        at all"."""
         session = None
         if session_id is not None:
             session = self.sessions.get(session_id)
             if session is not None and library_id is not None \
                     and session.library_id != library_id:
                 return None, [], 0.0
+            if session is not None:
+                self.expire_leases(session.id)
         else:
-            open_sessions = [
-                s for s in self.sessions.values()
-                if not s.all_done()
-                and (library_id is None or s.library_id == library_id)
-            ]
-            if open_sessions:
-                session = max(open_sessions, key=lambda s: s.created_at)
+            open_sessions = sorted(
+                (
+                    s for s in self.sessions.values()
+                    if not s.all_done()
+                    and (library_id is None or s.library_id == library_id)
+                ),
+                key=lambda s: s.created_at, reverse=True,
+            )
+            for cand in open_sessions:
+                # expire before inspecting: a lapsed lease IS an
+                # available shard for the next claimer
+                self.expire_leases(cand.id)
+                if any(sh.state == AVAILABLE
+                       for sh in cand.shards.values()):
+                    session = cand
+                    break
+            else:
+                # everything in flight: poll against the newest open
+                # session (matches the historical behavior when no
+                # shard is available anywhere)
+                session = open_sessions[0] if open_sessions else None
         if session is None:
             return None, [], 0.0
-        self.expire_leases(session.id)
         if not local:
             # health-gated stealing: a peer the federated mesh view
             # calls unhealthy (or whose snapshot went stale — silence
             # is a symptom) gets nothing; a degraded peer gets one
             # small shard so it can prove itself without hoarding
             if verdict == "unhealthy":
-                _tm.WORK_SHARDS.inc(result="refused")
+                _tm.WORK_SHARDS.inc(result="refused", stage="any")
                 WORK_EVENTS.emit(
                     "claim_refused", session=session.id,
                     peer=peer_label(peer_id), verdict=verdict,
@@ -247,12 +298,16 @@ class WorkBoard:
                 return session, [], 0.0
             if verdict == "degraded":
                 max_shards = 1
-        grant: list[WorkShard] = []
-        for shard in session.shards.values():
-            if len(grant) >= max(1, max_shards):
-                break
-            if shard.state == AVAILABLE:
-                grant.append(shard)
+        avail = [
+            sh for sh in session.shards.values() if sh.state == AVAILABLE
+        ]
+        if rates:
+            # stable sort: the claimer's fastest stages first, board
+            # insertion order breaking ties — a CPU-rich peer drains
+            # the decode/encode stages, a chip-rich peer the device
+            # stages, and rate-less stages keep publish order
+            avail.sort(key=lambda sh: -float(rates.get(sh.stage) or 0.0))
+        grant: list[WorkShard] = avail[:max(1, max_shards)]
         spec = _faults.hit("p2p.steal", arg="claim")
         if spec is not None and spec.mode == "race":
             # double-lease an already-leased shard: the chaos proof
@@ -261,11 +316,32 @@ class WorkBoard:
                 if shard.state == LEASED and shard.assignee != peer_id:
                     grant.append(shard)
                     break
-        tput = files_per_s if files_per_s > 0 else DEFAULT_FILES_PER_S
-        n_files = sum(len(s.entries) for s in grant)
-        lease_s = min(
-            max(LEASE_MIN_S, n_files / tput * LEASE_SLACK),
-            session.lease_max_s,
+        from ..parallel import scheduler as _scheduler
+
+        by_stage: dict[str, int] = {}
+        for sh in grant:
+            by_stage[sh.stage] = by_stage.get(sh.stage, 0) + len(sh.entries)
+        n_files = sum(by_stage.values())
+        # per-stage lease sizing: each stage's contribution is sized
+        # from the claimer's rate FOR THAT STAGE (then the Controller's
+        # per-stage target, then the static default — inside
+        # lease_seconds_for); contributions sum because the claimer
+        # executes the grant serially, and the session clamp still caps
+        # the total. A single-stage grant reproduces the pre-continuum
+        # lease law bit-for-bit.
+        stage_leases: dict[str, float] = {}
+        for st, files_st in by_stage.items():
+            rate_st = float((rates or {}).get(st) or 0.0)
+            if rate_st <= 0:
+                rate_st = files_per_s
+            stage_leases[st] = _scheduler.lease_seconds_for(
+                st, files_st, rate_st, session.lease_max_s)
+        lease_s = (
+            min(sum(stage_leases.values()), session.lease_max_s)
+            if stage_leases
+            # empty grant: the historical floor (callers only read this
+            # when shards were granted, but the reply shape is stable)
+            else min(LEASE_MIN_S, session.lease_max_s)
         )
         if verdict == "degraded":
             lease_s = LEASE_MIN_S
@@ -277,12 +353,31 @@ class WorkBoard:
             shard.grants += 1
             shard.granted_to.add(peer_id)
             if not local:
-                _tm.WORK_STEALS.inc(peer=peer_label(peer_id))
+                st = shard.stage
+                _tm.WORK_STEALS.inc(
+                    peer=peer_label(peer_id),
+                    stage="identify.hash" if st == "identify.hash" else (
+                        "thumb" if st == "thumb" else (
+                            "media.extract" if st == "media.extract" else (
+                                "phash" if st == "phash" else (
+                                    "embed" if st == "embed"
+                                    else "other")))),
+                )
         if grant:
-            _tm.WORK_LEASE_SECONDS.observe(lease_s)
+            for st, stage_lease in stage_leases.items():
+                _tm.WORK_LEASE_SECONDS.observe(
+                    stage_lease,
+                    stage="identify.hash" if st == "identify.hash" else (
+                        "thumb" if st == "thumb" else (
+                            "media.extract" if st == "media.extract" else (
+                                "phash" if st == "phash" else (
+                                    "embed" if st == "embed"
+                                    else "other")))),
+                )
             WORK_EVENTS.emit(
                 "lease", session=session.id, peer=peer_label(peer_id),
                 shards=len(grant), files=n_files,
+                stages=sorted(by_stage),
                 lease_s=round(lease_s, 2), local=local,
             )
         return session, grant, lease_s
@@ -306,8 +401,16 @@ class WorkBoard:
             return "unknown"
         if not local and peer_id not in shard.granted_to:
             return "unknown"
+        st = shard.stage
         if shard.state == DONE:
-            _tm.WORK_SHARDS.inc(result="duplicate")
+            _tm.WORK_SHARDS.inc(
+                result="duplicate",
+                stage="identify.hash" if st == "identify.hash" else (
+                    "thumb" if st == "thumb" else (
+                        "media.extract" if st == "media.extract" else (
+                            "phash" if st == "phash" else (
+                                "embed" if st == "embed" else "other")))),
+            )
             WORK_EVENTS.emit(
                 "duplicate_complete", session=session_id, shard=shard_id,
                 peer=peer_label(peer_id),
@@ -317,7 +420,12 @@ class WorkBoard:
         shard.assignee = peer_id
         session.completed_by[shard_id] = peer_id
         _tm.WORK_SHARDS.inc(
-            result="completed_local" if local else "completed_remote"
+            result="completed_local" if local else "completed_remote",
+            stage="identify.hash" if st == "identify.hash" else (
+                "thumb" if st == "thumb" else (
+                    "media.extract" if st == "media.extract" else (
+                        "phash" if st == "phash" else (
+                            "embed" if st == "embed" else "other")))),
         )
         WORK_EVENTS.emit(
             "complete", session=session_id, shard=shard_id,
@@ -418,6 +526,17 @@ async def respond_work(stream: Any, node: Any, header: Any) -> None:
             w.msgpack({"error": "malformed WORK claim fields"})
             await w.flush()
             return
+        # the per-stage rate report is advisory (grant preference +
+        # lease sizing): a malformed one degrades to the stage-blind
+        # scalar instead of erroring the claim
+        raw_rates = body.get("rates")
+        rates: dict[str, float] = {}
+        if isinstance(raw_rates, dict):
+            for k, v in raw_rates.items():
+                try:
+                    rates[str(k)] = float(v)
+                except (TypeError, ValueError):
+                    continue
         session, shards, lease_s = plane.board.claim(
             body.get("session"), peer_id,
             # scope to the header's library (the one the membership
@@ -426,6 +545,7 @@ async def respond_work(stream: Any, node: Any, header: Any) -> None:
             library_id=header.library_id,
             max_shards=min(max_shards, MAX_SHARDS_PER_CLAIM),
             files_per_s=files_per_s,
+            rates=rates or None,
             verdict=verdict,
         )
         w.msgpack({
@@ -437,6 +557,15 @@ async def respond_work(stream: Any, node: Any, header: Any) -> None:
             "done": session.all_done() if session else True,
         })
     elif op == "complete":
+        # stage BEFORE complete: the shard's stage routes the merge,
+        # and the board row is the trusted source (never the wire body)
+        session = plane.board.get(str(body.get("session")))
+        shard_row = (
+            session.shards.get(str(body.get("shard")))
+            if session is not None else None
+        )
+        stage_id = shard_row.stage if shard_row is not None \
+            else "identify.hash"
         outcome = plane.board.complete(
             str(body.get("session")), str(body.get("shard")), peer_id,
             library_id=header.library_id,
@@ -444,15 +573,15 @@ async def respond_work(stream: Any, node: Any, header: Any) -> None:
         applied = 0
         if outcome in ("completed", "duplicate"):
             # merge the shipped results locally (idempotent): the
-            # coordinator gets cas rows + journal vouches even when the
-            # peer's own sync ops are still in flight — and a duplicate
-            # completion re-applies to the same state
-            from ..location.indexer.mesh import apply_remote_results
+            # coordinator gets cas rows / webp bytes / vectors +
+            # journal vouches even when the peer's own sync ops are
+            # still in flight — and a duplicate completion re-applies
+            # to the same state
+            from ..location.indexer.stages import apply_stage_results
 
-            session = plane.board.get(str(body.get("session")))
             if session is not None:
-                applied = apply_remote_results(
-                    node, session, body.get("results") or []
+                applied = apply_stage_results(
+                    node, session, stage_id, body.get("results") or []
                 )
         w.msgpack({"ok": True, "outcome": outcome, "applied": applied})
     elif op == "announce":
@@ -487,7 +616,6 @@ class MeshWorker:
         self.node = node
         self.manager = manager
         self._loops: dict[str, asyncio.Task] = {}  # session id -> loop
-        self._rate_ewma: float = 0.0  # observed files/s, claim sizing
         self.executed_shards = 0
         self.executed_files = 0
         self._stopped = False
@@ -509,14 +637,27 @@ class MeshWorker:
         self._loops[session_id] = task
 
     def observed_files_per_s(self) -> float:
-        """This node's throughput self-report for claim sizing: the
-        worker's own EWMA, falling back to the autotune-observed
-        identify rate (telemetry-derived) before any shard ran here."""
-        if self._rate_ewma > 0:
-            return self._rate_ewma
-        from ..parallel import autotune as _autotune
+        """This node's stage-blind throughput self-report (the legacy
+        claim-sizing scalar, kept as the fallback for stages missing
+        from the per-stage report): the identify EWMA the scheduler
+        keeps, falling back to the autotune-observed identify rate
+        before any shard ran here."""
+        from ..parallel import scheduler as _scheduler
 
-        return _autotune.observed_files_per_s("identify") or 0.0
+        return _scheduler.observed_files_per_s(_scheduler.STAGE_IDENTIFY)
+
+    def rates_report(self) -> dict[str, float]:
+        """Per-stage files/s self-report shipped with every claim (the
+        continuum's heterogeneous-fleet input): the scheduler's EWMAs
+        for every stage that has executed anything here."""
+        from ..parallel import scheduler as _scheduler
+
+        out: dict[str, float] = {}
+        for stage_id in _scheduler.STAGES:
+            rate = _scheduler.observed_files_per_s(stage_id)
+            if rate > 0:
+                out[stage_id] = round(rate, 3)
+        return out
 
     async def stop(self) -> None:
         self._stopped = True
@@ -529,7 +670,7 @@ class MeshWorker:
 
     async def _work_loop(self, coordinator: Any, library_id: uuid.UUID,
                          session_id: str) -> None:
-        from ..location.indexer.mesh import execute_shard
+        from ..location.indexer.stages import execute_stage_shard
 
         lib = self.node.libraries.get(library_id)
         if lib is None:
@@ -546,6 +687,7 @@ class MeshWorker:
                         "session": session_id,
                         "max_shards": MAX_SHARDS_PER_CLAIM,
                         "files_per_s": self.observed_files_per_s(),
+                        "rates": self.rates_report(),
                     }),
                 )
                 failures = 0
@@ -572,24 +714,19 @@ class MeshWorker:
                 return
             location_pub = resp.get("location_pub")
             for shard in shards:
-                t0 = time.monotonic()
+                stage_id = str(shard.get("stage") or "identify.hash")
                 try:
-                    results = await execute_shard(
-                        self.node, lib, location_pub, shard["entries"]
+                    # execute_stage_shard feeds scheduler.RATES — the
+                    # per-stage EWMA the next claim's report rides
+                    results = await execute_stage_shard(
+                        self.node, lib, location_pub, stage_id,
+                        shard["entries"],
                     )
                 except Exception:  # noqa: BLE001 - a bad shard must not kill the loop
                     logger.exception("shard %s execution failed", shard["id"])
                     continue
-                dt = time.monotonic() - t0
-                n = len(shard["entries"])
-                if dt > 0 and n:
-                    rate = n / dt
-                    self._rate_ewma = (
-                        rate if self._rate_ewma == 0
-                        else 0.7 * self._rate_ewma + 0.3 * rate
-                    )
                 self.executed_shards += 1
-                self.executed_files += n
+                self.executed_files += len(shard["entries"])
                 try:
                     await WORK_POLICY.call(
                         pid,

@@ -132,6 +132,23 @@ OCC_HIGH = 0.9
 #: idle pipeline is not evidence of anything)
 STEP_STREAK = 2
 
+#: pool-quantum control bands: when dispatch (serialize + queue put)
+#: eats this share of the batch roundtrip, the IPC tax dominates and a
+#: wider quantum amortizes it; a roundtrip past POOL_RT_SLOW_S says the
+#: quantum is hurting latency (and lease margins) and should shrink
+POOL_DISPATCH_SHARE = 0.15
+POOL_RT_SLOW_S = 2.0
+#: pool-scale bounds mirror the window-scale rationale: the static
+#: quantum is the floor, ≥8× stops amortizing anything real
+POOL_SCALE_MIN = 1.0
+POOL_SCALE_MAX = 8.0
+
+#: per-stage lease-target hysteresis: the Controller republishes a
+#: stage's lease target only when it moved ≥25% — lease sizing is a
+#: fallback path, not a hot loop, and jittery targets would spam the
+#: decision ring
+STAGE_LEASE_HYSTERESIS = 0.25
+
 WORKLOADS = ("identify", "thumbnail", "embed")
 
 
@@ -164,11 +181,15 @@ class PipelinePolicy:
     window_scale: float = 1.0
     #: additive adjustment to the feeder read-ahead depth
     depth_extra: int = 0
+    #: multiplier on the static procpool batch quantum (its own knob:
+    #: the pool's IPC tax and the host window amortize different costs)
+    pool_scale: float = 1.0
 
     def reset(self) -> None:
         self.rung = len(BATCH_LADDER) - 1
         self.window_scale = 1.0
         self.depth_extra = 0
+        self.pool_scale = 1.0
 
     # ---- derived sizes (the seam every consumer reads) ---------------
 
@@ -207,10 +228,10 @@ class PipelinePolicy:
     def procpool_batch_rows(self) -> int:
         """Entries per multi-process-pool round-trip (the execute leg's
         per-stage shipping quantum — parallel/procpool.py). An explicit
-        ``SD_PROCS_BATCH`` pins it; otherwise the window scale the
-        controller already maintains for this workload widens pool
-        batches exactly when it widens host windows (both amortize a
-        per-batch tax against observed starvation)."""
+        ``SD_PROCS_BATCH`` pins it; otherwise the controller's
+        ``pool_scale`` knob sizes it from observed per-batch dispatch /
+        roundtrip deltas (``_tick_pool``) — growing when the IPC tax
+        dominates, shrinking on slow or underfilled batches."""
         explicit = os.environ.get("SD_PROCS_BATCH")
         if explicit:
             try:
@@ -219,7 +240,7 @@ class PipelinePolicy:
                 pass
         if not enabled():
             return PROCPOOL_BATCH_ROWS
-        return max(8, int(PROCPOOL_BATCH_ROWS * self.window_scale))
+        return max(8, int(PROCPOOL_BATCH_ROWS * self.pool_scale))
 
     def feeder_depth(self, n_devices: int = 1) -> int:
         """In-flight feeder windows (read live by WindowPipeline, so a
@@ -239,6 +260,8 @@ class PipelinePolicy:
             "rows_per_device": self.dispatch_rows_per_device(),
             "window_scale": round(self.window_scale, 3),
             "depth_extra": self.depth_extra,
+            "pool_scale": round(self.pool_scale, 3),
+            "pool_quantum": self.procpool_batch_rows(),
         }
 
 
@@ -257,6 +280,11 @@ class Sample:
     link_gbps: float = 0.0             # latest probe; 0 = no probe yet
     loop_lag_s: float = 0.0
     demotion_level: int = 0
+    # procpool per-batch deltas this tick (owner-side series)
+    pool_batches: int = 0
+    pool_dispatch_s: float = 0.0
+    pool_roundtrip_s: float = 0.0
+    pool_rows: float = 0.0
 
 
 #: which occupancy `op` label feeds each workload's rung control
@@ -282,6 +310,11 @@ class Controller:
         self._prev: dict[str, Any] | None = None
         # (workload, knob) -> signed streak of same-direction wishes
         self._streaks: dict[tuple[str, str], int] = {}
+        # execution-continuum outputs: per-stage observed rate (folded
+        # from scheduler.RATES each tick) and the derived lease target
+        # the WORK board falls back to when a claimer reports no rate
+        self.stage_rates: dict[str, float] = {}
+        self.stage_lease: dict[str, float] = {}
         self._task: Any = None
         self._tasks: set = set()
         self._stopped = False
@@ -353,8 +386,22 @@ class Controller:
             self._prev = None
             self._streaks.clear()
             self.ticks = 0
+            self.stage_rates.clear()
+            self.stage_lease.clear()
         for w, p in self.policies.items():
             self._export_gauges(w, p)
+
+    def stage_rate(self, stage_id: str) -> float:
+        """The Controller's per-stage rate output (files/s) — 0.0 until
+        the stage has executed shards here. The WORK board's lease
+        fallback when a claimer self-reports no rate for a stage."""
+        return self.stage_rates.get(stage_id, 0.0)
+
+    def reset_stage_targets(self) -> None:
+        """Clears the continuum state (scheduler.reset() fans out here;
+        telemetry.reset() zeroes the gauges themselves)."""
+        self.stage_rates.clear()
+        self.stage_lease.clear()
 
     # ---- sampling ----------------------------------------------------
 
@@ -373,6 +420,9 @@ class Controller:
             "occ": occ,
             "link": gauge_value("sd_bench_link_probe_gbps"),
             "lag": gauge_value("sd_event_loop_lag_seconds"),
+            "pool_dispatch": _tm.PROCPOOL_DISPATCH_SECONDS.stats(),
+            "pool_rt": _tm.PROCPOOL_ROUNDTRIP_SECONDS.stats(),
+            "pool_rows": _tm.PROCPOOL_BATCH_ROWS.stats(),
         }
 
     def sample(self) -> Sample:
@@ -403,6 +453,12 @@ class Controller:
             ds = cur["occ"][op]["sum"] - prev["occ"][op]["sum"]
             s.occ_n[op] = dn
             s.occ_mean[op] = (ds / dn) if dn > 0 else None
+        s.pool_batches = int(
+            cur["pool_rt"]["count"] - prev["pool_rt"]["count"])
+        s.pool_dispatch_s = (
+            cur["pool_dispatch"]["sum"] - prev["pool_dispatch"]["sum"])
+        s.pool_roundtrip_s = cur["pool_rt"]["sum"] - prev["pool_rt"]["sum"]
+        s.pool_rows = cur["pool_rows"]["sum"] - prev["pool_rows"]["sum"]
         return s
 
     # ---- the control law ---------------------------------------------
@@ -419,6 +475,7 @@ class Controller:
             decisions: list[dict[str, Any]] = []
             for workload, pol in self.policies.items():
                 decisions.extend(self._tick_workload(workload, pol, sample))
+            decisions.extend(self._tick_stages(sample))
         return decisions
 
     def _tick_workload(
@@ -568,6 +625,111 @@ class Controller:
                         ("pad-waste" if want < 0 else "saturate"),
                     ))
                     pol.rung = new_rung
+
+        out.extend(self._tick_pool(workload, pol, s))
+        return out
+
+    def _tick_pool(
+        self, workload: str, pol: PipelinePolicy, s: Sample
+    ) -> list[dict[str, Any]]:
+        """Procpool batch-quantum control (the execution continuum's
+        IPC leg). Evidence is the owner-side per-batch deltas — shared
+        across workloads because the pool is, so each workload's knob
+        sees the same signal but keeps its own damped streak:
+
+        - **slow roundtrips** (mean submit→result past
+          ``POOL_RT_SLOW_S``): the quantum is hurting latency — and a
+          stolen shard's lease margin — so shrink toward static;
+        - **underfilled** (mean rows under half the current quantum
+          while scaled up): call sites aren't producing batches that
+          size, so the scale buys nothing — decay;
+        - **IPC tax** (dispatch time ≥ ``POOL_DISPATCH_SHARE`` of the
+          roundtrip while roundtrips are fast): serialization + queue
+          overhead dominates — widen the quantum to amortize it."""
+        if s.pool_batches <= 0:
+            want: int | None = None  # idle pool: silence, not evidence
+            reason = ""
+        else:
+            rt_mean = s.pool_roundtrip_s / s.pool_batches
+            rows_mean = s.pool_rows / s.pool_batches
+            share = (s.pool_dispatch_s / s.pool_roundtrip_s
+                     if s.pool_roundtrip_s > 0 else 0.0)
+            if rt_mean >= POOL_RT_SLOW_S and pol.pool_scale > POOL_SCALE_MIN:
+                want, reason = -1, "slow-roundtrip"
+            elif (rows_mean < 0.5 * pol.procpool_batch_rows()
+                    and pol.pool_scale > POOL_SCALE_MIN):
+                want, reason = -1, "underfilled"
+            elif share >= POOL_DISPATCH_SHARE and rt_mean < POOL_RT_SLOW_S:
+                want, reason = +1, "ipc-tax"
+            else:
+                want, reason = 0, ""
+        if not self._step(workload, "pool", want):
+            return []
+        new = pol.pool_scale * (2.0 if want > 0 else 0.5)
+        new = min(POOL_SCALE_MAX, max(POOL_SCALE_MIN, new))
+        if new == pol.pool_scale:
+            return []
+        decision = self._apply(
+            workload, pol, "pool_scale", pol.pool_scale, new, s, reason)
+        pol.pool_scale = new
+        return [decision]
+
+    def _tick_stages(self, s: Sample) -> list[dict[str, Any]]:
+        """Per-stage lease targets (the continuum's WORK-board output):
+        fold the scheduler's per-stage throughput EWMAs into the lease
+        a default-sized shard would need at that rate, clamped to the
+        board's lease law bounds. Republished only past the hysteresis
+        band — lease sizing is a fallback path, not a hot loop."""
+        from ..p2p import work as _work
+        from . import scheduler as _scheduler
+
+        out: list[dict[str, Any]] = []
+        try:
+            from ..location.indexer.mesh import shard_files_default
+
+            files = shard_files_default()
+        except Exception:  # noqa: BLE001 - sizing default is fine
+            files = 128
+        for stage_id in _scheduler.STAGES:
+            rate = _scheduler.RATES.rate(stage_id)
+            if rate <= 0:
+                continue
+            self.stage_rates[stage_id] = rate
+            target = min(
+                _work.LEASE_MAX_S,
+                max(_work.LEASE_MIN_S, files / rate * _work.LEASE_SLACK),
+            )
+            old = self.stage_lease.get(stage_id)
+            if old is not None and old > 0 \
+                    and abs(target - old) <= STAGE_LEASE_HYSTERESIS * old:
+                continue
+            self.stage_lease[stage_id] = target
+            from ..telemetry import metrics as _tm
+            from ..telemetry.events import AUTOTUNE_EVENTS
+
+            AUTOTUNE_EVENTS.emit(
+                "stage-lease",
+                stage=stage_id,
+                rate_files_per_s=round(rate, 3),
+                old=None if old is None else round(old, 3),
+                new=round(target, 3),
+            )
+            # inline bounded conditional pins the label domain at the
+            # emit site (SD007): the stage registry is the vocabulary
+            _tm.WORK_STAGE_LEASE_TARGET.set(
+                target,
+                stage="identify.hash" if stage_id == "identify.hash" else (
+                    "thumb" if stage_id == "thumb" else (
+                        "media.extract" if stage_id == "media.extract" else (
+                            "phash" if stage_id == "phash" else (
+                                "embed" if stage_id == "embed"
+                                else "other")))),
+            )
+            out.append({
+                "knob": "stage_lease", "stage": stage_id,
+                "from": old, "to": target,
+                "rate_files_per_s": round(rate, 3),
+            })
         return out
 
     @staticmethod
@@ -648,6 +810,7 @@ class Controller:
         scale = new if knob == "window_scale" else pol.window_scale
         rung = new if knob == "rung" else pol.rung
         extra = new if knob == "depth_extra" else pol.depth_extra
+        pscale = new if knob == "pool_scale" else pol.pool_scale
         # inline bounded conditionals pin the label domain at each
         # emit site (SD007): WORKLOADS is the entire vocabulary
         _tm.AUTOTUNE_WINDOW_SCALE.set(
@@ -662,15 +825,29 @@ class Controller:
             float(extra),
             workload="identify" if workload == "identify"
             else ("thumbnail" if workload == "thumbnail" else "embed"))
+        _tm.AUTOTUNE_POOL_SCALE.set(
+            float(pscale),
+            workload="identify" if workload == "identify"
+            else ("thumbnail" if workload == "thumbnail" else "embed"))
 
     def snapshot(self) -> dict[str, Any]:
         """Current knob state — embedded in health.evaluate() so the
-        federation snapshot carries autotune state onto GET /mesh."""
+        federation snapshot carries autotune state onto GET /mesh,
+        including the execution continuum's per-stage rates and lease
+        targets (the Controller's WORK-board outputs)."""
+        from . import scheduler as _scheduler
+
         return {
             "enabled": enabled(),
             "ticks": self.ticks,
             "policies": {
                 w: p.snapshot() for w, p in self.policies.items()
+            },
+            "stages": {
+                **_scheduler.snapshot(),
+                "lease_targets": {
+                    st: round(v, 3) for st, v in self.stage_lease.items()
+                },
             },
         }
 

@@ -102,6 +102,16 @@ def enabled() -> bool:
     return procs() > 0
 
 
+def rig_stamp() -> dict:
+    """Host execution-rig facts stamped into every BENCH_*.json so a
+    comparator can tell an honest-floor single-core run from a real
+    scaling regression before gating any parallelism ratio."""
+    return {
+        "cpu_count": os.cpu_count() or 1,
+        "procpool_procs": procs(),
+    }
+
+
 class ProcPoolError(RuntimeError):
     """A pool-side failure (worker error, death past the retry budget,
     pool stopped). Call sites catch this and fall back inline — the

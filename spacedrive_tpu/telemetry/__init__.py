@@ -76,6 +76,11 @@ def reset() -> None:
     from ..location.indexer.journal import reset_runtime
 
     reset_runtime()
+    # the execution continuum's per-stage throughput EWMAs and the
+    # Controller's derived lease targets are registry-like state too
+    from ..parallel import scheduler as _scheduler
+
+    _scheduler.reset()
 
 
 def trace_export(trace_id=None):

@@ -300,25 +300,43 @@ FED_PEERS = REGISTRY.gauge(
 
 WORK_SHARDS = REGISTRY.counter(
     "sd_work_shards_total",
-    "distributed index work shards by outcome: published (added to a "
+    "distributed work shards by outcome: published (added to a "
     "session), completed_local / completed_remote (first completion, by "
     "executor side), duplicate (a re-stolen or raced shard completed "
     "again — idempotent merge absorbed it), expired (lease deadline "
     "passed; shard returned to the steal pool), refused (claim denied "
-    "by health verdict or breaker)",
-    labels=("result",),
+    "by health verdict or breaker). `stage` is the shard's pipeline "
+    "stage from the scheduler registry ('any' when the outcome has no "
+    "shard context, e.g. a refused claim)",
+    labels=("result", "stage"),
 )
 WORK_STEALS = REGISTRY.counter(
     "sd_work_steals_total",
     "shards leased to remote peers (one increment per shard per grant), "
-    "labeled by the claiming peer's short-hash",
-    labels=("peer",),
+    "labeled by the claiming peer's short-hash and the shard's stage",
+    labels=("peer", "stage"),
 )
 WORK_LEASE_SECONDS = REGISTRY.histogram(
     "sd_work_lease_seconds",
-    "lease durations granted to shard claims (sized from the peer's "
-    "observed throughput and its /mesh health verdict)",
+    "lease durations granted to shard claims (sized per stage from the "
+    "claimer's self-reported throughput, the Controller's per-stage "
+    "target, or the static default — in that order)",
+    labels=("stage",),
     buckets=(1, 5, 10, 30, 60, 120, 300),
+)
+WORK_STAGE_RATE = REGISTRY.gauge(
+    "sd_work_stage_rate_files_per_s",
+    "per-stage shard throughput EWMA observed by this node's executors "
+    "(the execution continuum's lease-sizing input; see "
+    "parallel/scheduler.py)",
+    labels=("stage",),
+)
+WORK_STAGE_LEASE_TARGET = REGISTRY.gauge(
+    "sd_work_stage_lease_target_seconds",
+    "the Controller's per-stage lease target: the lease a default-sized "
+    "shard would get at the stage's observed rate (0 until the stage "
+    "has run; the WORK board's fallback when a claimer reports no rate)",
+    labels=("stage",),
 )
 
 # --- resilience + fault plane (utils/resilience.py + utils/faults.py) -------
@@ -413,6 +431,14 @@ AUTOTUNE_RUNG = REGISTRY.gauge(
 AUTOTUNE_DEPTH_EXTRA = REGISTRY.gauge(
     "sd_autotune_depth_extra",
     "additive adjustment the autotuner applies to the feeder depth",
+    labels=("workload",),
+)
+AUTOTUNE_POOL_SCALE = REGISTRY.gauge(
+    "sd_autotune_pool_scale",
+    "current multiplier on the static procpool batch quantum (the "
+    "Controller grows it when the per-batch dispatch share says the "
+    "IPC tax dominates, shrinks it on long roundtrips or underfilled "
+    "batches)",
     labels=("workload",),
 )
 

@@ -179,7 +179,8 @@ def test_board_lease_expiry_and_resteal():
     board = WorkBoard()
     session = _session(n_shards=2, lease_max_s=60.0)
     board.publish(session)
-    assert counter_value("sd_work_shards_total", result="published") == 2
+    assert counter_value("sd_work_shards_total", result="published",
+                         stage="identify.hash") == 2
 
     got, grant, lease_s = board.claim(session.id, "peer-1", max_shards=2,
                                       files_per_s=1000.0)
@@ -189,8 +190,8 @@ def test_board_lease_expiry_and_resteal():
     # the steal was counted per-peer (hashed label)
     from spacedrive_tpu.telemetry.peers import peer_label
 
-    assert counter_value("sd_work_steals_total",
-                         peer=peer_label("peer-1")) == 2
+    assert counter_value("sd_work_steals_total", peer=peer_label("peer-1"),
+                         stage="identify.hash") == 2
 
     # nothing left to claim while the lease is live
     _s, more, _l = board.claim(session.id, "peer-2", max_shards=2)
@@ -207,7 +208,8 @@ def test_board_lease_expiry_and_resteal():
     # completion: first wins, the duplicate is counted and absorbed
     assert board.complete(session.id, "s0", "peer-2") == "completed"
     assert board.complete(session.id, "s0", "peer-1") == "duplicate"
-    assert counter_value("sd_work_shards_total", result="duplicate") == 1
+    assert counter_value("sd_work_shards_total", result="duplicate",
+                         stage="identify.hash") == 1
     assert board.complete(session.id, "s1", "peer-2") == "completed"
     assert session.all_done()
     assert session.shards["s0"].state == DONE
@@ -226,7 +228,8 @@ def test_board_health_gated_claims():
     _s, grant, _l = board.claim(session.id, "sick", max_shards=4,
                                 verdict="unhealthy")
     assert grant == []
-    assert counter_value("sd_work_shards_total", result="refused") == 1
+    assert counter_value("sd_work_shards_total", result="refused",
+                         stage="any") == 1
 
     # degraded: one shard, minimum lease — it may prove itself slowly
     _s, grant, lease_s = board.claim(session.id, "slow", max_shards=4,
@@ -364,12 +367,14 @@ async def test_distributed_index_matches_single_node(tmp_path):
         assert stats["remote_shards"] > 0, stats
         assert b.p2p.work.worker.executed_shards > 0
         assert counter_value("sd_work_shards_total",
-                             result="completed_remote") > 0
+                             result="completed_remote",
+                             stage="identify.hash") > 0
         from spacedrive_tpu.telemetry.peers import peer_label
 
         assert counter_value(
             "sd_work_steals_total",
             peer=peer_label(str(b.p2p.p2p.remote_identity)),
+            stage="identify.hash",
         ) > 0
 
         # coordinator replica: bit-identical observable state
@@ -417,7 +422,8 @@ async def test_peer_death_mid_lease_converges(tmp_path):
     try:
         assert plan.activations().get("p2p.steal", 0) >= 1
         # the abandoned lease expired and its shards were re-stolen
-        assert counter_value("sd_work_shards_total", result="expired") >= 1
+        assert counter_value("sd_work_shards_total", result="expired",
+                             stage="identify.hash") >= 1
         assert content_map(lib_a, loc["id"]) == ref_content
         assert object_grouping(lib_a, loc["id"]) == ref_groups
         assert journal_map(lib_a, loc["id"]) == ref_journal

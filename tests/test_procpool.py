@@ -92,18 +92,36 @@ def test_worker_error_fails_future_pool_survives(pool):
 
 
 def test_crash_fault_restarts_once_and_redispatches(pool):
-    before = counter_value("sd_procpool_restarts_total")
-    plan = faults.FaultPlan.parse("procpool.worker:crash:times=1", seed=3)
-    with faults.active(plan):
-        out = pool.request("echo", {"v": 42})
-    assert out == {"v": 42}
-    assert plan.activations().get("procpool.worker") == 1
-    assert counter_value("sd_procpool_restarts_total") == before + 1
-    assert counter_value("sd_procpool_jobs_total", result="retried") >= 1
-    deadline = time.monotonic() + 10
-    while pool.worker_count() < 2 and time.monotonic() < deadline:
-        time.sleep(0.05)
-    assert pool.worker_count() == 2
+    # The SIGKILL races the echo answer: on a loaded box the worker can
+    # answer before the kill lands, leaving nothing in flight for the
+    # reaper to re-dispatch. That interleaving is benign (the caller got
+    # its result and the dead worker still restarts) but proves nothing
+    # about re-dispatch — re-arm and try again until the kill wins. The
+    # restart counter itself is bumped by the reader thread AFTER the
+    # future resolves, so it is polled, never read-once.
+    for _ in range(5):
+        before = counter_value("sd_procpool_restarts_total")
+        retried0 = counter_value("sd_procpool_jobs_total", result="retried")
+        plan = faults.FaultPlan.parse(
+            "procpool.worker:crash:times=1", seed=3)
+        with faults.active(plan):
+            out = pool.request("echo", {"v": 42})
+        assert out == {"v": 42}
+        assert plan.activations().get("procpool.worker") == 1
+        deadline = time.monotonic() + 10
+        while counter_value("sd_procpool_restarts_total") < before + 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert counter_value("sd_procpool_restarts_total") == before + 1
+        deadline = time.monotonic() + 10
+        while pool.worker_count() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pool.worker_count() == 2
+        if counter_value("sd_procpool_jobs_total", result="retried") \
+                > retried0:
+            return
+    pytest.fail("kill never beat the echo answer in 5 attempts — "
+                "re-dispatch path not exercised")
 
 
 def test_stall_fault_delays_inside_worker(pool):

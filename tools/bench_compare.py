@@ -139,10 +139,27 @@ def compare(old: dict[str, Any], new: dict[str, Any],
 
 
 _E2E_CONFIGS = ("config1", "config3", "config4", "config5", "config_warm",
-                "config_mesh")
+                "config_mesh", "config_mesh_procs", "config_continuum")
 # higher-is-better ratio series gated alongside the rates
 _E2E_RATIOS = ("journal_hit_rate", "warm_speedup_vs_cold", "scaling",
                "scaling_efficiency")
+# parallelism ratios that only mean something on a multi-core rig: on
+# one core N in-process nodes / pool workers time-slice a single GIL
+# and the recorded ratio measures plane overhead, not the design's
+# scaling — such recordings are honest floors, never gate material
+_SCALING_KEYS = ("scaling", "scaling_efficiency", "pool_vs_single",
+                 "per_worker_efficiency")
+
+
+def _rig_cores(sec: dict[str, Any]) -> int:
+    """Core count a config section was recorded on (rig_stamp's
+    cpu_count, falling back to the older host_cores stamp). 0 when the
+    artifact predates both stamps — treated as unknown, not single."""
+    for key in ("cpu_count", "host_cores"):
+        v = sec.get(key)
+        if isinstance(v, int) and not isinstance(v, bool):
+            return v
+    return 0
 # rates that lean on a link-bound COLD leg: excused (only these) when a
 # non-link-bound config ran under congestion (``link_context`` stamp —
 # bench_e2e.probed(link_bound=False)). The headline warm/mesh rates move
@@ -266,6 +283,16 @@ def compare_e2e(old: dict[str, Any], new: dict[str, Any],
                 f"{name}: cold-leg rate with congested-link context"
             )
             continue
+        if key in _SCALING_KEYS:
+            oc = _rig_cores(old.get(cfg) or {})
+            nc = _rig_cores(new.get(cfg) or {})
+            if 0 < min(oc or 99, nc or 99) < 2:
+                skipped.append(
+                    f"{name}: recorded on a single-core rig — "
+                    "honest-floor recording, scaling ratios ungated "
+                    "(config_mesh precedent)"
+                )
+                continue
         ov, nv = old_s[name], new_s[name]
         if ov <= 0:
             skipped.append(f"{name}: non-positive baseline {ov}")
@@ -430,6 +457,72 @@ def check_procs(doc: dict[str, Any]) -> dict[str, Any]:
         # the plane's whole thesis: the pool must SHRINK the
         # unattributed-gap + gil_wait share, not just the wall clock
         if tot_p >= tot_s:
+            regressions.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "skipped": skipped}
+
+
+# bench_e2e config_continuum's absolute bars (mirrored there; this
+# gate re-derives the verdict from the recorded figures). Bit-identity
+# (webp bytes + embedding vectors across every arm of every repeat)
+# gates on EVERY rig: distribution that changes stage output is a
+# correctness regression regardless of core count. The efficiency
+# floor and the gap+gil-shrink bar gate only on >=2-core recordings
+# (the config_mesh / config_procs precedent). The floor is
+# config_mesh_procs' recorded scaling_efficiency: the unified
+# scheduler must beat the plane it fused.
+CONTINUUM_EFF_MIN = 0.302
+
+
+def check_continuum(doc: dict[str, Any]) -> dict[str, Any]:
+    """Gate a BENCH_CONTINUUM document (same result shape as
+    compare())."""
+    checked: list[dict[str, Any]] = []
+    regressions: list[dict[str, Any]] = []
+    skipped: list[str] = []
+    identical = doc.get("identical")
+    rec = {"name": "continuum.identical", "old": 1,
+           "new": 1 if identical else 0,
+           "delta_pct": 0.0 if identical else -100.0}
+    checked.append(rec)
+    if not identical:
+        regressions.append(rec)
+    cores = _rig_cores(doc)
+    if cores < 2:
+        skipped.append(
+            f"continuum.scaling_efficiency: recorded on a {cores}-core "
+            "rig — honest-floor recording, scaling bars ungated "
+            "(config_mesh precedent)"
+        )
+        return {"checked": checked, "regressions": regressions,
+                "skipped": skipped}
+    eff = doc.get("scaling_efficiency")
+    if not isinstance(eff, (int, float)) or isinstance(eff, bool):
+        skipped.append("continuum.scaling_efficiency: missing")
+    else:
+        rec = {"name": "continuum.scaling_efficiency",
+               "old": CONTINUUM_EFF_MIN, "new": round(float(eff), 3),
+               "delta_pct": round((float(eff) - CONTINUUM_EFF_MIN) * 100,
+                                  2)}
+        checked.append(rec)
+        if eff <= CONTINUUM_EFF_MIN:
+            regressions.append(rec)
+    shares_l = [doc.get("gap_share_local"), doc.get("gil_share_local")]
+    shares_m = [doc.get("gap_share_mesh"), doc.get("gil_share_mesh")]
+    if all(not isinstance(v, (int, float)) for v in shares_l):
+        skipped.append(
+            "continuum.gap_gil_share: not recorded (profiler off)")
+    else:
+        tot_l = sum(v for v in shares_l if isinstance(v, (int, float)))
+        tot_m = sum(v for v in shares_m if isinstance(v, (int, float)))
+        rec = {"name": "continuum.gap_gil_share", "old": round(tot_l, 4),
+               "new": round(tot_m, 4),
+               "delta_pct": round((tot_m - tot_l) * 100, 2)}
+        checked.append(rec)
+        # the continuum's thesis: distributing the stage legs must
+        # SHRINK the unattributed-gap + gil_wait share, not just move
+        # wall clock around
+        if tot_m >= tot_l:
             regressions.append(rec)
     return {"checked": checked, "regressions": regressions,
             "skipped": skipped}
@@ -811,6 +904,19 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
             result = check_procs(pr_doc)
             render("BENCH_PROCS.json (absolute pool-vs-single bars)",
+                   result)
+            total_regressions += len(result["regressions"])
+        ct_path = os.path.join(args.dir, "BENCH_CONTINUUM.json")
+        if os.path.exists(ct_path):
+            try:
+                with open(ct_path) as f:
+                    ct_doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"bench-compare: cannot read BENCH_CONTINUUM "
+                      f"JSON: {e}", file=sys.stderr)
+                return 2
+            result = check_continuum(ct_doc)
+            render("BENCH_CONTINUUM.json (absolute stage-continuum bars)",
                    result)
             total_regressions += len(result["regressions"])
         sm_path = os.path.join(args.dir, "BENCH_SEMANTIC.json")

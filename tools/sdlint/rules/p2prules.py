@@ -16,7 +16,12 @@ Scope: everywhere except the modules that *define* the request
 helpers (``p2p/operations.py``, ``p2p/sync.py``, ``p2p/rspc.py``,
 ``p2p/work.py``) — a definition module's own wire plumbing (the
 client half itself, retry-wrapped re-dial helpers) is the one place
-a bare call is the implementation rather than an adoption gap.
+a bare call is the implementation rather than an adoption gap. The
+stage-typed execution continuum (``parallel/scheduler.py``,
+``location/indexer/mesh.py``, ``location/indexer/stages.py``) is
+squarely IN scope: its claim/complete exchanges ride ``WORK_POLICY``
+inside ``p2p/work.py``, and any direct ``request_work`` dial added
+to the scheduler/stage modules is flagged here.
 
 What counts as "inside a policy call": any enclosing AST ancestor
 that is a ``Call`` whose callee attribute is named ``call`` — which

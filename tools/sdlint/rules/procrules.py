@@ -15,9 +15,11 @@ time.
 Detection keys off the repo's procpool idioms:
 
 - the handle is the module attribute (``procpool.POOL.submit(…)``,
-  ``_procpool.POOL.request(…)``) or a local bound from the accessor
-  (``pool = _procpool.get(); pool.submit(…)`` — same-function
-  dataflow, like SD007's ``peer_label`` sanction);
+  ``_procpool.POOL.request(…)``) or a local bound from an accessor
+  (``pool = _procpool.get()`` or the execution continuum's per-stage
+  seam ``pool = _scheduler.pool_for(STAGE)`` —
+  ``parallel/scheduler.py``; same-function dataflow, like SD007's
+  ``peer_label`` sanction);
 - the shipped expression is the second positional argument (after the
   stage name) or the ``payload`` keyword;
 - one level of same-function dataflow is followed: a payload that is a
@@ -63,6 +65,12 @@ def _is_pool_module(name: str | None) -> bool:
     )
 
 
+def _is_scheduler_module(name: str | None) -> bool:
+    return name is not None and name.rsplit(".", 1)[-1] in (
+        "scheduler", "_scheduler",
+    )
+
+
 def _is_pool_handle(expr: ast.AST, safe_names: set[str]) -> bool:
     """``procpool.POOL`` / ``_procpool.POOL`` / bare ``POOL`` / a local
     bound from ``procpool.get()`` or ``procpool.POOL``."""
@@ -79,8 +87,9 @@ def _is_pool_handle(expr: ast.AST, safe_names: set[str]) -> bool:
 
 
 def _pool_handle_names(ctx: FileContext, scope: ast.AST | None) -> set[str]:
-    """Locals assigned from ``procpool.get()`` / ``procpool.POOL`` in
-    this scope (same-function dataflow only)."""
+    """Locals assigned from ``procpool.get()`` / ``procpool.POOL`` /
+    ``scheduler.pool_for(...)`` in this scope (same-function dataflow
+    only)."""
     names: set[str] = set()
     for node in walk_shallow(scope if scope is not None else ctx.tree):
         if not isinstance(node, ast.Assign):
@@ -91,6 +100,12 @@ def _pool_handle_names(ctx: FileContext, scope: ast.AST | None) -> set[str]:
             callee = dotted_name(value.func)
             if callee is not None and callee.rsplit(".", 1)[-1] == "get" \
                     and _is_pool_module(callee.rsplit(".", 1)[0]):
+                bound = True
+            elif callee is not None \
+                    and callee.rsplit(".", 1)[-1] == "pool_for" \
+                    and ("." not in callee or _is_scheduler_module(
+                        callee.rsplit(".", 1)[0])):
+                # the execution continuum's per-stage pool seam
                 bound = True
         else:
             vname = dotted_name(value)

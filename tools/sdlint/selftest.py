@@ -193,6 +193,13 @@ CORPUS: dict[str, dict] = {
             pool = _procpool.get()
             pool.submit("identify.hash_entries",
                         {"db": self.db, "entries": entries})
+    """, "pkg/stage.py": """
+        from spacedrive_tpu.parallel import scheduler as _scheduler
+
+        def ship_stage(self, entries):
+            pool = _scheduler.pool_for("thumb")
+            pool.submit("thumb.cpu",
+                        {"library": self.library, "entries": entries})
     """}},
     "SD023": {"files": {"pkg/mod.py": """
         import threading
