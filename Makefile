@@ -155,6 +155,13 @@ soak-smoke:
 # every round; CI's `lint` target stays cold and authoritative.
 bench-check: lint-changed
 	$(PY) tools/bench_compare.py --dir .
+	$(PY) tools/check_failures.py
+
+# diff the tier-1 failure *set* (never the count) against
+# tests/tier1_known_failures.txt using the log the verify command
+# tees to /tmp/_t1.log; soft-skips when no log exists
+check-failures:
+	$(PY) tools/check_failures.py
 
 # observability smoke: boot a node, index, assert /metrics + /trace +
 # debug bundle are live and secret-free

@@ -37,9 +37,22 @@ the recorded rates, so a hand-edited verdict cannot sneak past
   sheds in the protected control/sync classes;
 - sheds are fast-fail: shed p99 ≤ ``SHED_P99_MAX_S``.
 
+Multi-tenant leg (telemetry/tenants.py acceptance): N libraries ×
+capacity clients drawing a deterministic zipf-quota mix, with the
+exact per-tenant oracle kept client-side. Bars (re-derived by
+bench_compare):
+
+- the serve sketch's resident top-K recall vs the exact oracle ≥
+  ``TENANT_RECALL_MIN``;
+- zero protected-class (control/sync) sheds during the arm;
+- ``SD_TENANT_OBS=0`` is a true no-op: the same deterministic request
+  sequence replayed with the plane off digests bit-identical bodies.
+
 Output: one JSON doc on stdout, also written to BENCH_SERVE.json.
 Knobs: SD_SERVE_BENCH_FILES=800 SD_SERVE_BENCH_SECONDS=5
-SD_SERVE_BENCH_SLOW_MS=4. ~45 s total on a CI box (`make bench-serve`).
+SD_SERVE_BENCH_SLOW_MS=4 SD_SERVE_BENCH_TENANTS=18
+SD_SERVE_BENCH_TENANT_FILES=100 SD_SERVE_BENCH_TENANT_REQS=200.
+~60 s total on a CI box (`make bench-serve`).
 """
 
 from __future__ import annotations
@@ -59,6 +72,22 @@ import time
 P99_RATIO_MAX = 5.0
 GOODPUT_MIN = 0.7
 SHED_P99_MAX_S = 1.0
+TENANT_RECALL_MIN = 0.9
+
+#: zipf exponent for the multi-tenant mix — steep enough that adjacent
+#: oracle ranks are separated by >15% (the recall bar then measures
+#: the sketch, not a coin-flip at the rank-K boundary)
+TENANT_ZIPF_S = 1.6
+#: oracle report size vs sketch residency for the leg: the sketch runs
+#: with SD_TENANT_TOPK=16 residents while the bar scores the exact
+#: top-8 — the standard ~2× residency oversize. Space-saving's churn
+#: floor is bounded by the cumulative tail mass beyond residency
+#: (ranks 17+ under this zipf ≈ 1% of the stream), so every oracle
+#: rank whose share clears that floor (rank 8 holds ~1.7%) is provably
+#: stable; K == report size would put the floor ABOVE rank 8's own
+#: share and make the bar measure slot churn, not the sketch.
+TENANT_ORACLE_TOP = 8
+TENANT_SKETCH_K = 16
 
 #: worker processes the client swarm is spread over — kept low so the
 #: load generators don't starve the server (the process under test) of
@@ -263,6 +292,101 @@ async def _worker_health(base: str, seconds: float) -> dict:
             "health_worst_ms": round(worst * 1e3, 2)}
 
 
+def _tenant_schedule(libs: list[str], requests: int,
+                     rng: random.Random) -> list[str]:
+    """Deterministic zipf-quota schedule: the library at rank r gets
+    ``max(1, round(share_r * requests))`` slots, shuffled. Fixed quotas
+    (not i.i.d. draws) keep the exact oracle's rank order deterministic
+    across runs, so the recall bar measures the sketch — not
+    multinomial noise at the rank-K boundary."""
+    weights = [(i + 1) ** -TENANT_ZIPF_S for i in range(len(libs))]
+    h = sum(weights)
+    sched: list[str] = []
+    for lib, w in zip(libs, weights):
+        sched.extend([lib] * max(1, round(w / h * requests)))
+    rng.shuffle(sched)
+    return sched
+
+
+async def _worker_tenants(base: str, libs: list[str], clients: int,
+                          requests: int, seed: int) -> dict:
+    """The multi-tenant arm: each client walks its own shuffled
+    zipf-quota schedule over ALL libraries, keeping exact per-library
+    offered/admitted counts + admitted latencies — the oracle the
+    server-side sketch is scored against."""
+    import aiohttp
+
+    offered = {lib: 0 for lib in libs}
+    admitted = {lib: 0 for lib in libs}
+    lat: dict[str, list[float]] = {lib: [] for lib in libs}
+    shed = 0
+    errors = 0
+
+    async def one_client(cseed: int) -> None:
+        nonlocal shed, errors
+        rng = random.Random(cseed)
+        sched = _tenant_schedule(libs, requests, rng)
+        async with aiohttp.ClientSession() as session:
+            for lib in sched:
+                arg = _mix_arg(rng)
+                offered[lib] += 1
+                t0 = time.monotonic()
+                try:
+                    async with session.post(
+                        f"{base}/rspc/search.paths",
+                        json={"library_id": lib, "arg": arg},
+                    ) as resp:
+                        await resp.read()
+                        dt = time.monotonic() - t0
+                        if resp.status == 200:
+                            admitted[lib] += 1
+                            lat[lib].append(dt)
+                        elif resp.status == 429:
+                            shed += 1
+                        else:
+                            errors += 1
+                except Exception:
+                    errors += 1
+
+    await asyncio.gather(*(one_client(seed * 1000 + i)
+                           for i in range(clients)))
+    return {
+        "offered": offered,
+        "admitted": admitted,
+        "lat": {lib: [round(v, 5) for v in vs] for lib, vs in lat.items()},
+        "shed": shed,
+        "errors": errors,
+    }
+
+
+async def _worker_ident(base: str, libs: list[str], requests: int,
+                        seed: int) -> dict:
+    """The SD_TENANT_OBS bit-identity probe: one sequential client
+    replaying a fully deterministic (seeded) request sequence, digesting
+    every (status, body) pair. The parent runs it twice — plane on,
+    plane off — and the digests must match exactly."""
+    import hashlib
+
+    import aiohttp
+
+    rng = random.Random(seed)
+    sched = _tenant_schedule(libs, requests, rng)
+    h = hashlib.sha256()
+    n = 0
+    async with aiohttp.ClientSession() as session:
+        for lib in sched:
+            arg = _mix_arg(rng)
+            async with session.post(
+                f"{base}/rspc/search.paths",
+                json={"library_id": lib, "arg": arg},
+            ) as resp:
+                body = await resp.read()
+                h.update(str(resp.status).encode())
+                h.update(body)
+                n += 1
+    return {"digest": h.hexdigest(), "requests": n}
+
+
 def worker_main(args: argparse.Namespace) -> int:
     if args.worker == "mix":
         out = asyncio.run(_worker_mix(
@@ -275,6 +399,15 @@ def worker_main(args: argparse.Namespace) -> int:
     elif args.worker == "probe":
         out = asyncio.run(_worker_probe(
             args.base, args.lib, args.seconds, args.seed
+        ))
+    elif args.worker == "tenants":
+        out = asyncio.run(_worker_tenants(
+            args.base, args.libs.split(","), args.clients, args.requests,
+            args.seed
+        ))
+    elif args.worker == "ident":
+        out = asyncio.run(_worker_ident(
+            args.base, args.libs.split(","), args.requests, args.seed
         ))
     else:
         out = asyncio.run(_worker_health(args.base, args.seconds))
@@ -450,6 +583,172 @@ async def bench_leg(node, base: str, lib_id: str, seconds: float,
     }
 
 
+async def _make_tenant_libs(node, tmp: str, n_tenants: int,
+                            files: int) -> list[str]:
+    """N additional small libraries on the SAME node, each indexing its
+    own corpus — the tenants the multi-tenant arm spreads load over."""
+    from spacedrive_tpu.jobs.manager import JobBuilder
+    from spacedrive_tpu.location.indexer.job import IndexerJob
+    from spacedrive_tpu.location.locations import LocationCreateArgs
+
+    libs: list[str] = []
+    for i in range(n_tenants):
+        corpus = os.path.join(tmp, f"tenant{i:02d}")
+        make_corpus(corpus, files)
+        lib = await node.create_library(f"bench-tenant-{i:02d}")
+        loc = LocationCreateArgs(path=corpus).create(lib)
+        await JobBuilder(IndexerJob({"location_id": loc["id"]})).spawn(
+            node.jobs, lib
+        )
+        libs.append(str(lib.id))
+    await node.jobs.wait_idle()
+    return libs
+
+
+async def bench_tenants(node, base: str, tmp: str) -> dict:
+    """The multi-tenant leg: capacity clients × N libraries under a
+    deterministic zipf-quota mix, scoring the serve sketch's resident
+    top-K against the exact client-side oracle, then replaying a
+    deterministic sequence with SD_TENANT_OBS=0 to prove the plane off
+    is a true no-op (bit-identical bodies). Raw library UUIDs never
+    reach the artifact — per-tenant rows are keyed by tenant_label."""
+    from spacedrive_tpu.telemetry import tenants as _tenants
+
+    n_tenants = int(os.environ.get("SD_SERVE_BENCH_TENANTS", "18"))
+    t_files = int(os.environ.get("SD_SERVE_BENCH_TENANT_FILES", "100"))
+    reqs = int(os.environ.get("SD_SERVE_BENCH_TENANT_REQS", "200"))
+    log(f"  indexing {n_tenants} tenant libraries "
+        f"({t_files} files each) ...")
+    libs = await _make_tenant_libs(node, tmp, n_tenants, t_files)
+    label_of = {lib: _tenants.tenant_label(lib) for lib in libs}
+
+    # fresh sketches for the arm — the single-library legs above filled
+    # the serve surface with one dominant tenant — at the oversized
+    # residency (see TENANT_SKETCH_K; topk() is read at sketch creation)
+    _tenants.reset()
+    prev_topk = os.environ.get("SD_TENANT_TOPK")
+    os.environ["SD_TENANT_TOPK"] = str(TENANT_SKETCH_K)
+    clients = node.serve.policy.budgets["interactive"].max_inflight
+    before = _gate_counters(node)
+    workers = min(WORKERS, clients)
+    per = [clients // workers + (1 if i < clients % workers else 0)
+           for i in range(workers)]
+    log(f"  zipf mix ({clients} clients x {reqs} requests, "
+        f"{n_tenants} tenants) ...")
+    t0 = time.monotonic()
+    try:
+        parts = await asyncio.gather(*(
+            _spawn_worker("--worker", "tenants", "--base", base,
+                          "--libs", ",".join(libs), "--clients", str(n),
+                          "--requests", str(reqs), "--seed", str(i))
+            for i, n in enumerate(per) if n
+        ))
+    finally:
+        if prev_topk is None:
+            os.environ.pop("SD_TENANT_TOPK", None)
+        else:
+            os.environ["SD_TENANT_TOPK"] = prev_topk
+    window = max(time.monotonic() - t0, 1e-3)
+    after = _gate_counters(node)
+
+    offered = {lib: 0 for lib in libs}
+    admitted = {lib: 0 for lib in libs}
+    lat: dict[str, list[float]] = {lib: [] for lib in libs}
+    shed = sum(p["shed"] for p in parts)
+    errors = sum(p["errors"] for p in parts)
+    for p in parts:
+        for lib in libs:
+            offered[lib] += p["offered"].get(lib, 0)
+            admitted[lib] += p["admitted"].get(lib, 0)
+            lat[lib].extend(p["lat"].get(lib, ()))
+
+    # sketch vs oracle: resident top-K against the exact client-side
+    # per-tenant counts (the sketch only sees admitted requests — sheds
+    # never reach observe_request_seconds — so admitted IS the oracle)
+    serve_sk = (_tenants.snapshot().get("surfaces") or {}).get("serve") or {}
+    sketch_top = [r["tenant"] for r in serve_sk.get("residents", [])]
+    k = min(TENANT_ORACLE_TOP, n_tenants)
+    oracle = sorted(admitted.items(), key=lambda kv: -kv[1])[:k]
+    oracle_top = [label_of[lib] for lib, _ in oracle]
+    recall = (len(set(oracle_top) & set(sketch_top)) / len(oracle_top)
+              if oracle_top else 0.0)
+
+    per_tenant = {
+        label_of[lib]: {
+            "offered": offered[lib],
+            "admitted": admitted[lib],
+            "admitted_rps": round(admitted[lib] / window, 2),
+            "admitted_p99_ms": round(_pct(lat[lib], 0.99) * 1e3, 2),
+            "share": round(offered[lib] / max(sum(offered.values()), 1), 4),
+        }
+        for lib in sorted(libs, key=lambda x: -offered[x])
+    }
+    # service fairness given demand: min/max admitted-over-offered
+    # ratio across tenants with enough demand to measure (recorded,
+    # not gated — absolute spread on a noisy box measures the box)
+    ratios = [admitted[lib] / offered[lib] for lib in libs
+              if offered[lib] >= 20]
+    spread = round(min(ratios) / max(ratios), 4) \
+        if ratios and max(ratios) > 0 else 0.0
+
+    # bit-identity: the same deterministic sequence, plane on vs off.
+    # Caches cleared before each pass so both see the identical
+    # cold-then-warm evolution; brownout decays first so neither pass
+    # straddles a mode edge the other missed.
+    log("  SD_TENANT_OBS=0 bit-identity replay ...")
+    await asyncio.sleep(node.serve.policy.brownout_hold_s + 1.0)
+    ident_argv = ("--worker", "ident", "--base", base,
+                  "--libs", ",".join(libs), "--requests", "120",
+                  "--seed", "4242")
+    node.serve.queries.clear()
+    node.serve.meta.clear()
+    ident_on = await _spawn_worker(*ident_argv)
+    node.serve.queries.clear()
+    node.serve.meta.clear()
+    prev_obs = os.environ.get("SD_TENANT_OBS")
+    os.environ["SD_TENANT_OBS"] = "0"
+    try:
+        ident_off = await _spawn_worker(*ident_argv)
+    finally:
+        if prev_obs is None:
+            os.environ.pop("SD_TENANT_OBS", None)
+        else:
+            os.environ["SD_TENANT_OBS"] = prev_obs
+    identical = (ident_on["digest"] == ident_off["digest"]
+                 and ident_on["requests"] == ident_off["requests"])
+
+    out = {
+        "params": {"tenants": n_tenants, "files_per_tenant": t_files,
+                   "requests_per_client": reqs, "clients": clients,
+                   "zipf_s": TENANT_ZIPF_S, "oracle_top": k,
+                   "sketch_k": TENANT_SKETCH_K},
+        "window_s": round(window, 2),
+        "offered": sum(offered.values()),
+        "admitted": sum(admitted.values()),
+        "shed": shed,
+        "errors": errors,
+        "per_tenant": per_tenant,
+        "oracle_top": oracle_top,
+        "sketch_top": sketch_top,
+        "topk_recall": round(recall, 3),
+        "fairness_index": round(serve_sk.get("fairness_index", 1.0), 4),
+        "dominant_share": round(serve_sk.get("dominant_share", 0.0), 4),
+        "other_share": round(
+            serve_sk.get("other", 0.0) / max(serve_sk.get("total", 0.0), 1.0),
+            4),
+        "evictions": serve_sk.get("evictions", 0),
+        "goodput_spread": spread,
+        "control_shed": after["control_shed"] - before["control_shed"],
+        "sync_shed": after["sync_shed"] - before["sync_shed"],
+        "obs_off_identical": identical,
+        "ident_requests": ident_on["requests"],
+    }
+    log(f"    recall {out['topk_recall']}, fairness "
+        f"{out['fairness_index']}, spread {spread}, "
+        f"obs-off identical: {identical}")
+    return out
+
+
 async def run() -> dict:
     from spacedrive_tpu.utils import faults as _faults
 
@@ -491,6 +790,13 @@ async def run() -> dict:
                                         clients_capacity, leg_seed=2000)
         finally:
             _faults.clear()
+        # settle again before the multi-tenant arm: the throttled leg's
+        # brownout hold and cached entries would pollute its baseline
+        await asyncio.sleep(node.serve.policy.brownout_hold_s + 1.0)
+        node.serve.queries.clear()
+        node.serve.meta.clear()
+        log("tenant leg (sketch recall + obs-off bit-identity):")
+        tenants = await bench_tenants(node, base, tmp)
         doc = {
             "ts": time.time(),
             "host": {"platform": platform.platform(),
@@ -500,9 +806,11 @@ async def run() -> dict:
                        "capacity_clients": clients_capacity},
             "bars": {"p99_ratio_max": P99_RATIO_MAX,
                      "goodput_min": GOODPUT_MIN,
-                     "shed_p99_max_s": SHED_P99_MAX_S},
+                     "shed_p99_max_s": SHED_P99_MAX_S,
+                     "tenant_recall_min": TENANT_RECALL_MIN},
             "clean": clean,
             "throttled": throttled,
+            "tenants": tenants,
         }
         doc["verdict"] = {
             "pass": all(
@@ -511,6 +819,11 @@ async def run() -> dict:
                 and leg["protected_ok"]
                 and leg["shed_p99_s"] <= SHED_P99_MAX_S
                 for leg in (clean, throttled)
+            ) and (
+                tenants["topk_recall"] >= TENANT_RECALL_MIN
+                and tenants["control_shed"] == 0
+                and tenants["sync_shed"] == 0
+                and tenants["obs_off_identical"]
             ),
         }
         return doc
@@ -522,9 +835,12 @@ async def run() -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--worker",
-                    choices=("mix", "unloaded", "probe", "health"))
+                    choices=("mix", "unloaded", "probe", "health",
+                             "tenants", "ident"))
     ap.add_argument("--base")
     ap.add_argument("--lib")
+    ap.add_argument("--libs", help="comma-joined library ids "
+                                   "(tenants/ident workers)")
     ap.add_argument("--clients", type=int, default=1)
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--requests", type=int, default=200)

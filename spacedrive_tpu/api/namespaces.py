@@ -1638,6 +1638,13 @@ def _telemetry(r: Router) -> None:
         # the redacted support artifact (see telemetry.bundle)
         return telemetry.debug_bundle(node)
 
+    @r.query("telemetry.tenants", priority="background")
+    def tenants(node):
+        # the per-tenant heavy-hitter sketches (telemetry.tenants):
+        # hashed tenant labels only — explicitly background, an
+        # observability read must never contend with control traffic
+        return telemetry.tenants.snapshot()
+
     @r.query("telemetry.health")
     def health(node):
         # per-subsystem → per-node verdicts (telemetry.health)

@@ -141,12 +141,15 @@ class Router:
             # 30 s before failing is exactly the latency the
             # interactive_p99 SLO exists to catch (sheds stay excluded
             # — fast 429s would bias the percentile low under overload)
-            observe_request_seconds(klass, _time.perf_counter() - t0)
+            observe_request_seconds(klass, _time.perf_counter() - t0,
+                                    tenant=library_id)
             raise
         # answered rspc calls feed the same per-class request latency
         # series the HTTP middleware does — without this leg the
-        # interactive_p99 SLO would only ever see raw-route traffic
-        observe_request_seconds(klass, _time.perf_counter() - t0)
+        # interactive_p99 SLO would only ever see raw-route traffic;
+        # library-scoped calls also attribute to the tenant sketch
+        observe_request_seconds(klass, _time.perf_counter() - t0,
+                                tenant=library_id)
         return result
 
     async def _exec_gated(
@@ -190,6 +193,7 @@ class Router:
             load,
             tags=(("lib", lib_key), ("q", key, lib_key)),
             stale_ok=serve.gate.in_brownout(),
+            tenant=lib_key,
         )
         return result.value
 

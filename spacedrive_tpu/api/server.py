@@ -83,6 +83,7 @@ class ApiServer:
                 self._gated(web.get("/trace", self._trace), BACKGROUND),
                 self._gated(web.get("/attrib", self._attrib), BACKGROUND),
                 self._gated(web.get("/profile", self._profile), BACKGROUND),
+                self._gated(web.get("/tenants", self._tenants), BACKGROUND),
                 self._gated(web.get("/health", self._health), CONTROL),
                 self._gated(web.get("/mesh", self._mesh), INTERACTIVE),
                 self._gated(web.get("/search", self._search), INTERACTIVE),
@@ -254,6 +255,17 @@ class ApiServer:
             doc = result.value
         return web.json_response(doc, dumps=_dumps)
 
+    async def _tenants(self, request: web.Request) -> web.Response:
+        """Per-tenant accounting snapshot (telemetry/tenants.py): the
+        full space-saving sketch read — per-surface totals, resident
+        top-K with error bounds and latency buckets, fairness index,
+        dominant share. Tenant keys are blake2b hashes; raw library/
+        instance UUIDs never appear here. Admission-gated BACKGROUND
+        like the other observability reads."""
+        from ..telemetry import tenants as _tenants_mod
+
+        return web.json_response(_tenants_mod.snapshot(), dumps=_dumps)
+
     async def _profile(self, request: web.Request) -> web.Response:
         """The continuous host profiler (telemetry/sampler.py):
         collapsed-stack frame groups, on-CPU vs GIL-wait split, and
@@ -391,6 +403,11 @@ class ApiServer:
                     tags=(("lib", lib_key), ("q", "search.semantic", lib_key)),
                     stale_ok=serve.gate.in_brownout(),
                 )
+                if res.state != "miss":
+                    # see _rspc_http: hit attribution for the byte layer
+                    from ..telemetry import tenants as _tenants_mod
+
+                    _tenants_mod.observe("cache_hit", lib_key)
                 return web.Response(
                     body=res.value,
                     content_type="application/json",
@@ -445,6 +462,15 @@ class ApiServer:
                         tags=(("lib", lib_key), ("q", key, lib_key)),
                         stale_ok=serve.gate.in_brownout(),
                     )
+                    if res.state != "miss":
+                        # byte-cache hits never reach the router (that's
+                        # the point), so the tenant attribution the
+                        # object-cache tap would have made happens here;
+                        # misses fall through to load_bytes and tap once
+                        # inside the router's cache
+                        from ..telemetry import tenants as _tenants_mod
+
+                        _tenants_mod.observe("cache_hit", lib_key)
                     return web.Response(
                         body=res.value,
                         content_type="application/json",
@@ -574,8 +600,10 @@ class ApiServer:
                 except OSError:
                     raise web.HTTPNotFound()
 
+            # ns is the owning library's id string, so thumb reads
+            # attribute to the tenant whose grid is hot
             result = await serve.thumbs.get(
-                (ns, shard, name), load, weigh=len,
+                (ns, shard, name), load, weigh=len, tenant=ns,
             )
             return web.Response(
                 body=result.value,

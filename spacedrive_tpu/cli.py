@@ -430,6 +430,61 @@ async def cmd_serve_status(args: argparse.Namespace) -> int:
         await node.shutdown()
 
 
+async def cmd_tenants(args: argparse.Namespace) -> int:
+    """Per-tenant accounting: the space-saving heavy-hitter sketches
+    (telemetry/tenants.py) — per-surface totals, resident top-K with
+    error bounds, fairness index, dominant share. Tenant keys are
+    hashed labels, never raw UUIDs. With --url, reads a running
+    node's GET /tenants; with --peer, shows the named mesh peer's
+    federated tenant digest; otherwise boots an ephemeral mesh node
+    and shows the mesh-wide digests."""
+    if args.url:
+        import urllib.error
+
+        url = args.url.rstrip("/") + "/tenants"
+        try:
+            doc = await asyncio.to_thread(_http_get, url)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"tenants: cannot reach {url}: {e}", file=sys.stderr)
+            print("is a node running? start one with `sdx serve`",
+                  file=sys.stderr)
+            return 1
+        _write_or_print(json.dumps(json.loads(doc), indent=2), args.out)
+        return 0
+
+    from .telemetry.federation import mesh_status
+
+    async with _mesh_node(args) as node:
+        await node.p2p.refresh_federation(force=True)
+        mesh = mesh_status(node)["mesh"]
+        from .telemetry import tenants as _tenants_mod
+
+        peers = {
+            pid: {
+                "peer_label": p.get("peer_label"),
+                "stale": p.get("stale"),
+                "tenants": (p.get("snapshot") or {}).get("tenants"),
+            }
+            for pid, p in mesh.get("peers", {}).items()
+        }
+        if args.peer:
+            want = args.peer
+            match = {
+                pid: p for pid, p in peers.items()
+                if want in (pid, p.get("peer_label"))
+                or pid.startswith(want)
+            }
+            if not match:
+                print(f"tenants: no mesh peer matches {want!r} "
+                      f"(known: {sorted(peers)})", file=sys.stderr)
+                return 1
+            doc: dict = {"peers": match}
+        else:
+            doc = {"local": _tenants_mod.snapshot(), "peers": peers}
+        _write_or_print(json.dumps(doc, indent=2, default=str), args.out)
+        return 0
+
+
 def cmd_crypto(args: argparse.Namespace) -> int:
     from .crypto import FileHeader, decrypt_file, encrypt_file
 
@@ -1086,6 +1141,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "instead of booting an ephemeral node")
     ss.add_argument("--out", help="write JSON here instead of stdout")
 
+    tn = sub.add_parser(
+        "tenants",
+        help="per-tenant accounting: heavy-hitter sketches per surface "
+             "(serve/relay/p2p/sync), fairness index, dominant share — "
+             "hashed tenant labels, never raw UUIDs",
+    )
+    tn.add_argument("--url", default=None,
+                    help="read a running node's GET /tenants instead of "
+                         "booting an ephemeral mesh node")
+    tn.add_argument("--peer", default=None, metavar="LABEL",
+                    help="show one mesh peer's federated tenant digest "
+                         "(peer_label or instance-id prefix)")
+    tn.add_argument("--wait", type=float, default=3.0,
+                    help="discovery settle time (ephemeral-node mode)")
+    tn.add_argument("--out", help="write JSON here instead of stdout")
+
     dk = sub.add_parser(
         "desktop",
         help="managed desktop host: single instance, browser UI, "
@@ -1155,6 +1226,8 @@ def main(argv: list[str] | None = None) -> int:
         return asyncio.run(cmd_mesh_status(args))
     if args.cmd == "serve-status":
         return asyncio.run(cmd_serve_status(args))
+    if args.cmd == "tenants":
+        return asyncio.run(cmd_tenants(args))
     if args.cmd == "desktop":
         from . import desktop
 

@@ -23,6 +23,7 @@ from typing import Any
 
 import aiohttp
 
+from ..telemetry import tenants as _tenants
 from ..telemetry import trace as _trace
 from ..utils.resilience import (
     PASS,
@@ -139,6 +140,10 @@ class CloudClient:
             f"/api/libraries/{library_uuid}/messageCollections",
             {"instance_uuid": instance_uuid, "contents": b64(packed_ops)},
         )
+        # node-side mirror of the relay's accounting: which of OUR
+        # libraries spends the relay link, in raw payload bytes
+        _tenants.observe_bytes(library_uuid, len(packed_ops),
+                               outbound=True)
         return out["id"]
 
     async def pull_ops(
@@ -155,6 +160,9 @@ class CloudClient:
         )
         for c in out:
             c["contents"] = unb64(c["contents"])
+        _tenants.observe_bytes(
+            library_uuid, sum(len(c["contents"]) for c in out),
+            outbound=False)
         return out
 
     # --- telemetry federation fallback (telemetry/federation.py) -------

@@ -32,6 +32,7 @@ from typing import Any
 from aiohttp import web
 
 from ..telemetry import span as _span
+from ..telemetry import tenants as _tenants
 from ..telemetry import trace as _trace
 from ..utils import faults as _faults
 
@@ -181,6 +182,14 @@ class CloudRelay:
                     "contents": body["contents"],  # base64 packed ops
                 }
             )
+            # the relay is the one surface every tenant's every device
+            # hits — attribute pushes (and their payload weight) to
+            # the library so a hot tenant is visible before fairness
+            # enforcement (ROADMAP item 4) exists to act on it
+            tenant = request.match_info.get("lib")
+            _tenants.observe("relay_push", tenant)
+            _tenants.observe_bytes(tenant, len(body["contents"]),
+                                   outbound=False)
             return web.json_response({"id": cid})
 
     async def _pull(self, request: web.Request) -> web.Response:
@@ -197,7 +206,13 @@ class CloudRelay:
                 if c["instance_uuid"] != me
                 and c["id"] > cursors.get(c["instance_uuid"], 0)
             ]
-            return web.json_response(out[: int(body.get("count", 100))])
+            page = out[: int(body.get("count", 100))]
+            tenant = request.match_info.get("lib")
+            _tenants.observe("relay_pull", tenant)
+            _tenants.observe_bytes(
+                tenant, sum(len(c["contents"]) for c in page),
+                outbound=True)
+            return web.json_response(page)
 
 
     # --- telemetry federation fallback (telemetry/federation.py) -------

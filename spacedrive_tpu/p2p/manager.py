@@ -20,6 +20,7 @@ from typing import Any
 from ..node.config import BackendFeature, P2PDiscoveryState
 from ..sync.ingest import IngestActor
 from ..telemetry import span as _span
+from ..telemetry import tenants as _tenants
 from ..telemetry import trace as _trace
 from ..telemetry.events import P2P_EVENTS
 from ..telemetry.federation import FederationCache, local_snapshot, snapshot_compatible
@@ -529,6 +530,9 @@ class P2PManager:
                 w = Writer(stream)
                 w.u8(0x01)
                 await w.flush()
+                # responder-side tenant accounting: which library's
+                # sync traffic this node is serving (hashed label only)
+                _tenants.observe("p2p_sync", header.library_id)
                 actor = self.ingest_actors.get(header.library_id)
                 if actor is not None:
                     actor.notify(trace_ctx=wire_ctx)
@@ -538,6 +542,7 @@ class P2PManager:
                 return
             lib = self.node.libraries.get(header.library_id)
             if lib is not None:
+                _tenants.observe("p2p_sync", header.library_id)
                 async with self._serve_admit("p2p.sync_serve"):
                     with _span("p2p.sync_serve"):
                         await respond_sync_request(stream, lib.sync)
@@ -557,6 +562,11 @@ class P2PManager:
             if self._is_library_member(
                 getattr(stream, "remote_identity", None)
             ):
+                # TELEMETRY carries no library id — attribute the
+                # responder work to the calling instance's identity
+                _tenants.observe(
+                    "p2p_telemetry",
+                    getattr(stream, "remote_identity", None))
                 op = (header.telemetry_op or {}).get("op")
                 if op == "trace_pull":
                     if _faults.hit("p2p.trace_pull") is not None:
@@ -600,6 +610,7 @@ class P2PManager:
             ):
                 from .work import respond_work
 
+                _tenants.observe("p2p_work", header.library_id)
                 async with self._serve_admit("p2p.work_serve"):
                     with _span("p2p.work_serve"):
                         await respond_work(stream, self.node, header)

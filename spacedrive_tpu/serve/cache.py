@@ -32,6 +32,7 @@ from collections import OrderedDict
 from typing import Any, Awaitable, Callable, NamedTuple
 
 from ..telemetry import metrics as _tm
+from ..telemetry import tenants as _tenants
 from ..utils.tasks import supervise
 
 logger = logging.getLogger(__name__)
@@ -102,6 +103,7 @@ class ReadCache:
         tags: tuple[Tag, ...] = (),
         stale_ok: bool = False,
         weigh: Callable[[Any], int] | None = None,
+        tenant: Any = None,
     ) -> CacheResult:
         """Cached value for ``key``, loading (single-flight) on miss.
 
@@ -109,7 +111,10 @@ class ReadCache:
         concurrent callers cost one loader run, and the next caller
         after completion loads fresh (the /mesh refresh shape).
         ``stale_ok`` (brownout) serves an expired entry while a
-        background single-flight refresh replaces it.
+        background single-flight refresh replaces it. ``tenant`` (the
+        owning library id, when the caller has one) feeds the
+        per-tenant cache hit/miss sketches — hashed on entry, never
+        stored here.
         """
         ttl = self.default_ttl_s if ttl_s is None else ttl_s
         entry = self._entries.get(key)
@@ -122,6 +127,7 @@ class ReadCache:
                     cache="query" if self.name == "query"
                     else "thumb" if self.name == "thumb" else "meta",
                     result="hit")
+                _tenants.observe("cache_hit", tenant)
                 return CacheResult(entry.value, HIT, age)
             if stale_ok and age - entry.ttl_s < self.stale_max_s:
                 # brownout: answer stale NOW, refresh behind the response
@@ -130,6 +136,7 @@ class ReadCache:
                     cache="query" if self.name == "query"
                     else "thumb" if self.name == "thumb" else "meta",
                     result="stale")
+                _tenants.observe("cache_hit", tenant)
                 return CacheResult(entry.value, STALE, age)
             self._evict_key(key)
         fut = self._inflight.get(key)
@@ -138,6 +145,7 @@ class ReadCache:
                     cache="query" if self.name == "query"
                     else "thumb" if self.name == "thumb" else "meta",
                     result="coalesced")
+            _tenants.observe("cache_hit", tenant)
             value = await asyncio.shield(fut)
             return CacheResult(value, COALESCED, 0.0)
         value = await self._load(key, loader, ttl, tags, weigh)
@@ -145,6 +153,7 @@ class ReadCache:
                     cache="query" if self.name == "query"
                     else "thumb" if self.name == "thumb" else "meta",
                     result="miss")
+        _tenants.observe("cache_miss", tenant)
         return CacheResult(value, MISS, 0.0)
 
     def get_sync(

@@ -38,6 +38,7 @@ import time
 from typing import Any, AsyncIterator
 
 from ..telemetry import metrics as _tm
+from ..telemetry import tenants as _tenants
 from ..telemetry.events import SERVE_EVENTS
 from ..telemetry.snapshot import gauge_value
 from . import policy as _policy
@@ -47,13 +48,18 @@ NORMAL = "normal"
 BROWNOUT = "brownout"
 
 
-def observe_request_seconds(klass: str, seconds: float) -> None:
+def observe_request_seconds(klass: str, seconds: float,
+                            tenant: Any = None) -> None:
     """Admitted-request wall time per priority class — the ONE record
     site both serve surfaces share (the HTTP admission middleware and
     the rspc Router.exec leg), so the `interactive_p99` SLO input
     covers rspc traffic, not just raw HTTP routes. The conditional maps
     onto the class-constant vocabulary (an unknown string — which the
-    gate itself degrades to background — records as background too)."""
+    gate itself degrades to background — records as background too).
+    ``tenant`` (the request's library id, when the surface knows one)
+    rides the same call into the per-tenant serve sketch
+    (telemetry/tenants.py) so request latency and volume attribute to
+    the library that caused them."""
     _tm.SERVE_REQUEST_SECONDS.observe(
         seconds,
         klass="control" if klass == CONTROL
@@ -61,6 +67,7 @@ def observe_request_seconds(klass: str, seconds: float) -> None:
         else "interactive" if klass == INTERACTIVE
         else "background",
     )
+    _tenants.observe("serve", tenant, seconds=seconds)
 
 
 class Shed(Exception):

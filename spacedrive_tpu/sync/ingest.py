@@ -25,6 +25,7 @@ from typing import Any, Awaitable, Callable, Iterable
 
 from ..telemetry import metrics as _tm
 from ..telemetry import span as _span
+from ..telemetry import tenants as _tenants
 from ..telemetry import trace as _trace
 from ..telemetry.events import SYNC_EVENTS
 from ..telemetry.peers import peer_label
@@ -186,6 +187,10 @@ def _finalize_committed(sync: SyncManager, op: CRDTOperation,
         result="tombstone" if outcome == _TOMBSTONE
         else "applied" if outcome == _APPLIED else "stale"
     )
+    # tenant accounting keyed by origin instance (SyncManager carries
+    # no library id) — the one choke point both the per-op and
+    # write-combined batch paths funnel through
+    _tenants.observe("ingest", op.instance)
     current = sync.timestamps.get(op.instance, NTP64(0))
     if op.timestamp > current:
         sync.timestamps[op.instance] = op.timestamp

@@ -32,6 +32,7 @@ from . import (
     resources,
     sampler,
     slo,
+    tenants,
     trace,
 )
 from .registry import (
@@ -60,7 +61,8 @@ def reset() -> None:
     attribution report cache + pass markers, SLO evaluation state, the
     host profiler's accumulators + capture-window ring + trigger
     state, the resource sampler's last-sample state + planted test
-    leaks, and every history writer's in-memory tail (durable history
+    leaks, the tenant plane's heavy-hitter sketches, and every
+    history writer's in-memory tail (durable history
     segments are data-dir state and deliberately survive)."""
     REGISTRY.reset()
     clear_recent()
@@ -70,6 +72,7 @@ def reset() -> None:
     slo.reset()
     sampler.reset()
     resources.reset()
+    tenants.reset()
     history.reset_tails()
     # the index journal's per-location runtime counters + stats cache
     # live like registry series (lazy import: journal imports metrics)
@@ -137,5 +140,5 @@ __all__ = [
     "counter_value", "render", "counter", "gauge", "histogram",
     "trace", "events", "reset", "trace_export", "debug_bundle",
     "health", "federation", "attrib", "history", "slo", "sampler",
-    "resources",
+    "resources", "tenants",
 ]

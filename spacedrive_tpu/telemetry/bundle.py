@@ -158,6 +158,12 @@ def _libraries(node: Any) -> list[dict[str, Any]]:
     return out
 
 
+def _tenants_snapshot() -> dict[str, Any]:
+    from . import tenants as _tenants
+
+    return _tenants.snapshot()
+
+
 def build_bundle(node: Any = None, data_dir: str | None = None) -> dict[str, Any]:
     """Assemble the bundle dict (JSON-serializable, already redacted)."""
     from . import trace as _trace
@@ -195,6 +201,10 @@ def build_bundle(node: Any = None, data_dir: str | None = None) -> dict[str, Any
             "doc": _sampler.SAMPLER.profile(),
             "folded": _sampler.SAMPLER.folded(max_bytes=64 * 1024),
         },
+        # per-tenant accounting snapshot: redaction-clean by
+        # construction — every tenant key is a blake2b tenant_label
+        # hash, never a raw library/instance UUID (sdlint SD027)
+        "tenants": _tenants_snapshot(),
     }
     if node is not None:
         bundle["libraries"] = _libraries(node)

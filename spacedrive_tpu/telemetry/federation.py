@@ -118,6 +118,7 @@ def local_snapshot(node: Any = None) -> dict[str, Any]:
 def _local_snapshot(node: Any = None) -> dict[str, Any]:
     from . import health as _health
     from . import sampler as _sampler
+    from . import tenants as _tenants
 
     snap: dict[str, Any] = {
         "v": SNAPSHOT_VERSION,
@@ -130,6 +131,12 @@ def _local_snapshot(node: Any = None) -> dict[str, Any]:
         # those stay on the owning node behind an explicit profile pull
         "profile": _sampler.SAMPLER.summary(),
     }
+    if _tenants.enabled():
+        # per-tenant heavy-hitter digest (hashed labels, a few numbers
+        # per surface) so every peer's /mesh shows who is spending
+        # each shared surface mesh-wide; gated so SD_TENANT_OBS=0
+        # keeps the snapshot shape identical to a pre-tenants node
+        snap["tenants"] = _tenants.digest()
     if node is not None:
         cfg = node.config.config
         libraries: dict[str, Any] = {}

@@ -69,6 +69,10 @@ P2P_WINDOW_SECONDS = 60.0
 # corroborated by a FRESH federation snapshot — see _sync below)
 SYNC_GAP_DEGRADED = 60.0
 SYNC_GAP_UNHEALTHY = 600.0
+# a resident tenant holding this share of the serve surface (with at
+# least one other tenant present) degrades the tenants subsystem even
+# before the fairness SLO burns
+DOMINANT_DEGRADED = 0.95
 
 
 def _verdict(status: str, reason: str | None = None,
@@ -376,6 +380,51 @@ def _resources() -> dict[str, Any]:
     return _verdict(HEALTHY, **signals)
 
 
+def _tenants() -> dict[str, Any]:
+    """Per-tenant fairness posture (telemetry/tenants.py + the
+    ``tenant_fairness`` SLO). A burning fairness SLO is UNHEALTHY —
+    one library is starving the rest on the serve surface, the exact
+    condition ROADMAP item 4's enforcement loop exists to prevent; a
+    fast-window warn or a dominant tenant holding nearly the whole
+    surface is DEGRADED. Disabled accounting (SD_TENANT_OBS=0) or an
+    idle plane reads UNKNOWN and never worsens the rollup."""
+    from . import slo as _slo_mod
+    from . import tenants as _ten
+
+    if not _ten.enabled():
+        return _verdict(UNKNOWN, "tenant accounting disabled")
+    dig = _ten.digest()
+    if not dig:
+        return _verdict(UNKNOWN, "no tenant observations yet")
+    evaluation = _slo_mod.REGISTRY.last_evaluation or {}
+    fairness_slo = next(
+        (s for s in evaluation.get("slos", ())
+         if s["name"] == "tenant_fairness"), None)
+    serve = dig.get("serve", {})
+    signals = {
+        "surfaces": len(dig),
+        "serve_fairness": serve.get("fairness"),
+        "serve_dominant": serve.get("dominant"),
+        "slo": fairness_slo["status"] if fairness_slo else None,
+        "digest": dig,
+    }
+    if fairness_slo and fairness_slo["status"] == _slo_mod.BREACH:
+        return _verdict(
+            UNHEALTHY,
+            "tenant_fairness burning both windows — a tenant is "
+            "starving the serve surface", **signals)
+    if fairness_slo and fairness_slo["status"] == _slo_mod.WARN:
+        return _verdict(
+            DEGRADED, "tenant_fairness fast-window burn", **signals)
+    if (serve.get("tenants", 0) >= 2
+            and (serve.get("dominant") or 0.0) >= DOMINANT_DEGRADED):
+        return _verdict(
+            DEGRADED,
+            f"dominant tenant holds {serve['dominant']:.0%} of the "
+            "serve surface", **signals)
+    return _verdict(HEALTHY, **signals)
+
+
 def evaluate(node: Any = None) -> dict[str, Any]:
     """The full health rollup: per-subsystem verdicts plus the overall
     status (worst subsystem; ``unknown`` counts as healthy)."""
@@ -388,9 +437,10 @@ def evaluate(node: Any = None) -> dict[str, Any]:
         "resilience": _resilience(),
         "serve": _serve(node),
         "slo": _slo(node),
-        # MUST come after "slo": the trend verdicts it reads are the
+        # MUST come after "slo": the trend verdicts they read are the
         # ones _slo just computed into REGISTRY.last_evaluation
         "resources": _resources(),
+        "tenants": _tenants(),
     }
     overall = HEALTHY
     for v in subsystems.values():

@@ -161,6 +161,16 @@ def default_samplers() -> dict[str, Callable[[], float]]:
             "resource_history_bytes": lambda: gauge_value(
                 "sd_resource_inventory", kind="history_bytes"),
         })
+    from . import tenants as _tenants
+
+    if _tenants.enabled():
+        # fairness surfaces for the tenant_fairness SLO — gated so
+        # SD_TENANT_OBS=0 leaves the sampled allowlist (and every
+        # history record) byte-identical to a pre-tenants node
+        samplers.update({
+            "tenant_fairness_index": _tenants.fairness_index,
+            "tenant_dominant_share": _tenants.dominant_share,
+        })
     return samplers
 
 

@@ -633,3 +633,40 @@ SPAN_BYTES = REGISTRY.counter(
     "bytes attributed to pipeline spans by stage",
     labels=("stage",),
 )
+
+# --- per-tenant accounting (telemetry/tenants.py) ---------------------------
+
+TENANT_OPS = REGISTRY.counter(
+    "sd_tenant_ops_total",
+    "per-tenant observations by surface (serve, cache_hit/miss, "
+    "relay_push/pull, p2p_sync/work/telemetry, ingest, bytes_in/out — "
+    "byte surfaces weight by payload size); tenant labels are blake2b "
+    "tenant_label hashes for sketch residents, with every non-resident "
+    "folded into the aggregated `other` bucket so a million-library "
+    "relay stays inside the series cap",
+    labels=("surface", "tenant"),
+)
+TENANT_SECONDS = REGISTRY.histogram(
+    "sd_tenant_request_seconds",
+    "request latency for sketch-resident tenants (serve surface), "
+    "`other` aggregates the non-resident tail",
+    labels=("surface", "tenant"),
+)
+TENANT_FAIRNESS = REGISTRY.gauge(
+    "sd_tenant_fairness_index",
+    "Jain's fairness index over resident tenant counts per surface: "
+    "1.0 = equal shares, -> 1/n under a single dominant tenant; "
+    "feeds the tenant_fairness SLO via the history series",
+    labels=("surface",),
+)
+TENANT_DOMINANT = REGISTRY.gauge(
+    "sd_tenant_dominant_share",
+    "largest resident tenant's share of the surface total",
+    labels=("surface",),
+)
+TENANT_RESIDENTS = REGISTRY.gauge(
+    "sd_tenant_sketch_residents",
+    "tenants currently resident in the surface's space-saving sketch "
+    "(bounded by SD_TENANT_TOPK)",
+    labels=("surface",),
+)

@@ -112,6 +112,7 @@ class SLO:
 
 def default_slos() -> list[SLO]:
     from . import resources as _resources
+    from . import tenants as _tenants
 
     objective = float(os.environ.get("SD_SLO_INTERACTIVE_P99_MS", "250"))
     throughput = float(os.environ.get("SD_SLO_FILES_PER_S", "50"))
@@ -160,6 +161,21 @@ def default_slos() -> list[SLO]:
                 description="open-fd count flat at steady state "
                             f"(slope ≤ {fd_h:g} fds/h) — growth means "
                             "descriptors are being stranded"),
+        ]
+    if _tenants.enabled():
+        # gated on SD_TENANT_OBS so =0 stays a true no-op: no
+        # tenant_fairness_index series, no SLO over it, no new
+        # sd_slo_status labels — serve output golden-identical
+        fairness_floor = float(
+            os.environ.get("SD_SLO_TENANT_FAIRNESS", "0.5"))
+        slos += [
+            SLO("tenant_fairness", series="tenant_fairness_index",
+                objective=fairness_floor, kind="lower", target=0.95,
+                description="Jain's fairness index over resident "
+                            "serve-surface tenants stays ≥ "
+                            f"{fairness_floor:g} — sustained burn "
+                            "means one library is starving the rest "
+                            "(ROADMAP item 4's enforcement signal)"),
         ]
     return slos
 

@@ -199,5 +199,6 @@ async def test_slo_smoke_attribution_and_slo_surfaces(tmp_path, corpus,
     # healthy 5-file pass
     names = {s["name"] for s in slo_doc["slos"]}
     assert names == {"interactive_p99", "sync_lag", "pass_throughput",
-                     "protected_sheds", "rss_growth", "fd_growth"}
+                     "protected_sheds", "rss_growth", "fd_growth",
+                     "tenant_fairness"}
     assert slo_doc["status"] in ("ok", "no_data"), slo_doc

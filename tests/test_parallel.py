@@ -285,8 +285,13 @@ def test_window_pipeline_close_joins_restarted_producer():
     from spacedrive_tpu.parallel import WindowPipeline
 
     crashed = threading.Event()
+    # gate the producer until the original handle is captured — on a
+    # loaded box it can crash-and-swap before the line after the
+    # constructor runs, making `first` the replacement already
+    handle_read = threading.Event()
 
     def fetch(k):
+        handle_read.wait(5.0)
         if k == 1 and not crashed.is_set():
             crashed.set()
             raise RuntimeError("one-shot crash")
@@ -296,6 +301,7 @@ def test_window_pipeline_close_joins_restarted_producer():
 
     pipe = WindowPipeline(fetch, 0, depth=1)
     first = pipe._thread
+    handle_read.set()
     got = []
     while (w := pipe.take()) is not None:
         got.append(w)

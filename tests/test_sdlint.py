@@ -627,6 +627,46 @@ def test_sd010_silent_on_peer_label_and_non_peer_values(tmp_path):
     assert findings == []
 
 
+# --- SD027 tenant-label-discipline -----------------------------------------
+
+
+def test_sd027_flags_raw_tenant_identifier_labels(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        def record(op, library_id, lib_key, TENANT_OPS, CACHE_OPS):
+            TENANT_OPS.inc(tenant=str(op.library_id))
+            TENANT_OPS.inc(tenant=library_id)
+            CACHE_OPS.inc(lib=lib_key)
+        """,
+        ["SD027"],
+    )
+    assert len(findings) == 3
+    assert rules_of(findings) == ["SD027"]
+    assert "tenant_label" in findings[0].message
+
+
+def test_sd027_silent_on_tenant_label_and_peer_label_values(tmp_path):
+    findings = run_on(
+        tmp_path,
+        """
+        from spacedrive_tpu.telemetry.peers import peer_label
+        from spacedrive_tpu.telemetry.tenants import tenant_label
+
+        def record(op, stage, TENANT_OPS, SYNC_OPS):
+            TENANT_OPS.inc(tenant=tenant_label(op.library_id))
+            label = tenant_label(op.library_id)
+            TENANT_OPS.inc(tenant=label)
+            # peer_label is the same hash discipline — also sanctioned
+            TENANT_OPS.inc(tenant=peer_label(op.instance))
+            SYNC_OPS.inc(result="applied")     # constant — no tenant shape
+            SYNC_OPS.observe(0.1, stage=stage)  # dynamic but not tenant-ish
+        """,
+        ["SD027"],
+    )
+    assert findings == []
+
+
 # --- SD009 event-ring-cardinality -----------------------------------------
 
 

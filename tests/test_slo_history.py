@@ -350,6 +350,7 @@ def test_all_ok_rolls_up_ok_not_no_data(tmp_path):
         "files_per_s": 500.0,
         "interactive_p99_ms": 10.0,
         "protected_sheds_total": 0.0,
+        "tenant_fairness_index": 1.0,
     }))
     now = time.time()
     for i in range(6):

@@ -1,0 +1,301 @@
+"""Per-tenant observability plane (telemetry/tenants.py), end to end:
+space-saving sketch accounting with explicit error bounds, bounded
+metric cardinality (resident labels or ``other``), derived
+fairness/health/SLO planes gated by ``SD_TENANT_OBS``, the redaction
+discipline (raw library/instance UUIDs never leave the process), and
+the two-node loop where tenant digests ride telemetry federation onto
+a peer's ``GET /mesh``.
+
+Note: both loopback nodes live in one process and share the global
+tenant plane — the federation assertions check the digest rides the
+wire and keeps its shape, not that the two nodes diverge.
+"""
+
+import asyncio
+import json
+import os
+import uuid
+
+import pytest
+
+from spacedrive_tpu import telemetry
+from spacedrive_tpu.telemetry import counter_value, gauge_value
+from spacedrive_tpu.telemetry import tenants as tenants_mod
+from spacedrive_tpu.telemetry.tenants import (
+    OTHER,
+    SpaceSavingSketch,
+    tenant_label,
+)
+
+
+# --- the sketch (unit) ------------------------------------------------------
+
+
+def test_sketch_eviction_inherits_floor_and_accounts_other():
+    sk = SpaceSavingSketch(k=2)
+    sk.observe("aa", 5, None)
+    sk.observe("bb", 3, None)
+    assert sk.errs == {"aa": 0.0, "bb": 0.0}  # never evicted → exact
+
+    # full sketch: the newcomer evicts the minimum resident (bb),
+    # inheriting its count as an explicit overestimate bound
+    sk.observe("cc", 1, None)
+    assert set(sk.counts) == {"aa", "cc"}
+    assert sk.counts["cc"] == 4.0 and sk.errs["cc"] == 3.0
+    # bb's observations stay accounted in the aggregated tail, so the
+    # surface total remains exact
+    assert sk.other == 3.0
+    assert sk.total == 9.0
+    assert sk.evictions == 1
+
+    rows = sk.residents()
+    assert [r["tenant"] for r in rows] == ["aa", "cc"]
+    assert rows[0]["err"] == 0.0
+    # count is an upper bound: count - err <= true count <= count
+    assert sk.counts["cc"] - sk.errs["cc"] <= 1 <= sk.counts["cc"]
+
+
+def test_sketch_fairness_index_and_dominant_share():
+    sk = SpaceSavingSketch(k=4)
+    assert sk.fairness_index() == 1.0  # idle: nothing to be unfair about
+    sk.observe("aa", 10, None)
+    assert sk.fairness_index() == 1.0  # single tenant: fair by vacuity
+    sk.observe("bb", 10, None)
+    assert sk.fairness_index() == pytest.approx(1.0)  # equal shares
+    sk.observe("aa", 980, None)
+    # one dominant tenant drives Jain's index toward 1/n
+    assert sk.fairness_index() < 0.6
+    assert sk.dominant_share() == pytest.approx(990 / 1000)
+
+
+def test_sketch_latency_buckets_ride_residents():
+    sk = SpaceSavingSketch(k=4)
+    for _ in range(90):
+        sk.observe("aa", 1, 0.002)
+    for _ in range(10):
+        sk.observe("aa", 1, 8.0)
+    row = sk.residents()[0]
+    # fixed-bucket quantiles: p50 in a small bucket, p99 caught the
+    # outlier in a large one
+    assert row["p50_s"] <= 0.05
+    assert row["p99_s"] >= 1.0
+
+
+def test_tenant_label_agrees_across_id_spellings():
+    """Regression (live-drive find): the serve/cache taps see the
+    request's STRING library id while p2p/sync taps hold ``uuid.UUID``
+    objects — both spellings (plus uppercase/undashed/urn:) must fold
+    to ONE label or a single tenant splits across sketch entries."""
+    lib = uuid.uuid4()
+    canonical = tenant_label(lib)
+    assert tenant_label(str(lib)) == canonical
+    assert tenant_label(str(lib).upper()) == canonical
+    assert tenant_label(lib.hex) == canonical
+    assert tenant_label(f"urn:uuid:{lib}") == canonical
+    # non-UUID tenants (opaque ids) still label stably by their string
+    assert tenant_label("not-a-uuid") == tenant_label("not-a-uuid")
+
+
+# --- metric cardinality: resident labels or ``other`` only ------------------
+
+
+def test_observe_folds_nonresidents_to_other(monkeypatch):
+    monkeypatch.setenv("SD_TENANT_TOPK", "2")
+    telemetry.reset()
+    t1, t2, t3 = uuid.uuid4(), uuid.uuid4(), uuid.uuid4()
+    for _ in range(5):
+        tenants_mod.observe("serve", t1, seconds=0.01)
+        tenants_mod.observe("serve", t2, seconds=0.01)
+    tenants_mod.observe("serve", t3, seconds=0.01)
+
+    # residents carry their own (hashed) label
+    assert counter_value("sd_tenant_ops_total", surface="serve",
+                         tenant=tenant_label(t1)) == 5.0
+    # the newcomer arrived with the sketch full: its metric increment
+    # folded to the aggregated bucket, so series stay bounded by K+1
+    assert counter_value("sd_tenant_ops_total", surface="serve",
+                         tenant=OTHER) == 1.0
+    assert gauge_value("sd_tenant_sketch_residents", surface="serve") == 2.0
+    telemetry.reset()
+
+
+# --- telemetry.reset() clears tenant state (satellite) ----------------------
+
+
+def test_reset_clears_tenant_state():
+    telemetry.reset()
+    tenants_mod.observe("serve", uuid.uuid4(), seconds=0.01)
+    tenants_mod.observe_bytes(uuid.uuid4(), 4096, outbound=True)
+    snap = tenants_mod.snapshot()
+    assert set(snap["surfaces"]) == {"serve", "bytes_out"}
+    assert tenants_mod.digest()["serve"]["total"] == 1.0
+
+    telemetry.reset()
+    assert tenants_mod.snapshot()["surfaces"] == {}
+    assert tenants_mod.digest() == {}
+    assert tenants_mod.fairness_index() == 1.0
+    assert tenants_mod.dominant_share() == 0.0
+
+
+# --- SD_TENANT_OBS=0 is a true no-op ---------------------------------------
+
+
+def test_disabled_plane_gates_every_derived_surface(monkeypatch):
+    from spacedrive_tpu.telemetry import health, history
+    from spacedrive_tpu.telemetry.federation import local_snapshot
+    from spacedrive_tpu.telemetry.slo import default_slos
+
+    telemetry.reset()
+    monkeypatch.setenv("SD_TENANT_OBS", "0")
+    assert tenants_mod.enabled() is False
+
+    # observe() is a no-op; reads return the idle/fair defaults
+    tenants_mod.observe("serve", uuid.uuid4(), seconds=0.01)
+    tenants_mod.observe_bytes(uuid.uuid4(), 1024, outbound=False)
+    snap = tenants_mod.snapshot()
+    assert snap["enabled"] is False and snap["surfaces"] == {}
+    assert tenants_mod.fairness_index() == 1.0
+    assert tenants_mod.dominant_share() == 0.0
+
+    # no fairness SLO, no history samplers, no federation digest key
+    assert all(s.name != "tenant_fairness" for s in default_slos())
+    assert "tenant_fairness_index" not in history.default_samplers()
+    assert "tenants" not in local_snapshot()
+
+    # the health subsystem reports UNKNOWN and never worsens the rollup
+    v = health.evaluate()
+    assert v["subsystems"]["tenants"]["status"] == health.UNKNOWN
+    assert v["status"] == health.HEALTHY
+
+    # flipping the plane back on restores every surface
+    monkeypatch.delenv("SD_TENANT_OBS")
+    assert any(s.name == "tenant_fairness" for s in default_slos())
+    assert "tenant_fairness_index" in history.default_samplers()
+    assert "tenants" in local_snapshot()
+    telemetry.reset()
+
+
+# --- health subsystem -------------------------------------------------------
+
+
+def test_health_tenants_unknown_then_degraded_on_dominance():
+    from spacedrive_tpu.telemetry import health
+
+    telemetry.reset()
+    v = health.evaluate()
+    assert v["subsystems"]["tenants"]["status"] == health.UNKNOWN
+    assert v["status"] == health.HEALTHY  # UNKNOWN never worsens rollup
+
+    # two tenants, one holding ~99% of the serve surface → DEGRADED
+    hog, mouse = uuid.uuid4(), uuid.uuid4()
+    for _ in range(99):
+        tenants_mod.observe("serve", hog)
+    tenants_mod.observe("serve", mouse)
+    v = health.evaluate()
+    ten = v["subsystems"]["tenants"]
+    assert ten["status"] == health.DEGRADED
+    assert "dominant" in ten["reason"]
+    telemetry.reset()
+
+
+# --- redaction: a planted UUID never appears raw ---------------------------
+
+
+def test_planted_uuid_never_raw_on_any_read_surface():
+    from spacedrive_tpu.telemetry.bundle import build_bundle
+    from spacedrive_tpu.telemetry.registry import REGISTRY
+
+    telemetry.reset()
+    planted = uuid.uuid4()
+    tenants_mod.observe("serve", planted, seconds=0.01)
+    tenants_mod.observe("ingest", planted)
+    tenants_mod.observe_bytes(planted, 65536, outbound=True)
+    label = tenant_label(planted)
+
+    metrics_text = REGISTRY.render()
+    snapshot_doc = json.dumps(tenants_mod.snapshot())
+    digest_doc = json.dumps(tenants_mod.digest())
+    bundle_doc = json.dumps(build_bundle())
+    for doc in (metrics_text, snapshot_doc, digest_doc, bundle_doc):
+        assert str(planted) not in doc
+        assert planted.hex not in doc
+    # ...while the hashed label IS there (the surfaces are useful)
+    assert label in metrics_text
+    assert label in snapshot_doc
+    assert label in bundle_doc
+    telemetry.reset()
+
+
+# --- the two-node loop: digests ride federation onto /mesh ------------------
+
+
+from spacedrive_tpu.p2p.loopback import make_mesh_pair  # noqa: E402
+
+
+@pytest.mark.asyncio
+async def test_two_node_tenant_digests_on_peer_mesh(tmp_path):
+    """Tenant digests ride ``local_snapshot`` over the TELEMETRY wire:
+    a peer's ``GET /mesh`` carries them fresh, keeps the last-known
+    copy when the peer partitions (stale → unhealthy), and no surface
+    — /mesh, /tenants, rspc — ever shows a raw library UUID."""
+    import aiohttp
+
+    telemetry.reset()
+    a, b, lib_a, lib_b, _server_tasks = await make_mesh_pair(tmp_path)
+    try:
+        planted = uuid.uuid4()
+        tenants_mod.observe("serve", planted, seconds=0.02)
+        tenants_mod.observe("relay_push", str(lib_a.id))
+        label = tenant_label(planted)
+
+        a.p2p.federation.refresh_interval = 0.0
+        port = await a.start_api()
+        base = f"http://127.0.0.1:{port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.get(f"{base}/mesh") as resp:
+                assert resp.status == 200
+                mesh_doc = await resp.json()
+            async with http.get(f"{base}/tenants") as resp:
+                assert resp.status == 200
+                tenants_doc = await resp.json()
+            async with http.post(f"{base}/rspc/telemetry.tenants",
+                                 json={}) as resp:
+                assert resp.status == 200
+                rspc_doc = (await resp.json())["result"]
+
+        # the local snapshot and the peer's federated snapshot both
+        # carry the digest (the peer's rode the TELEMETRY stream)
+        assert "serve" in mesh_doc["local"]["tenants"]
+        b_key = str(b.p2p.p2p.remote_identity)
+        entry = mesh_doc["mesh"]["peers"][b_key]
+        assert entry["stale"] is False
+        peer_digest = entry["snapshot"]["tenants"]
+        assert peer_digest["serve"]["total"] >= 1.0
+        assert peer_digest["serve"]["top"][0]["tenant"] == label
+
+        # full read paths agree and are redaction-clean
+        assert rspc_doc["surfaces"].keys() == tenants_doc["surfaces"].keys()
+        everything = json.dumps([mesh_doc, tenants_doc, rspc_doc])
+        assert str(planted) not in everything
+        assert planted.hex not in everything
+        assert str(lib_a.id) not in json.dumps(tenants_doc)
+        assert label in everything
+
+        # --- partition: stale then unhealthy, digest retained ----------
+        a.p2p.federation.stale_after = 0.3
+
+        async def refuse(identity, timeout=10.0):
+            raise ConnectionError("partitioned")
+
+        a.p2p.p2p.new_stream = refuse
+        await asyncio.sleep(0.4)
+        mesh2 = await a.p2p.refresh_federation(force=True)
+        entry2 = mesh2["peers"][b_key]
+        assert entry2["stale"] is True
+        assert entry2["verdict"] == "unhealthy"
+        # the operator still sees the last-known tenant posture
+        assert "serve" in entry2["snapshot"]["tenants"]
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+    telemetry.reset()

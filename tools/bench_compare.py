@@ -318,6 +318,11 @@ AUTOTUNE_CLEAN_MIN = 0.95
 SERVE_P99_RATIO_MAX = 5.0
 SERVE_GOODPUT_MIN = 0.7
 SERVE_SHED_P99_MAX_S = 1.0
+# the multi-tenant leg's bars (telemetry/tenants.py acceptance): the
+# serve sketch's resident top-K must recall ≥ this fraction of the
+# exact client-side oracle, protected classes must not shed during the
+# arm, and the SD_TENANT_OBS=0 replay must digest bit-identical bodies
+SERVE_TENANT_RECALL_MIN = 0.9
 
 
 def check_serve(doc: dict[str, Any]) -> dict[str, Any]:
@@ -371,8 +376,50 @@ def check_serve(doc: dict[str, Any]) -> dict[str, Any]:
         checked.append(rec)
         if bad:
             regressions.append(rec)
+    _check_serve_tenants(doc, checked, regressions, skipped)
     return {"checked": checked, "regressions": regressions,
             "skipped": skipped}
+
+
+def _check_serve_tenants(doc: dict[str, Any], checked: list,
+                         regressions: list, skipped: list) -> None:
+    """Gate the multi-tenant leg of a BENCH_SERVE document; recordings
+    that predate the leg skip it (nothing to gate, not a failure)."""
+    ten = doc.get("tenants")
+    if not isinstance(ten, dict):
+        skipped.append("serve.tenants: leg not recorded (older artifact)")
+        return
+
+    recall = ten.get("topk_recall")
+    if not isinstance(recall, (int, float)) or isinstance(recall, bool):
+        skipped.append("serve.tenants.topk_recall: not recorded")
+    else:
+        rec = {"name": "serve.tenants.topk_recall",
+               "old": SERVE_TENANT_RECALL_MIN,
+               "new": round(float(recall), 3),
+               "delta_pct": round(
+                   (float(recall) - SERVE_TENANT_RECALL_MIN) * 100, 2)}
+        checked.append(rec)
+        if recall < SERVE_TENANT_RECALL_MIN:
+            regressions.append(rec)
+
+    bad = bool(ten.get("control_shed", 0) or ten.get("sync_shed", 0))
+    rec = {"name": "serve.tenants.protected_classes", "old": 0,
+           "new": 1 if bad else 0, "delta_pct": -100.0 if bad else 0.0}
+    checked.append(rec)
+    if bad:
+        regressions.append(rec)
+
+    identical = ten.get("obs_off_identical")
+    if not isinstance(identical, bool):
+        skipped.append("serve.tenants.obs_off_identical: not recorded")
+    else:
+        rec = {"name": "serve.tenants.obs_off_identical", "old": 1,
+               "new": 1 if identical else 0,
+               "delta_pct": 0.0 if identical else -100.0}
+        checked.append(rec)
+        if not identical:
+            regressions.append(rec)
 
 
 def check_autotune(doc: dict[str, Any]) -> dict[str, Any]:

@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""Diff tier-1 failure *sets* against the checked-in baseline.
+
+ROADMAP's standing rule — "always diff failure sets against baseline,
+never compare counts" — was hand-eyeballed for ten PRs: a new failure
+could hide behind a coincidentally-fixed old one and the ~29-failure
+count would still look clean. This tool machine-enforces the rule:
+
+- ``tests/tier1_known_failures.txt`` is the committed baseline — one
+  ``path::test_id`` per line, the documented env-rooted failures;
+- the tier-1 runner tees its output to ``/tmp/_t1.log`` (ROADMAP's
+  verify command); this tool parses the pytest short summary
+  (``FAILED``/``ERROR`` lines) out of that log;
+- any failure id NOT in the baseline fails the check (exit 1) — that
+  is a regression no matter what the total count did;
+- baseline ids that now pass are reported as resolved (exit 0): run
+  with ``--update`` to shrink the baseline once they're understood.
+
+Wired into ``make bench-check`` so the same gate that rejects bench
+regressions rejects test regressions. A missing log is a soft skip
+(bench-check must be runnable without a fresh tier-1 run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+DEFAULT_LOG = "/tmp/_t1.log"
+DEFAULT_BASELINE = os.path.join("tests", "tier1_known_failures.txt")
+
+#: a pytest short-summary failure line: ``FAILED tests/x.py::id - msg``
+#: (anchored on ``tests/`` so application ERROR log lines in the tee'd
+#: output can never masquerade as a failure id)
+_FAILURE_LINE = re.compile(r"^(?:FAILED|ERROR)\s+(tests/\S+)")
+
+
+def parse_failures(text: str) -> set[str]:
+    out = set()
+    for line in text.splitlines():
+        m = _FAILURE_LINE.match(line)
+        if m:
+            out.add(m.group(1).split(" - ")[0].rstrip(","))
+    return out
+
+
+def load_baseline(path: str) -> set[str]:
+    out = set()
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--log", default=DEFAULT_LOG,
+                    help="tier-1 pytest log (tee'd by the verify "
+                         f"command; default {DEFAULT_LOG})")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"known-failure ids (default {DEFAULT_BASELINE})")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from the log's failure "
+                         "set (use only after understanding every diff)")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.log):
+        print(f"check_failures: no tier-1 log at {args.log} — run the "
+              "tier-1 suite first (soft skip)")
+        return 0
+    with open(args.log, encoding="utf-8", errors="replace") as fh:
+        text = fh.read()
+    failures = parse_failures(text)
+    if "passed" not in text and "failed" not in text \
+            and "no tests ran" not in text:
+        print(f"check_failures: {args.log} has no pytest summary — "
+              "truncated run? refusing to judge it")
+        return 1
+
+    if args.update:
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(sorted(failures)) + ("\n" if failures else ""))
+        print(f"check_failures: baseline rewritten with "
+              f"{len(failures)} ids")
+        return 0
+
+    baseline = load_baseline(args.baseline) \
+        if os.path.exists(args.baseline) else set()
+    new = sorted(failures - baseline)
+    resolved = sorted(baseline - failures)
+    print(f"check_failures: {len(failures)} failing, "
+          f"{len(baseline)} baselined, {len(new)} new, "
+          f"{len(resolved)} resolved")
+    for fid in resolved:
+        print(f"  RESOLVED {fid}  (run --update to shrink baseline)")
+    for fid in new:
+        print(f"  NEW      {fid}")
+    if new:
+        print("check_failures: FAIL — new tier-1 failures (the set "
+              "grew; counts are irrelevant)")
+        return 1
+    print("check_failures: OK — failure set within baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,6 +7,9 @@ SD009  event-ring emissions with non-constant event types / unauditable
        field expansion
 SD010  peer/instance identifiers fed into metric labels without the
        ``peer_label`` short-hash
+SD027  library/tenant identifiers fed into metric labels without the
+       ``tenant_label`` (or ``peer_label``) short-hash — the SD010
+       discipline extended to tenancy ids
 SD020  metric-catalog drift: every ``sd_*`` family minted in the tree
        must have a ``docs/telemetry.md`` catalog row, and every catalog
        row must name a family that still exists
@@ -45,8 +48,10 @@ from ..core import FileContext, Finding, call_name, dotted_name, rule, walk_shal
 
 _RECORD_METHODS = {"inc", "observe", "set", "labels", "dec"}
 
-# the sanctioned per-peer label mapping (telemetry/peers.py)
-_PEER_LABEL_FUNC = "peer_label"
+# the sanctioned identifier→label mappings: telemetry/peers.py
+# ``peer_label`` and telemetry/tenants.py ``tenant_label`` (the same
+# blake2b short-hash applied to library/instance tenancy — SD027)
+_LABEL_FUNCS = {"peer_label", "tenant_label"}
 
 
 def _is_metric_handle(expr: ast.AST) -> bool:
@@ -61,7 +66,7 @@ def _is_metric_handle(expr: ast.AST) -> bool:
 def _is_peer_label_call(expr: ast.AST) -> bool:
     return (
         isinstance(expr, ast.Call)
-        and (call_name(expr) or "").rsplit(".", 1)[-1] == _PEER_LABEL_FUNC
+        and (call_name(expr) or "").rsplit(".", 1)[-1] in _LABEL_FUNCS
     )
 
 
@@ -231,6 +236,77 @@ def check_peer_identifier_labels(ctx: FileContext) -> Iterator[Finding]:
                     f"is fed from peer identifier `{mention}` — wrap it in "
                     f"telemetry.peers.peer_label(...) (capped stable "
                     f"short-hash), never the raw id",
+                )
+
+
+# -- SD027 ------------------------------------------------------------------
+
+# identifier fragments that mark a value as library/tenant-shaped —
+# the tenancy mirror of _PEER_ID_TOKENS ("lib" alone is too noisy:
+# the tree is full of `lib`/`library` locals that never touch ids)
+_TENANT_ID_TOKENS = ("library", "lib_id", "lib_key", "lib_uuid",
+                     "tenant")
+
+
+def _tenant_identifier_mention(expr: ast.AST,
+                               safe_names: set[str]) -> str | None:
+    """The first library/tenant-shaped identifier referenced by
+    ``expr`` outside a ``tenant_label``/``peer_label`` wrapping, or
+    None — the SD010 walk with the tenancy token set."""
+    stack = [expr]
+    while stack:
+        cur = stack.pop()
+        if _is_peer_label_call(cur):
+            continue  # hashed — don't descend
+        if isinstance(cur, ast.Name) and cur.id in safe_names:
+            continue
+        ident = None
+        if isinstance(cur, ast.Name):
+            ident = cur.id
+        elif isinstance(cur, ast.Attribute):
+            ident = cur.attr
+        if ident is not None and any(
+            tok in ident.lower() for tok in _TENANT_ID_TOKENS
+        ):
+            return ident
+        stack.extend(ast.iter_child_nodes(cur))
+    return None
+
+
+@rule(
+    "SD027",
+    "tenant-label-discipline",
+    "metric labels fed from library/tenant identifiers must go through "
+    "telemetry.tenants.tenant_label (or peers.peer_label) — a raw "
+    "library UUID on a series is unbounded cardinality AND a privacy "
+    "leak into every scrape, /tenants read, and debug bundle",
+)
+def check_tenant_identifier_labels(ctx: FileContext) -> Iterator[Finding]:
+    safe = _ScopeSafeNames(ctx)
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _RECORD_METHODS
+            and _is_metric_handle(node.func.value)
+        ):
+            continue
+        handle = dotted_name(node.func.value)
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue  # SD007 already rejects ** expansion
+            if _is_sanctioned_peer_value(kw.value, safe.for_call(node)):
+                continue
+            mention = _tenant_identifier_mention(
+                kw.value, safe.for_call(node))
+            if mention is not None:
+                yield ctx.finding(
+                    "SD027",
+                    node,
+                    f"label `{kw.arg}=...` on `{handle}.{node.func.attr}` "
+                    f"is fed from tenant identifier `{mention}` — wrap "
+                    f"it in telemetry.tenants.tenant_label(...) (blake2b "
+                    f"short-hash), never the raw library/instance id",
                 )
 
 

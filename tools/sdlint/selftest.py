@@ -108,6 +108,10 @@ CORPUS: dict[str, dict] = {
         def record(op, SYNC_LAG):
             SYNC_LAG.set(1.0, peer=str(op.instance))
     """}},
+    "SD027": {"files": {"pkg/mod.py": """
+        def record(op, TENANT_OPS):
+            TENANT_OPS.inc(tenant=str(op.library_id))
+    """}},
     "SD011": {"files": {"pkg/mod.py": """
         async def hammer(client):
             while True:
